@@ -1,0 +1,27 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference serving framework.
+
+Capabilities modeled on NVIDIA Dynamo (see SURVEY.md): OpenAI-compatible
+frontend, KV-cache-aware routing over a global radix index, disaggregated
+prefill/decode, multi-tier paged-KV block management, request migration, and
+SLA-driven planning — but the compute path is JAX/XLA/Pallas on TPU and the
+data planes are designed for ICI/DCN + host DMA rather than NCCL/NIXL.
+
+Layer map (bottom-up), mirroring the reference's layering
+(reference: lib/runtime, lib/llm, components/ — SURVEY.md §1):
+
+- ``dynamo_tpu.runtime``  — distributed runtime kernel: KV store w/ leases +
+  watches (control plane), Namespace→Component→Endpoint registry, TCP
+  request/response plane, AsyncEngine pipeline, routing, metrics, config.
+- ``dynamo_tpu.llm``      — OpenAI protocol types, SSE, preprocessor,
+  detokenizing backend, model cards, discovery.
+- ``dynamo_tpu.kv_router``— KV-cache-aware routing: radix indexer, cost
+  scheduler, event publishers.
+- ``dynamo_tpu.engine``   — the TPU inference engine: JAX models, paged KV
+  cache, Pallas paged attention, continuous batching.
+- ``dynamo_tpu.block_manager`` — multi-tier KV block pools (HBM/host/disk).
+- ``dynamo_tpu.mocker``   — CPU-only fake engine for routing/serving tests.
+- ``dynamo_tpu.planner``  — SLA-driven autoscaling.
+- ``dynamo_tpu.parallel`` — meshes, shardings, ring attention.
+"""
+
+__version__ = "0.1.0"

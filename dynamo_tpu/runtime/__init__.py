@@ -1,0 +1,29 @@
+"""Distributed runtime kernel (hardware-agnostic).
+
+Fills the role of the reference's ``dynamo-runtime`` Rust crate
+(reference: lib/runtime/src/lib.rs:36-60): async runtime + cancellation,
+cluster handle, component addressing, discovery, request/response planes,
+routing, metrics, config, logging.
+
+Design departures from the reference (deliberate, TPU-era re-design):
+
+- Control plane is a self-hosted replicated KV store speaking a msgpack/TCP
+  protocol (``store.py``) instead of etcd; same semantics (leases, prefix
+  watch, CAS) with zero external infra.
+- Request + response planes are a single bidirectional framed-TCP stream
+  plane (``messaging.py``) instead of NATS publish + separate TCP back-
+  channel (reference: lib/runtime/src/pipeline/network/egress/
+  addressed_router.rs:86-211). One hop fewer, same per-token streaming.
+"""
+
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineStream
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+__all__ = [
+    "RuntimeConfig",
+    "AsyncEngine",
+    "Context",
+    "EngineStream",
+    "DistributedRuntime",
+]

@@ -1,0 +1,105 @@
+"""Discovery client: a live, watched view of an endpoint's instances.
+
+Reference analogue: ``Client::new_dynamic`` with an etcd prefix watcher
+feeding a ``tokio::sync::watch`` of instances, availability filtering, and
+``report_instance_down`` (reference: lib/runtime/src/component/client.rs:
+66-84,134-143,204-258).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.runtime.component import Instance, instance_prefix
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.store import EventKind, KeyValueStore
+
+log = get_logger("client")
+
+
+class DiscoveryClient:
+    def __init__(self, store: KeyValueStore, namespace: str, component: str, endpoint: str):
+        self.namespace = namespace
+        self.component = component
+        self.endpoint = endpoint
+        self._store = store
+        self._prefix = instance_prefix(namespace, component, endpoint)
+        self._instances: dict[str, Instance] = {}
+        self._down: set[int] = set()
+        self._changed = asyncio.Event()
+        self._watch = None
+        self._watch_task: asyncio.Task | None = None
+        self._started = False
+
+    async def start(self) -> "DiscoveryClient":
+        if self._started:
+            return self
+        self._started = True
+        self._watch = await self._store.watch_prefix(self._prefix)
+        for entry in self._watch.snapshot:
+            self._instances[entry.key] = Instance.from_bytes(entry.value)
+        self._changed.set()
+        self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
+        return self
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watch:
+                if ev.kind == EventKind.PUT:
+                    inst = Instance.from_bytes(ev.value)
+                    self._instances[ev.key] = inst
+                    # A re-registered instance id is alive again.
+                    self._down.discard(inst.instance_id)
+                else:
+                    inst = self._instances.pop(ev.key, None)
+                    if inst is not None:
+                        self._down.discard(inst.instance_id)
+                self._changed.set()
+        except asyncio.CancelledError:
+            pass
+
+    def instances(self) -> list[Instance]:
+        """All registered instances, including ones locally marked down."""
+        return list(self._instances.values())
+
+    def available(self) -> list[Instance]:
+        """Instances not locally marked down — the routing set."""
+        return [i for i in self._instances.values() if i.instance_id not in self._down]
+
+    def instance_ids(self) -> list[int]:
+        return [i.instance_id for i in self.available()]
+
+    def get(self, instance_id: int) -> Instance | None:
+        for inst in self._instances.values():
+            if inst.instance_id == instance_id:
+                return inst
+        return None
+
+    def report_instance_down(self, instance_id: int) -> None:
+        """Fast-path fault marking before the lease expires
+        (reference: client.rs:134-143). Cleared when the watch shows the
+        instance re-register or vanish."""
+        self._down.add(instance_id)
+        self._changed.set()
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[Instance]:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while len(self.available()) < n:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"{self._prefix}: {len(self.available())}/{n} instances after {timeout}s"
+                )
+            self._changed.clear()
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+        return self.available()
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+        if self._watch is not None:
+            await self._watch.cancel()

@@ -1,0 +1,122 @@
+"""Layered runtime configuration.
+
+Mirrors the reference's figment stack — defaults → TOML file → env overrides
+(reference: lib/runtime/src/config.rs:25-214) — with ``DYNTPU_*`` environment
+variables in place of ``DYN_RUNTIME_*``.
+
+Precedence (lowest→highest): dataclass defaults, TOML file named by
+``DYNTPU_CONFIG``, then ``DYNTPU_<SECTION>_<FIELD>`` env vars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any
+
+_ENV_PREFIX = "DYNTPU"
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    if typ is bool:
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class RuntimeConfig:
+    """Worker/runtime-level knobs (section ``[runtime]``, env ``DYNTPU_RUNTIME_*``)."""
+
+    # Number of worker threads for compute-adjacent thread pools (0 = ncpu).
+    num_worker_threads: int = 0
+    # Grace period (s) for in-flight requests during shutdown.
+    graceful_shutdown_timeout: float = 30.0
+    # Maximum concurrent in-flight requests an endpoint accepts.
+    max_inflight: int = 4096
+
+    @classmethod
+    def section(cls) -> str:
+        return "runtime"
+
+
+@dataclass
+class StoreConfig:
+    """Control-plane store client config (section ``[store]``, env ``DYNTPU_STORE_*``)."""
+
+    # URL of the store server, e.g. "tcp://127.0.0.1:3280". "memory://" selects
+    # the in-process store (single-process deployments and tests).
+    url: str = "memory://"
+    # Lease time-to-live seconds; keepalives are sent at ttl/3.
+    lease_ttl: float = 10.0
+    connect_timeout: float = 5.0
+
+    @classmethod
+    def section(cls) -> str:
+        return "store"
+
+
+@dataclass
+class SystemConfig:
+    """System status server (section ``[system]``, env ``DYNTPU_SYSTEM_*``).
+
+    Reference analogue: env-gated health/metrics server
+    (reference: lib/runtime/src/config.rs:98-123, http_server.rs:33-69).
+    """
+
+    enabled: bool = False
+    host: str = "0.0.0.0"
+    port: int = 9090
+
+    @classmethod
+    def section(cls) -> str:
+        return "system"
+
+
+@dataclass
+class Config:
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
+    system: SystemConfig = field(default_factory=SystemConfig)
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "Config":
+        """Build config honoring precedence defaults < TOML < env."""
+        env = dict(os.environ if env is None else env)
+        layers: dict[str, dict[str, Any]] = {}
+        toml_path = env.get(f"{_ENV_PREFIX}_CONFIG")
+        if toml_path and os.path.exists(toml_path):
+            with open(toml_path, "rb") as f:
+                layers = tomllib.load(f)
+
+        cfg = cls()
+        for section_obj in (cfg.runtime, cfg.store, cfg.system):
+            section = section_obj.section()
+            toml_section = layers.get(section, {})
+            for f_ in dataclasses.fields(section_obj):
+                if f_.name in toml_section:
+                    setattr(section_obj, f_.name, toml_section[f_.name])
+                env_key = f"{_ENV_PREFIX}_{section.upper()}_{f_.name.upper()}"
+                if env_key in env:
+                    setattr(section_obj, f_.name, _coerce(env[env_key], f_.type if isinstance(f_.type, type) else type(getattr(section_obj, f_.name))))
+        return cfg
+
+
+_GLOBAL: Config | None = None
+
+
+def global_config() -> Config:
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = Config.from_env()
+    return _GLOBAL
+
+
+def reset_global_config() -> None:
+    global _GLOBAL
+    _GLOBAL = None

@@ -1,0 +1,77 @@
+"""AsyncEngine: the universal streaming-inference interface, plus request Context.
+
+Reference analogue: ``AsyncEngine<SingleIn<T>, ManyOut<U>, E>`` with a
+``Context`` carrying request id and cancellation across pipeline stages
+(reference: lib/runtime/src/pipeline.rs:16-124, engine.rs).
+
+Every stage of a serving pipeline — preprocessor, router, backend, engine,
+network hop — implements the same shape: one request in, an async stream of
+responses out. Operators compose by wrapping a downstream engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, AsyncIterator, Protocol, runtime_checkable
+
+from dynamo_tpu.runtime.logging import TraceContext
+
+EngineStream = AsyncIterator[Any]
+
+
+class Context:
+    """Per-request context: id, distributed trace, cancellation, annotations.
+
+    Cancellation is cooperative and propagates *forward* through pipeline
+    stages (each stage passes the same context downstream) and across the
+    network (the messaging layer converts it to a cancel frame)."""
+
+    def __init__(
+        self,
+        request_id: str | None = None,
+        trace: TraceContext | None = None,
+        metadata: dict[str, Any] | None = None,
+    ):
+        self.id = request_id or uuid.uuid4().hex
+        self.trace = trace
+        self.metadata: dict[str, Any] = metadata or {}
+        self._cancelled = asyncio.Event()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    async def wait_cancelled(self) -> None:
+        await self._cancelled.wait()
+
+    def child(self) -> "Context":
+        """Context to forward downstream: same id/cancellation, child span."""
+        ctx = Context(self.id, self.trace.child() if self.trace else None, dict(self.metadata))
+        ctx._cancelled = self._cancelled
+        return ctx
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    """One request in → stream of responses out."""
+
+    def generate(self, request: Any, context: Context) -> EngineStream: ...
+
+
+class Operator:
+    """Base for pipeline stages wrapping a downstream engine."""
+
+    def __init__(self, inner: AsyncEngine):
+        self.inner = inner
+
+    def generate(self, request: Any, context: Context) -> EngineStream:  # pragma: no cover
+        raise NotImplementedError
+
+
+async def collect(stream: EngineStream) -> list[Any]:
+    """Drain a stream to a list (test/aggregation helper)."""
+    return [item async for item in stream]

@@ -1,0 +1,45 @@
+"""Length-prefixed msgpack framing over asyncio streams.
+
+The wire format for both the control plane (store) and the request plane
+(messaging): ``u32_be length || msgpack payload``. Analogue of the
+reference's two-part codec (reference: lib/runtime/src/pipeline/network/
+codec/two_part.rs) — here a single msgpack map carries header + body, with
+raw ``bytes`` payloads passing through msgpack unencoded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import msgpack
+
+MAX_FRAME = 256 * 1024 * 1024  # 256 MiB hard cap; KV block transfers chunk below this.
+
+_LEN = struct.Struct(">I")
+
+
+def pack(obj) -> bytes:
+    body = msgpack.packb(obj, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    """Read one frame; returns the decoded object or None on clean EOF."""
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return msgpack.unpackb(body, raw=False)
+
+
+async def write_frame(writer: asyncio.StreamWriter, obj) -> None:
+    writer.write(pack(obj))
+    await writer.drain()

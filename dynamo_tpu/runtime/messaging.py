@@ -1,0 +1,307 @@
+"""Request/response plane: bidirectional framed-TCP streaming RPC.
+
+Replaces the reference's NATS request plane + one-shot TCP response plane
+(reference: lib/runtime/src/pipeline/network/egress/addressed_router.rs:86-211
+and ingress/push_endpoint.rs:46-136) with a single plane:
+
+- each worker process runs one :class:`EndpointServer` (one TCP port)
+  hosting many endpoints keyed by *subject* ``{ns}/{component}/{endpoint}``;
+- a caller holds pooled connections per (host, port); requests are
+  multiplexed by request id; responses stream back on the same connection
+  with an explicit final/error frame (the reference's ``complete_final``
+  marker — a truncated stream without it is detectably abnormal);
+- cancellation is a client→server frame that trips the server-side
+  :class:`~dynamo_tpu.runtime.engine.Context`.
+
+Wire frames (msgpack maps):
+  client→server: {t:"req", id, subject, payload, headers} | {t:"cancel", id}
+  server→client: {t:"data", id, payload} | {t:"final", id} | {t:"err", id, error}
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.logging import TraceContext, get_logger, set_current_trace
+
+log = get_logger("messaging")
+
+Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+
+class StreamError(Exception):
+    """Remote handler raised; message carries the remote error string."""
+
+
+class TruncatedStreamError(Exception):
+    """Connection dropped before the final frame — worker likely died.
+
+    Analogue of the reference's truncated-stream fault signal
+    (reference: push_router.rs:168-201)."""
+
+
+class NoHandlerError(Exception):
+    """Subject not served at the target (analogue of NATS NoResponders)."""
+
+
+class EndpointServer:
+    """Per-process ingress: serves all endpoints this process registered."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0, advertise_host: str | None = None):
+        self.host = host
+        self.port = port
+        self.advertise_host = advertise_host or ("127.0.0.1" if host in ("0.0.0.0", "") else host)
+        self._handlers: dict[str, Handler] = {}
+        self._server: asyncio.Server | None = None
+        self._inflight: dict[str, int] = {}
+        self._draining: set[str] = set()
+        self._idle: dict[str, asyncio.Event] = {}
+
+    async def start(self) -> "EndpointServer":
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("endpoint server listening on %s:%d", self.host, self.port)
+        return self
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.advertise_host, self.port)
+
+    def register(self, subject: str, handler: Handler) -> None:
+        self._handlers[subject] = handler
+        self._inflight.setdefault(subject, 0)
+        self._idle[subject] = asyncio.Event()
+        self._idle[subject].set()
+        self._draining.discard(subject)
+
+    def unregister(self, subject: str) -> None:
+        self._handlers.pop(subject, None)
+
+    def inflight(self, subject: str) -> int:
+        return self._inflight.get(subject, 0)
+
+    async def drain(self, subject: str, timeout: float = 30.0) -> None:
+        """Stop accepting new requests for subject; wait for in-flight ones.
+
+        Graceful-shutdown path (reference: push_endpoint.rs graceful shutdown
+        with inflight counter)."""
+        self._draining.add(subject)
+        if self._inflight.get(subject, 0) > 0:
+            try:
+                await asyncio.wait_for(self._idle[subject].wait(), timeout)
+            except asyncio.TimeoutError:
+                log.warning("drain timeout for %s (%d inflight)", subject, self._inflight[subject])
+        self.unregister(subject)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        write_lock = asyncio.Lock()
+        tasks: dict[str, asyncio.Task] = {}
+        contexts: dict[str, Context] = {}
+
+        async def send(obj) -> None:
+            async with write_lock:
+                await framing.write_frame(writer, obj)
+
+        try:
+            while True:
+                msg = await framing.read_frame(reader)
+                if msg is None:
+                    break
+                t = msg.get("t")
+                if t == "req":
+                    rid = msg["id"]
+                    ctx = self._make_context(rid, msg.get("headers") or {})
+                    contexts[rid] = ctx
+                    task = asyncio.get_running_loop().create_task(
+                        self._run_request(msg, ctx, send)
+                    )
+                    tasks[rid] = task
+                    task.add_done_callback(lambda _t, r=rid: (tasks.pop(r, None), contexts.pop(r, None)))
+                elif t == "cancel":
+                    ctx = contexts.get(msg["id"])
+                    if ctx is not None:
+                        ctx.cancel()
+        finally:
+            for ctx in contexts.values():
+                ctx.cancel()
+            for task in list(tasks.values()):
+                task.cancel()
+            writer.close()
+
+    def _make_context(self, rid: str, headers: dict) -> Context:
+        trace = None
+        tp = headers.get("traceparent")
+        if tp:
+            trace = TraceContext.parse(tp, headers.get("tracestate"))
+        return Context(request_id=rid, trace=trace, metadata=dict(headers.get("metadata") or {}))
+
+    async def _run_request(self, msg: dict, ctx: Context, send) -> None:
+        rid, subject = msg["id"], msg["subject"]
+        handler = self._handlers.get(subject)
+        if handler is None or subject in self._draining:
+            await send({"t": "err", "id": rid, "error": f"no handler for {subject}", "kind": "no_handler"})
+            return
+        self._inflight[subject] += 1
+        self._idle[subject].clear()
+        token = set_current_trace(ctx.trace)
+        try:
+            async for item in handler(msg.get("payload"), ctx):
+                if ctx.cancelled:
+                    break
+                await send({"t": "data", "id": rid, "payload": item})
+            await send({"t": "final", "id": rid})
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            log.exception("handler error for %s", subject)
+            try:
+                await send({"t": "err", "id": rid, "error": f"{type(e).__name__}: {e}"})
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        finally:
+            set_current_trace(token.old_value if hasattr(token, "old_value") else None)
+            self._inflight[subject] -= 1
+            if self._inflight[subject] == 0:
+                self._idle[subject].set()
+
+
+class _Connection:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.write_lock = asyncio.Lock()
+        self.streams: dict[str, asyncio.Queue] = {}
+        self.pump: asyncio.Task | None = None
+        self.closed = False
+
+    def start_pump(self) -> None:
+        self.pump = asyncio.get_running_loop().create_task(self._pump_loop())
+
+    async def _pump_loop(self) -> None:
+        while True:
+            msg = await framing.read_frame(self.reader)
+            if msg is None:
+                break
+            queue = self.streams.get(msg.get("id"))
+            if queue is not None:
+                queue.put_nowait(msg)
+        self.closed = True
+        for queue in self.streams.values():
+            queue.put_nowait(None)  # None ⇒ connection lost mid-stream
+
+    async def send(self, obj) -> None:
+        async with self.write_lock:
+            await framing.write_frame(self.writer, obj)
+
+    def close(self) -> None:
+        self.closed = True
+        if self.pump is not None:
+            self.pump.cancel()
+        self.writer.close()
+
+
+class MessageClient:
+    """Caller side: pooled connections, streaming calls with cancellation."""
+
+    def __init__(self, connect_timeout: float = 5.0):
+        self._conns: dict[tuple[str, int], _Connection] = {}
+        self._conn_locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self.connect_timeout = connect_timeout
+
+    async def _get_conn(self, addr: tuple[str, int]) -> _Connection:
+        conn = self._conns.get(addr)
+        if conn is not None and not conn.closed:
+            return conn
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr[0], addr[1]), self.connect_timeout
+            )
+            conn = _Connection(reader, writer)
+            conn.start_pump()
+            self._conns[addr] = conn
+            return conn
+
+    async def call(
+        self,
+        addr: tuple[str, int],
+        subject: str,
+        payload: Any,
+        context: Context,
+    ) -> AsyncIterator[Any]:
+        """Issue a streaming request; yields response payloads.
+
+        Raises NoHandlerError / StreamError / TruncatedStreamError — callers
+        (PushRouter, Migration) use these to distinguish dead-worker from
+        application failure."""
+        conn = await self._get_conn(addr)
+        rid = context.id
+        queue: asyncio.Queue = asyncio.Queue()
+        conn.streams[rid] = queue
+        headers: dict[str, Any] = {"metadata": context.metadata}
+        if context.trace is not None:
+            headers["traceparent"] = context.trace.traceparent()
+            if context.trace.tracestate:
+                headers["tracestate"] = context.trace.tracestate
+        try:
+            await conn.send({"t": "req", "id": rid, "subject": subject, "payload": payload, "headers": headers})
+        except (ConnectionResetError, BrokenPipeError) as e:
+            conn.streams.pop(rid, None)
+            raise TruncatedStreamError(f"connection to {addr} lost on send") from e
+
+        async def _gen() -> AsyncIterator[Any]:
+            cancel_waiter = asyncio.get_running_loop().create_task(context.wait_cancelled())
+            finished = False
+            try:
+                while True:
+                    getter = asyncio.get_running_loop().create_task(queue.get())
+                    done, _ = await asyncio.wait(
+                        {getter, cancel_waiter}, return_when=asyncio.FIRST_COMPLETED
+                    )
+                    if cancel_waiter in done and getter not in done:
+                        getter.cancel()
+                        return
+                    msg = getter.result()
+                    if msg is None:
+                        raise TruncatedStreamError(f"stream from {addr} truncated")
+                    t = msg["t"]
+                    if t == "data":
+                        yield msg["payload"]
+                    elif t == "final":
+                        finished = True
+                        return
+                    elif t == "err":
+                        finished = True
+                        if msg.get("kind") == "no_handler":
+                            raise NoHandlerError(msg.get("error", subject))
+                        raise StreamError(msg.get("error", "remote error"))
+            finally:
+                cancel_waiter.cancel()
+                conn.streams.pop(rid, None)
+                # Abandoned before the final frame (explicit cancel OR the
+                # consumer dropped the stream early): tell the worker to stop.
+                if not finished and not conn.closed:
+                    try:
+                        await conn.send({"t": "cancel", "id": rid})
+                    except (ConnectionResetError, BrokenPipeError):
+                        pass
+
+        return _gen()
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()

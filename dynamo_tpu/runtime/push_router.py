@@ -1,0 +1,122 @@
+"""Instance-selecting router with fault detection.
+
+Reference analogue: ``PushRouter`` with RoundRobin/Random/Direct modes and
+``generate_with_fault_detection`` — a worker that answers "no responders" or
+truncates its stream before any payload is marked down and the request
+retried on another instance (reference: lib/runtime/src/pipeline/network/
+egress/push_router.rs:61-75,168-201).
+
+Once payload frames have flowed, mid-stream death is *not* retried here —
+that is the Migration operator's job (it owns accumulated-token re-dispatch;
+see dynamo_tpu/llm/migration.py).
+"""
+
+from __future__ import annotations
+
+import random
+from enum import Enum
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.client import DiscoveryClient
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.messaging import (
+    MessageClient,
+    NoHandlerError,
+    TruncatedStreamError,
+)
+
+log = get_logger("push_router")
+
+
+class RouterMode(Enum):
+    ROUND_ROBIN = "round-robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"  # handled by KvPushRouter, which wraps a DIRECT PushRouter
+
+
+class NoInstancesError(Exception):
+    pass
+
+
+class PushRouter:
+    def __init__(
+        self,
+        discovery: DiscoveryClient,
+        messaging: MessageClient,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        max_attempts: int = 3,
+    ):
+        self.discovery = discovery
+        self.messaging = messaging
+        self.mode = mode
+        self.max_attempts = max_attempts
+        self._rr_counter = 0
+
+    def _pick(self, instance_id: int | None) -> Any:
+        instances = self.discovery.available()
+        if not instances:
+            raise NoInstancesError(
+                f"no available instances for {self.discovery.namespace}/"
+                f"{self.discovery.component}/{self.discovery.endpoint}"
+            )
+        if instance_id is not None:
+            inst = self.discovery.get(instance_id)
+            if inst is None:
+                raise NoInstancesError(f"instance {instance_id} not found")
+            return inst
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(instances)
+        instances = sorted(instances, key=lambda i: i.instance_id)
+        inst = instances[self._rr_counter % len(instances)]
+        self._rr_counter += 1
+        return inst
+
+    async def generate(
+        self,
+        request: Any,
+        context: Context,
+        instance_id: int | None = None,
+    ) -> AsyncIterator[Any]:
+        """Route and stream. Yields (instance_id, payload) framing is NOT
+        exposed — payloads only; the chosen instance id is recorded in
+        ``context.metadata['worker_instance_id']``."""
+        attempts = 0
+        last_err: Exception | None = None
+        while attempts < self.max_attempts:
+            attempts += 1
+            inst = self._pick(instance_id)
+            context.metadata["worker_instance_id"] = inst.instance_id
+            try:
+                stream = await self.messaging.call(
+                    inst.address, inst.subject, request, context.child()
+                )
+            except (TruncatedStreamError, ConnectionError, OSError) as e:
+                log.warning("instance %x unreachable: %s", inst.instance_id, e)
+                self.discovery.report_instance_down(inst.instance_id)
+                last_err = e
+                if instance_id is not None:
+                    raise
+                continue
+
+            first = True
+            try:
+                async for item in stream:
+                    first = False
+                    yield item
+                return
+            except NoHandlerError as e:
+                # Worker registered but not serving (draining) — mark + retry.
+                self.discovery.report_instance_down(inst.instance_id)
+                last_err = e
+                if instance_id is not None or not first:
+                    raise
+                continue
+            except TruncatedStreamError:
+                self.discovery.report_instance_down(inst.instance_id)
+                if first and instance_id is None:
+                    last_err = TruncatedStreamError(f"instance {inst.instance_id:x} died pre-stream")
+                    continue
+                raise  # mid-stream death: Migration's responsibility
+        raise last_err or NoInstancesError("exhausted retries")

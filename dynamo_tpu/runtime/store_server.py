@@ -1,0 +1,37 @@
+"""CLI: run a standalone control-plane store server.
+
+Usage: ``python -m dynamo_tpu.runtime.store_server [--host H] [--port P]``
+
+One per cluster (analogue of the reference's etcd; SURVEY.md §1 layer 0).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.runtime.store_net import StoreServer
+
+
+async def _main(host: str, port: int) -> None:
+    server = await StoreServer(host, port).start()
+    print(f"dynamo_tpu store server: {server.url}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo_tpu control-plane store server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=3280)
+    args = parser.parse_args()
+    try:
+        asyncio.run(_main(args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Token sequences and chained block hashing.
+
+The unit of KV-cache identity is the *token block*: a fixed-size run of
+token ids whose hash chains in the parent block's hash, so equal sequence
+hashes imply equal full prefixes. This is the foundation for KV-cache reuse
+and KV-aware routing.
+
+Reference analogue: ``Tokens``/``TokenBlock`` with chained ``SequenceHash``
+(reference: lib/llm/src/tokens.rs:43-45,394-417) and the router's
+``compute_block_hash_for_seq`` xxh3 hashing
+(reference: lib/llm/src/kv_router/indexer.rs:64,123).
+
+Own design notes: hashes are xxh3-64 over little-endian u32 token ids; a
+block's *sequence hash* is xxh3-64 over (parent_seq_hash_le64 || block_local
+hash_le64), parentless blocks use the block-local hash directly. Seed is a
+fixed framework constant so router and workers agree.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterable, Sequence
+
+import xxhash
+
+# Fixed seed shared by every component that hashes token blocks.
+HASH_SEED = 0xD7A0_0001
+
+BlockHash = int
+SequenceHash = int
+
+
+def hash_tokens(tokens: Sequence[int], seed: int = HASH_SEED) -> BlockHash:
+    """Block-local hash: xxh3_64 over little-endian u32 token ids."""
+    return xxhash.xxh3_64_intdigest(struct.pack(f"<{len(tokens)}I", *tokens), seed=seed)
+
+
+def chain_hash(parent: SequenceHash | None, local: BlockHash, seed: int = HASH_SEED) -> SequenceHash:
+    """Sequence hash of a block given its parent's sequence hash."""
+    if parent is None:
+        return local
+    return xxhash.xxh3_64_intdigest(struct.pack("<QQ", parent, local), seed=seed)
+
+
+def compute_block_hashes(
+    tokens: Sequence[int], block_size: int, seed: int = HASH_SEED
+) -> list[SequenceHash]:
+    """Chained sequence hashes for every *complete* block of ``tokens``.
+
+    The router and the engine's block manager both call this, so a prefix
+    match in the router's radix tree corresponds exactly to reusable blocks
+    in a worker's cache.
+    """
+    out: list[SequenceHash] = []
+    parent: SequenceHash | None = None
+    for start in range(0, len(tokens) - block_size + 1, block_size):
+        local = hash_tokens(tokens[start : start + block_size], seed)
+        parent = chain_hash(parent, local, seed)
+        out.append(parent)
+    return out
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """An immutable, complete block of tokens with its chained identity."""
+
+    tokens: tuple[int, ...]
+    block_hash: BlockHash
+    sequence_hash: SequenceHash
+    parent_sequence_hash: SequenceHash | None
+
+    @property
+    def size(self) -> int:
+        return len(self.tokens)
+
+
+class TokenBlockSequence:
+    """Splits a growing token stream into complete blocks plus a partial tail.
+
+    Used by the engine's block manager to register blocks as they complete
+    (which emits KV "stored" events) and by tests to cross-check router
+    hashing (reference: lib/llm/src/tokens.rs TokenBlockSequence semantics).
+    """
+
+    def __init__(self, tokens: Iterable[int] = (), block_size: int = 16, seed: int = HASH_SEED):
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self.block_size = block_size
+        self.seed = seed
+        self.blocks: list[TokenBlock] = []
+        self._partial: list[int] = []
+        self.extend(tokens)
+
+    def append(self, token: int) -> TokenBlock | None:
+        """Add one token; returns the newly completed block if one closed."""
+        self._partial.append(int(token))
+        if len(self._partial) < self.block_size:
+            return None
+        return self._seal()
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        completed = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                completed.append(b)
+        return completed
+
+    def _seal(self) -> TokenBlock:
+        toks = tuple(self._partial)
+        self._partial.clear()
+        local = hash_tokens(toks, self.seed)
+        parent = self.blocks[-1].sequence_hash if self.blocks else None
+        seq = chain_hash(parent, local, self.seed)
+        block = TokenBlock(toks, local, seq, parent)
+        self.blocks.append(block)
+        return block
+
+    @property
+    def partial_tokens(self) -> tuple[int, ...]:
+        return tuple(self._partial)
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.blocks) * self.block_size + len(self._partial)
+
+    def all_tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self._partial)
+        return out
+
+    def sequence_hashes(self) -> list[SequenceHash]:
+        return [b.sequence_hash for b in self.blocks]

@@ -1,0 +1,34 @@
+"""Layered config precedence (mirrors reference config.rs:330-489 env tests)."""
+
+from dynamo_tpu.runtime.config import Config
+
+
+def test_defaults():
+    cfg = Config.from_env(env={})
+    assert cfg.store.url == "memory://"
+    assert cfg.system.enabled is False
+    assert cfg.runtime.max_inflight == 4096
+
+
+def test_env_overrides():
+    cfg = Config.from_env(
+        env={
+            "DYNTPU_STORE_URL": "tcp://10.0.0.1:3280",
+            "DYNTPU_SYSTEM_ENABLED": "true",
+            "DYNTPU_SYSTEM_PORT": "9999",
+            "DYNTPU_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT": "5.5",
+        }
+    )
+    assert cfg.store.url == "tcp://10.0.0.1:3280"
+    assert cfg.system.enabled is True
+    assert cfg.system.port == 9999
+    assert cfg.runtime.graceful_shutdown_timeout == 5.5
+
+
+def test_toml_layer_below_env(tmp_path):
+    toml = tmp_path / "cfg.toml"
+    toml.write_text("[system]\nport = 7000\nenabled = true\n[store]\nurl = 'tcp://a:1'\n")
+    cfg = Config.from_env(env={"DYNTPU_CONFIG": str(toml), "DYNTPU_SYSTEM_PORT": "7001"})
+    assert cfg.system.enabled is True
+    assert cfg.system.port == 7001  # env beats toml
+    assert cfg.store.url == "tcp://a:1"

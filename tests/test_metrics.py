@@ -1,0 +1,41 @@
+from dynamo_tpu.runtime.metrics import InflightGuard, MetricsRegistry
+
+
+def test_counter_and_labels():
+    reg = MetricsRegistry()
+    c = reg.child("ns1").child("comp1").counter("requests_total", "total requests")
+    c.inc(model="m1")
+    c.inc(2, model="m1")
+    c.inc(model="m2")
+    assert c.value(model="m1") == 3
+    text = reg.render()
+    assert 'dynamo_tpu_requests_total{dynamo_component="comp1",dynamo_namespace="ns1",model="m1"} 3' in text
+    assert "# TYPE dynamo_tpu_requests_total counter" in text
+
+
+def test_gauge_inflight_guard():
+    reg = MetricsRegistry()
+    g = reg.gauge("inflight", "in-flight requests")
+    with InflightGuard(g, model="m"):
+        assert g.value(model="m") == 1
+    assert g.value(model="m") == 0
+
+
+def test_histogram_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("latency_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render()
+    assert 'le="0.1"} 1' in text
+    assert 'le="1"} 2' in text
+    assert 'le="+Inf"} 3' in text
+    assert "latency_seconds_count 3" in text
+
+
+def test_same_name_returns_same_metric():
+    reg = MetricsRegistry()
+    a = reg.counter("x")
+    b = reg.counter("x")
+    assert a is b

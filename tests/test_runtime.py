@@ -1,0 +1,201 @@
+"""Distributed runtime integration: serve, discover, route, cancel, fail over.
+
+Mirrors the intent of the reference's lib/runtime/tests/ pipeline +
+lifecycle suites, on the in-process memory store.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import TraceContext, current_trace
+from dynamo_tpu.runtime.push_router import NoInstancesError, RouterMode
+
+
+async def make_runtime(name="testcluster"):
+    return await DistributedRuntime.create(store_url=f"memory://{name}")
+
+
+def test_serve_and_call_roundtrip():
+    async def run():
+        rt = await make_runtime()
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+
+        async def handler(payload, ctx):
+            for i in range(payload["n"]):
+                yield {"token": i}
+
+        handle = await ep.serve(handler)
+        router = await ep.router()
+        out = [item async for item in router.generate({"n": 3}, Context())]
+        assert out == [{"token": 0}, {"token": 1}, {"token": 2}]
+        await handle.close()
+        await rt.shutdown()
+
+    asyncio.run(run())
+
+
+def test_round_robin_over_two_instances():
+    async def run():
+        rt1 = await make_runtime("rr")
+        rt2 = await DistributedRuntime.create(store_url="memory://rr")
+        seen = []
+
+        def mk(tag):
+            async def handler(payload, ctx):
+                seen.append(tag)
+                yield {"worker": tag}
+
+            return handler
+
+        ep1 = rt1.namespace("ns").component("c").endpoint("e")
+        ep2 = rt2.namespace("ns").component("c").endpoint("e")
+        await ep1.serve(mk("a"))
+        await ep2.serve(mk("b"))
+
+        router = await ep1.router(RouterMode.ROUND_ROBIN)
+        await router.discovery.wait_for_instances(2, timeout=5)
+        for _ in range(4):
+            [_ async for _ in router.generate({}, Context())]
+        assert sorted(seen) == ["a", "a", "b", "b"]
+        await rt1.shutdown()
+        await rt2.shutdown()
+
+    asyncio.run(run())
+
+
+def test_failover_marks_instance_down():
+    async def run():
+        rt1 = await make_runtime("fo")
+        rt2 = await DistributedRuntime.create(store_url="memory://fo")
+
+        async def good(payload, ctx):
+            yield {"ok": True}
+
+        ep1 = rt1.namespace("ns").component("c").endpoint("e")
+        ep2 = rt2.namespace("ns").component("c").endpoint("e")
+        h1 = await ep1.serve(good)
+        await ep2.serve(good)
+
+        router = await ep2.router(RouterMode.ROUND_ROBIN)
+        await router.discovery.wait_for_instances(2, timeout=5)
+
+        # Kill rt1's server abruptly (no deregistration) — simulates crash.
+        await rt1._server.close()
+        results = []
+        for _ in range(4):
+            out = [item async for item in router.generate({}, Context())]
+            results.extend(out)
+        assert all(r == {"ok": True} for r in results)
+        # rt1's instance should now be marked down locally.
+        assert len(router.discovery.available()) == 1
+        await rt2.shutdown()
+        await rt1.shutdown()
+
+    asyncio.run(run())
+
+
+def test_deregistration_via_handle_close():
+    async def run():
+        rt = await make_runtime("dereg")
+
+        async def handler(payload, ctx):
+            yield 1
+
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        handle = await ep.serve(handler)
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=5)
+        await handle.close()
+        await asyncio.sleep(0.1)
+        assert client.available() == []
+        router = await ep.router()
+        with pytest.raises(NoInstancesError):
+            [_ async for _ in router.generate({}, Context())]
+        await rt.shutdown()
+
+    asyncio.run(run())
+
+
+def test_cancellation_stops_worker_stream():
+    async def run():
+        rt = await make_runtime("cancel")
+        progressed = {"n": 0}
+
+        async def slow(payload, ctx):
+            for i in range(1000):
+                if ctx.cancelled:
+                    return
+                progressed["n"] = i
+                yield {"i": i}
+                await asyncio.sleep(0.01)
+
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        await ep.serve(slow)
+        router = await ep.router()
+        ctx = Context()
+        got = []
+        async for item in router.generate({}, ctx):
+            got.append(item)
+            if len(got) == 3:
+                ctx.cancel()
+                break
+        await asyncio.sleep(0.3)
+        n_after = progressed["n"]
+        await asyncio.sleep(0.2)
+        assert progressed["n"] <= n_after + 1  # worker stopped advancing
+        await rt.shutdown()
+
+    asyncio.run(run())
+
+
+def test_traceparent_propagates_to_handler():
+    async def run():
+        rt = await make_runtime("trace")
+        seen = {}
+
+        async def handler(payload, ctx):
+            seen["trace"] = ctx.trace
+            seen["logging_trace"] = current_trace()
+            yield {}
+
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        await ep.serve(handler)
+        router = await ep.router()
+        root = TraceContext.parse("00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01")
+        [_ async for _ in router.generate({}, Context(trace=root))]
+        assert seen["trace"].trace_id == "0af7651916cd43dd8448eb211c80319c"
+        # span id was re-minted for the hop but trace id survived
+        assert seen["logging_trace"].trace_id == seen["trace"].trace_id
+        await rt.shutdown()
+
+    asyncio.run(run())
+
+
+def test_direct_mode_targets_specific_instance():
+    async def run():
+        rt = await make_runtime("direct")
+        tags = {}
+
+        def mk(tag):
+            async def handler(payload, ctx):
+                yield {"worker": tag}
+
+            return handler
+
+        rt2 = await DistributedRuntime.create(store_url="memory://direct")
+        ep1 = rt.namespace("ns").component("c").endpoint("e")
+        ep2 = rt2.namespace("ns").component("c").endpoint("e")
+        h1 = await ep1.serve(mk("a"))
+        h2 = await ep2.serve(mk("b"))
+        router = await ep1.router(RouterMode.DIRECT)
+        await router.discovery.wait_for_instances(2, timeout=5)
+        target = h2.instance.instance_id
+        out = [i async for i in router.generate({}, Context(), instance_id=target)]
+        assert out == [{"worker": "b"}]
+        await rt.shutdown()
+        await rt2.shutdown()
+
+    asyncio.run(run())

@@ -1,0 +1,151 @@
+"""Control-plane store: CRUD, CAS modes, leases, watches — memory and TCP."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.store import (
+    EventKind,
+    KeyExistsError,
+    MemoryStore,
+    PutMode,
+    connect_store,
+)
+from dynamo_tpu.runtime.store_net import StoreServer, TcpStoreClient
+
+
+def test_put_get_delete():
+    async def run():
+        s = MemoryStore()
+        await s.put("a/b", b"1")
+        e = await s.get("a/b")
+        assert e.value == b"1"
+        await s.put("a/b", b"2")
+        e2 = await s.get("a/b")
+        assert e2.value == b"2"
+        assert e2.create_revision == e.create_revision
+        assert e2.mod_revision > e.mod_revision
+        assert await s.delete("a/b") is True
+        assert await s.get("a/b") is None
+        await s.close()
+
+    asyncio.run(run())
+
+
+def test_create_modes():
+    async def run():
+        s = MemoryStore()
+        await s.put("k", b"v", mode=PutMode.CREATE)
+        with pytest.raises(KeyExistsError):
+            await s.put("k", b"other", mode=PutMode.CREATE)
+        # create_or_validate: same value ok, different value raises
+        await s.put("k", b"v", mode=PutMode.CREATE_OR_VALIDATE)
+        with pytest.raises(KeyExistsError):
+            await s.put("k", b"other", mode=PutMode.CREATE_OR_VALIDATE)
+        await s.close()
+
+    asyncio.run(run())
+
+
+def test_prefix_ops():
+    async def run():
+        s = MemoryStore()
+        await s.put("p/1", b"a")
+        await s.put("p/2", b"b")
+        await s.put("q/1", b"c")
+        got = await s.get_prefix("p/")
+        assert [e.key for e in got] == ["p/1", "p/2"]
+        assert await s.delete_prefix("p/") == 2
+        assert await s.get_prefix("p/") == []
+        await s.close()
+
+    asyncio.run(run())
+
+
+def test_lease_expiry_deletes_keys_and_notifies_watch():
+    async def run():
+        s = MemoryStore()
+        lease = await s.grant_lease(ttl=0.4)
+        await s.put("inst/x", b"v", lease_id=lease)
+        watch = await s.watch_prefix("inst/")
+        assert [e.key for e in watch.snapshot] == ["inst/x"]
+        # no keepalive ⇒ expires
+        ev = await asyncio.wait_for(watch.__anext__(), timeout=3.0)
+        assert ev.kind == EventKind.DELETE
+        assert ev.key == "inst/x"
+        await watch.cancel()
+        await s.close()
+
+    asyncio.run(run())
+
+
+def test_keepalive_prevents_expiry():
+    async def run():
+        s = MemoryStore()
+        lease = await s.grant_lease(ttl=0.6)
+        await s.put("inst/y", b"v", lease_id=lease)
+        for _ in range(4):
+            await asyncio.sleep(0.3)
+            await s.keep_alive(lease)
+        assert (await s.get("inst/y")) is not None
+        await s.revoke_lease(lease)
+        assert (await s.get("inst/y")) is None
+        await s.close()
+
+    asyncio.run(run())
+
+
+def test_watch_sees_puts_and_deletes():
+    async def run():
+        s = MemoryStore()
+        watch = await s.watch_prefix("w/")
+        await s.put("w/1", b"a")
+        await s.put("other", b"zzz")
+        await s.delete("w/1")
+        ev1 = await asyncio.wait_for(watch.__anext__(), 1)
+        ev2 = await asyncio.wait_for(watch.__anext__(), 1)
+        assert (ev1.kind, ev1.key, ev1.value) == (EventKind.PUT, "w/1", b"a")
+        assert (ev2.kind, ev2.key) == (EventKind.DELETE, "w/1")
+        await watch.cancel()
+        await s.close()
+
+    asyncio.run(run())
+
+
+def test_tcp_store_roundtrip():
+    async def run():
+        server = await StoreServer("127.0.0.1", 0).start()
+        c = TcpStoreClient("127.0.0.1", server.port)
+        await c.connect()
+        await c.put("a", b"1")
+        assert (await c.get("a")).value == b"1"
+        lease = await c.grant_lease(5.0)
+        await c.put("leased", b"x", lease_id=lease)
+        watch = await c.watch_prefix("a")
+        await c.put("ab", b"2")
+        ev = await asyncio.wait_for(watch.__anext__(), 2)
+        assert (ev.kind, ev.key, ev.value) == (EventKind.PUT, "ab", b"2")
+        await watch.cancel()
+        with pytest.raises(KeyExistsError):
+            await c.put("a", b"zzz", mode=PutMode.CREATE)
+        await c.close()
+        # client disconnect revokes its leases server-side
+        await asyncio.sleep(0.2)
+        assert (await server.store.get("leased")) is None
+        await server.close()
+
+    asyncio.run(run())
+
+
+def test_connect_store_memory_shared():
+    async def run():
+        a = await connect_store("memory://t1")
+        b = await connect_store("memory://t1")
+        other = await connect_store("memory://t2")
+        assert a is b
+        assert a is not other
+        await a.put("k", b"v")
+        assert (await b.get("k")).value == b"v"
+        assert (await other.get("k")) is None
+
+    asyncio.run(run())

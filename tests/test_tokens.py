@@ -1,0 +1,58 @@
+"""Token block hashing semantics (mirrors reference tokens.rs test intent)."""
+
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    chain_hash,
+    compute_block_hashes,
+    hash_tokens,
+)
+
+
+def test_hash_deterministic():
+    assert hash_tokens([1, 2, 3]) == hash_tokens([1, 2, 3])
+    assert hash_tokens([1, 2, 3]) != hash_tokens([3, 2, 1])
+
+
+def test_chain_depends_on_parent():
+    local = hash_tokens([7, 8])
+    assert chain_hash(None, local) == local
+    assert chain_hash(123, local) != chain_hash(456, local)
+
+
+def test_compute_block_hashes_prefix_property():
+    toks = list(range(64))
+    h_full = compute_block_hashes(toks, 16)
+    h_prefix = compute_block_hashes(toks[:32], 16)
+    assert len(h_full) == 4
+    assert h_full[:2] == h_prefix  # shared prefix ⇒ shared hashes
+    # Divergence in the first block changes every downstream hash.
+    toks2 = [999] + toks[1:]
+    h_div = compute_block_hashes(toks2, 16)
+    assert all(a != b for a, b in zip(h_full, h_div))
+
+
+def test_compute_block_hashes_ignores_partial_tail():
+    toks = list(range(40))
+    assert compute_block_hashes(toks, 16) == compute_block_hashes(toks[:32], 16)
+
+
+def test_token_block_sequence_matches_batch_hashing():
+    toks = list(range(50))
+    seq = TokenBlockSequence(block_size=16)
+    completed = seq.extend(toks)
+    assert len(completed) == 3
+    assert seq.partial_tokens == tuple(range(48, 50))
+    assert seq.sequence_hashes() == compute_block_hashes(toks, 16)
+    assert seq.all_tokens() == toks
+    assert seq.total_tokens == 50
+
+
+def test_append_returns_block_only_on_boundary():
+    seq = TokenBlockSequence(block_size=4)
+    assert seq.append(1) is None
+    assert seq.append(2) is None
+    assert seq.append(3) is None
+    block = seq.append(4)
+    assert block is not None
+    assert block.tokens == (1, 2, 3, 4)
+    assert block.parent_sequence_hash is None
