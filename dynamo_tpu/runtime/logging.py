@@ -74,6 +74,10 @@ def set_current_trace(ctx: TraceContext | None) -> contextvars.Token:
     return _current_trace.set(ctx)
 
 
+def reset_current_trace(token: contextvars.Token) -> None:
+    _current_trace.reset(token)
+
+
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {

@@ -21,11 +21,17 @@ Wire frames (msgpack maps):
 from __future__ import annotations
 
 import asyncio
+import uuid
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from dynamo_tpu.runtime import framing
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
-from dynamo_tpu.runtime.logging import TraceContext, get_logger, set_current_trace
+from dynamo_tpu.runtime.logging import (
+    TraceContext,
+    get_logger,
+    reset_current_trace,
+    set_current_trace,
+)
 
 log = get_logger("messaging")
 
@@ -141,7 +147,11 @@ class EndpointServer:
         tp = headers.get("traceparent")
         if tp:
             trace = TraceContext.parse(tp, headers.get("tracestate"))
-        return Context(request_id=rid, trace=trace, metadata=dict(headers.get("metadata") or {}))
+        return Context(
+            request_id=headers.get("context_id") or rid,
+            trace=trace,
+            metadata=dict(headers.get("metadata") or {}),
+        )
 
     async def _run_request(self, msg: dict, ctx: Context, send) -> None:
         rid, subject = msg["id"], msg["subject"]
@@ -169,7 +179,7 @@ class EndpointServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
         finally:
-            set_current_trace(token.old_value if hasattr(token, "old_value") else None)
+            reset_current_trace(token)
             self._inflight[subject] -= 1
             if self._inflight[subject] == 0:
                 self._idle[subject].set()
@@ -248,10 +258,14 @@ class MessageClient:
         (PushRouter, Migration) use these to distinguish dead-worker from
         application failure."""
         conn = await self._get_conn(addr)
-        rid = context.id
+        # Fresh wire id per call: two concurrent calls sharing a context lineage
+        # (e.g. disagg prefill+decode fan-out) must not collide in conn.streams
+        # or the server-side per-connection maps. context.id travels in headers
+        # for tracing/correlation.
+        rid = uuid.uuid4().hex
         queue: asyncio.Queue = asyncio.Queue()
         conn.streams[rid] = queue
-        headers: dict[str, Any] = {"metadata": context.metadata}
+        headers: dict[str, Any] = {"metadata": context.metadata, "context_id": context.id}
         if context.trace is not None:
             headers["traceparent"] = context.trace.traceparent()
             if context.trace.tracestate:

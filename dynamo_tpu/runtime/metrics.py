@@ -47,8 +47,20 @@ class Metric:
         self.const_labels = dict(const_labels)
         self._lock = threading.Lock()
 
-    def render(self) -> list[str]:  # pragma: no cover - abstract
+    def _header(self, with_header: bool) -> list[str]:
+        if not with_header:
+            return []
+        return [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+
+    def render(self, with_header: bool = True) -> list[str]:  # pragma: no cover - abstract
         raise NotImplementedError
+
+    def _render_values(self, values: dict, with_header: bool) -> list[str]:
+        lines = self._header(with_header)
+        for key, v in values.items() or [((), 0.0)]:
+            labels = {**self.const_labels, **dict(key)}
+            lines.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(v)}")
+        return lines
 
 
 class Counter(Metric):
@@ -68,14 +80,10 @@ class Counter(Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+    def render(self, with_header: bool = True) -> list[str]:
         with self._lock:
-            items = list(self._values.items()) or [((), 0.0)]
-        for key, v in items:
-            labels = {**self.const_labels, **dict(key)}
-            lines.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(v)}")
-        return lines
+            values = dict(self._values)
+        return self._render_values(values, with_header)
 
 
 class Gauge(Metric):
@@ -100,14 +108,10 @@ class Gauge(Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+    def render(self, with_header: bool = True) -> list[str]:
         with self._lock:
-            items = list(self._values.items()) or [((), 0.0)]
-        for key, v in items:
-            labels = {**self.const_labels, **dict(key)}
-            lines.append(f"{self.name}{_fmt_labels(labels)} {_fmt_value(v)}")
-        return lines
+            values = dict(self._values)
+        return self._render_values(values, with_header)
 
 
 class InflightGuard:
@@ -156,8 +160,8 @@ class Histogram(Metric):
             st.total += value
             st.n += 1
 
-    def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+    def render(self, with_header: bool = True) -> list[str]:
+        lines = self._header(with_header)
         with self._lock:
             items = list(self._states.items())
         for key, st in items:
@@ -187,7 +191,10 @@ class MetricsRegistry:
         self._root = _root or self
         self._depth = depth
         if _root is None:
-            self._metrics: dict[str, Metric] = {}
+            # Keyed by (name, const-label set): the same metric name used in two
+            # scopes (e.g. two components) must be two series, not one.
+            self._metrics: dict[tuple[str, frozenset], Metric] = {}
+            self._kinds: dict[str, type] = {}
             self._lock = threading.Lock()
 
     def child(self, name: str) -> "MetricsRegistry":
@@ -198,13 +205,22 @@ class MetricsRegistry:
 
     def _register(self, cls, name: str, help_: str, **kw) -> Metric:
         full = f"{PREFIX}_{name}"
+        key = (full, frozenset(self.const_labels.items()))
         root = self._root
         with root._lock:
-            existing = root._metrics.get(full)
+            registered = root._kinds.get(full)
+            if registered is not None and registered is not cls:
+                # Same name must be one type everywhere: Prometheus emits one
+                # TYPE header per name across all label scopes.
+                raise TypeError(
+                    f"metric {full} already registered as {registered.kind}"
+                )
+            root._kinds[full] = cls
+            existing = root._metrics.get(key)
             if existing is not None:
                 return existing
             metric = cls(full, help_, self.const_labels, **kw)
-            root._metrics[full] = metric
+            root._metrics[key] = metric
             return metric
 
     def counter(self, name: str, help_: str = "") -> Counter:
@@ -221,6 +237,8 @@ class MetricsRegistry:
         with root._lock:
             metrics = list(root._metrics.values())
         lines: list[str] = []
+        seen_names: set[str] = set()
         for m in sorted(metrics, key=lambda m: m.name):
-            lines.extend(m.render())
+            lines.extend(m.render(with_header=m.name not in seen_names))
+            seen_names.add(m.name)
         return "\n".join(lines) + "\n"

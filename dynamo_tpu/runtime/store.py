@@ -170,13 +170,20 @@ class MemoryStore(KeyValueStore):
     async def _drop_lease(self, lease: _Lease) -> None:
         self._leases.pop(lease.id, None)
         for key in sorted(lease.keys):
-            entry = self._data.pop(key, None)
-            if entry is not None:
+            entry = self._data.get(key)
+            # Only delete keys still owned by this lease: a later put() may have
+            # re-attached the key to a different (live) lease, like etcd.
+            if entry is not None and entry.lease_id == lease.id:
+                del self._data[key]
                 self._notify(EventKind.DELETE, key, None)
 
-    def _notify(self, kind: EventKind, key: str, value: bytes | None) -> None:
-        self._revision += 1
-        ev = WatchEvent(kind, key, value, self._revision)
+    def _notify(
+        self, kind: EventKind, key: str, value: bytes | None, revision: int | None = None
+    ) -> None:
+        if revision is None:
+            self._revision += 1
+            revision = self._revision
+        ev = WatchEvent(kind, key, value, revision)
         for prefix, queue in self._watchers.values():
             if key.startswith(prefix):
                 queue.put_nowait(ev)
@@ -188,14 +195,23 @@ class MemoryStore(KeyValueStore):
             if mode == PutMode.CREATE:
                 raise KeyExistsError(key)
             if mode == PutMode.CREATE_OR_VALIDATE:
-                if existing.value == value:
+                if existing.value != value:
+                    raise KeyExistsError(f"{key}: exists with different value")
+                if existing.lease_id == lease_id:
                     return existing.mod_revision
-                raise KeyExistsError(f"{key}: exists with different value")
+                # Equal value but new ownership: fall through so the key is
+                # re-attached to the caller's lease (etcd semantics) — a
+                # restarted worker must not stay tied to its dead lease.
         if lease_id is not None:
             lease = self._leases.get(lease_id)
             if lease is None:
                 raise LeaseNotFoundError(str(lease_id))
             lease.keys.add(key)
+        if existing is not None and existing.lease_id not in (None, lease_id):
+            # Ownership moved: detach from the previous lease (etcd semantics).
+            old = self._leases.get(existing.lease_id)
+            if old is not None:
+                old.keys.discard(key)
         self._revision += 1
         entry = KvEntry(
             key=key,
@@ -205,9 +221,7 @@ class MemoryStore(KeyValueStore):
             mod_revision=self._revision,
         )
         self._data[key] = entry
-        # _notify bumps revision again for the event; keep entry and event aligned.
-        self._revision -= 1
-        self._notify(EventKind.PUT, key, value)
+        self._notify(EventKind.PUT, key, value, revision=entry.mod_revision)
         return entry.mod_revision
 
     async def get(self, key):

@@ -105,15 +105,23 @@ class StoreServer:
             except (ConnectionResetError, BrokenPipeError):
                 pass
 
+        # The event loop holds tasks only weakly: retain dispatch tasks so they
+        # can't be garbage-collected mid-execution, and cancel any still pending
+        # on disconnect so they don't write to a closed writer.
+        dispatch_tasks: set[asyncio.Task] = set()
         try:
             while True:
                 msg = await framing.read_frame(reader)
                 if msg is None:
                     break
-                asyncio.get_running_loop().create_task(
+                task = asyncio.get_running_loop().create_task(
                     self._dispatch(msg, send, conn_leases, conn_watches, pump_watch)
                 )
+                dispatch_tasks.add(task)
+                task.add_done_callback(dispatch_tasks.discard)
         finally:
+            for task in list(dispatch_tasks):
+                task.cancel()
             for watch, task in conn_watches.values():
                 task.cancel()
                 await watch.cancel()

@@ -39,3 +39,18 @@ def test_same_name_returns_same_metric():
     a = reg.counter("x")
     b = reg.counter("x")
     assert a is b
+
+
+def test_same_name_in_two_scopes_is_two_series():
+    # ADVICE r1: metrics must be keyed by (name, const_labels), not name alone.
+    reg = MetricsRegistry()
+    a = reg.child("ns1").child("compA").counter("reqs")
+    b = reg.child("ns2").child("compB").counter("reqs")
+    assert a is not b
+    a.inc()
+    b.inc(3)
+    out = reg.render()
+    assert 'dynamo_component="compA"' in out
+    assert 'dynamo_component="compB"' in out
+    # but only one HELP/TYPE header per metric name
+    assert out.count("# TYPE dynamo_tpu_reqs counter") == 1

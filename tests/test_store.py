@@ -149,3 +149,35 @@ def test_connect_store_memory_shared():
         assert (await other.get("k")) is None
 
     asyncio.run(run())
+
+
+def test_put_detaches_key_from_previous_lease():
+    # ADVICE r1: key reattached to a new lease must survive the old lease's death.
+    async def run():
+        now = [0.0]
+        store = MemoryStore(clock=lambda: now[0])
+        l1 = await store.grant_lease(1.0)
+        l2 = await store.grant_lease(100.0)
+        await store.put("k", b"v1", lease_id=l1)
+        await store.put("k", b"v2", lease_id=l2)
+        now[0] = 5.0  # l1 expired, l2 alive
+        await store._expire_leases()
+        entry = await store.get("k")
+        assert entry is not None and entry.value == b"v2"
+
+    asyncio.run(run())
+
+
+def test_drop_lease_skips_keys_owned_elsewhere():
+    async def run():
+        store = MemoryStore()
+        l1 = await store.grant_lease(100.0)
+        l2 = await store.grant_lease(100.0)
+        await store.put("k", b"v1", lease_id=l1)
+        await store.put("k", b"v2", lease_id=l2)
+        await store.revoke_lease(l1)
+        assert (await store.get("k")).value == b"v2"
+        await store.revoke_lease(l2)
+        assert await store.get("k") is None
+
+    asyncio.run(run())
