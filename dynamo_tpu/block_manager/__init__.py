@@ -1,0 +1,19 @@
+"""Multi-tier paged-KV block management.
+
+Reference analogue: lib/llm/src/block_manager.rs:68-173 (KVBM: G1 device /
+G2 pinned host / G3 disk / G4 remote tiers with sequence-hash reuse and an
+offload manager). Here the tiers map to TPU memory:
+
+- G1 = HBM: the engine's paged cache arrays; this package does the
+  *bookkeeping* (allocation, ref-counts, prefix reuse, eviction) while the
+  bytes live in the engine's jax arrays.
+- G2 = host RAM: numpy mirrors filled by device→host DMA (offload.py).
+- G3 = local disk (later).
+
+The pool emits KV cache events on block registration/eviction — the same
+events that feed the KV-aware router's global index.
+"""
+
+from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
+
+__all__ = ["BlockPool", "NoFreeBlocksError"]

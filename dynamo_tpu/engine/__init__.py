@@ -1,0 +1,20 @@
+"""TPU-native LLM inference engine.
+
+This is the subsystem the reference *delegates* to vLLM/SGLang/TRT-LLM
+(reference: components/backends/vllm/src/dynamo/vllm/main.py:90); here it
+is built in-repo, TPU-first:
+
+- pure-functional Llama-family forward in JAX (jnp + lax.scan over
+  layers), bf16 on the MXU, static shapes via bucketing;
+- paged KV cache as device arrays, written/read with vectorized
+  scatter/gather (Pallas kernels are a drop-in upgrade path);
+- a continuous-batching scheduler (host-side, outside jit) driving jitted
+  prefill/decode steps with donated cache buffers;
+- prefix caching through the block manager's sequence-hash reuse, which
+  also emits the KV events that feed KV-aware routing.
+"""
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+
+__all__ = ["EngineArgs", "ModelConfig", "TpuEngine"]

@@ -1,0 +1,164 @@
+"""Engine + model configuration.
+
+Reference analogue: engine args passthrough (components/backends/vllm/src/
+dynamo/vllm/args.py) — but here the engine is ours, so the config is too.
+All shapes that reach jit are derived here and static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-family architecture hyperparameters."""
+
+    name: str = "test-tiny"
+    vocab_size: int = 512
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int = 32
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position: int = 8192
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def param_count(self) -> int:
+        d, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = (
+            d * self.q_size + 2 * d * self.kv_size + self.q_size * d  # attn
+            + 3 * d * i                                               # mlp
+            + 2 * d                                                   # norms
+        )
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + self.num_layers * per_layer + d + head
+
+    @staticmethod
+    def preset(name: str) -> "ModelConfig":
+        presets = {
+            # CPU-testable toy model
+            "test-tiny": ModelConfig(),
+            # ~1.2B params — fits v5e-lite HBM in bf16 with headroom for KV
+            "llama-1b": ModelConfig(
+                name="llama-1b", vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_layers=22, num_heads=32,
+                num_kv_heads=4, head_dim=64, rope_theta=500000.0,
+                max_position=131072, tie_embeddings=True,
+            ),
+            # Llama-3.2-3B-class
+            "llama-3b": ModelConfig(
+                name="llama-3b", vocab_size=128256, hidden_size=3072,
+                intermediate_size=8192, num_layers=28, num_heads=24,
+                num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+                max_position=131072, tie_embeddings=True,
+            ),
+            # Llama-3.1-8B-class (multi-chip / bf16-tight on one v5e)
+            "llama-8b": ModelConfig(
+                name="llama-8b", vocab_size=128256, hidden_size=4096,
+                intermediate_size=14336, num_layers=32, num_heads=32,
+                num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+                max_position=131072, tie_embeddings=False,
+            ),
+            # Llama-3-70B-class (BASELINE.md north-star target, multi-host)
+            "llama-70b": ModelConfig(
+                name="llama-70b", vocab_size=128256, hidden_size=8192,
+                intermediate_size=28672, num_layers=80, num_heads=64,
+                num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+                max_position=131072, tie_embeddings=False,
+            ),
+        }
+        if name not in presets:
+            raise ValueError(f"unknown model preset {name!r}; have {sorted(presets)}")
+        return presets[name]
+
+
+def _pow2_buckets(lo: int, hi: int) -> tuple[int, ...]:
+    out = []
+    b = lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(dict.fromkeys(out))
+
+
+@dataclass
+class EngineArgs:
+    """Runtime shape/capacity knobs. Every jitted shape derives from here."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    block_size: int = 16                 # KV page size (tokens)
+    num_kv_blocks: int = 256             # G1 (HBM) pool size
+    max_num_seqs: int = 8                # max concurrent sequences in decode
+    max_model_len: int = 2048            # max prompt+gen tokens per sequence
+    max_prefill_tokens: int = 2048       # longest single prefill chunk
+    # bf16 for weights/activations; fp32 sampling.
+    dtype: str = "bfloat16"
+    # TP mesh axis size (1 = single chip). Sharding rules in parallel/.
+    tp: int = 1
+    enforce_eager: bool = False          # skip jit (debug)
+    prefix_caching: bool = True
+
+    def __post_init__(self):
+        if self.max_model_len % self.block_size:
+            self.max_model_len = ((self.max_model_len // self.block_size) + 1) * self.block_size
+        if self.max_prefill_tokens % self.block_size:
+            # prefill chunks must be block-aligned (model.py scatter contract)
+            self.max_prefill_tokens = (
+                (self.max_prefill_tokens // self.block_size) + 1
+            ) * self.block_size
+
+    @property
+    def blocks_per_seq(self) -> int:
+        return self.max_model_len // self.block_size
+
+    @property
+    def prefill_buckets(self) -> tuple[int, ...]:
+        lo = min(self.block_size * 2, self.max_prefill_tokens)
+        return _pow2_buckets(lo, self.max_prefill_tokens)
+
+    @property
+    def decode_buckets(self) -> tuple[int, ...]:
+        return _pow2_buckets(1, self.max_num_seqs)
+
+    def bucket_prefill(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prefill of {n} tokens exceeds max_prefill_tokens={self.max_prefill_tokens}")
+
+    def bucket_decode(self, n: int) -> int:
+        for b in self.decode_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"decode batch {n} exceeds max_num_seqs={self.max_num_seqs}")
+
+    def kv_bytes_per_block(self) -> int:
+        m = self.model
+        itemsize = 2 if self.dtype == "bfloat16" else 4
+        return 2 * m.num_layers * self.block_size * m.num_kv_heads * m.head_dim * itemsize
+
+    def replace(self, **kw) -> "EngineArgs":
+        return dataclasses.replace(self, **kw)
+
+    @staticmethod
+    def auto_kv_blocks(hbm_bytes_free: int, args: "EngineArgs", utilization: float = 0.9) -> int:
+        """vLLM-style: size the G1 pool from free HBM after weights."""
+        per_block = args.kv_bytes_per_block()
+        n = int(hbm_bytes_free * utilization) // per_block
+        return max(n, args.blocks_per_seq * 2)

@@ -1,0 +1,512 @@
+"""TpuEngine: continuous-batching inference engine over the jitted model.
+
+Replaces what the reference delegates to vLLM's ``AsyncLLM``
+(reference: components/backends/vllm/src/dynamo/vllm/main.py:90,
+handlers.py:113): admission, paged-KV allocation with prefix caching,
+prefill (chunked, prefix-skipping), batched decode, on-device sampling,
+per-request streaming, cancellation, preemption-by-recompute, KV events
+and load metrics.
+
+Threading model: JAX dispatch is blocking, so the scheduler loop runs in a
+dedicated thread; asyncio callers submit requests through a lock-guarded
+queue and receive ``LLMEngineOutput`` dicts on per-request asyncio queues
+via ``loop.call_soon_threadsafe``. One host↔device sync per decode step
+(the sampled token ids), which is the standard cost of host-driven
+continuous batching; everything else stays on device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import random
+import threading
+from typing import Any, AsyncIterator
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
+from dynamo_tpu.engine import model as M
+from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.engine.sampler import needs_full, sample_full, sample_simple
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
+
+log = get_logger("engine")
+
+_SENTINEL_DONE = object()
+
+
+class _Seq:
+    __slots__ = (
+        "request_id", "tokens", "prompt_len", "sampling", "stop", "eos_ids",
+        "block_ids", "block_seq", "registered_blocks", "queue", "emitted",
+        "cancelled", "preempted", "prefix_hit_blocks", "sample_seed",
+        "kv_written",
+    )
+
+    def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
+        self.request_id = request_id
+        self.tokens: list[int] = list(req.token_ids)
+        self.prompt_len = len(req.token_ids)
+        self.sampling = req.sampling
+        self.stop = req.stop
+        self.eos_ids = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
+        self.block_ids: list[int] = []
+        self.block_seq: TokenBlockSequence | None = None
+        self.registered_blocks = 0
+        self.queue = queue
+        self.emitted = 0
+        self.cancelled = False
+        self.preempted = False
+        self.prefix_hit_blocks = 0
+        # Seeded requests are reproducible; others get a per-request seed.
+        self.sample_seed = (
+            req.sampling.seed if req.sampling.seed is not None else random.getrandbits(31)
+        ) & 0x7FFFFFFF
+        # Number of positions whose KV is actually in the cache. Blocks may
+        # only be registered for prefix reuse once fully *written* — a
+        # just-sampled token's KV lands on the NEXT step (it is that step's
+        # input), so sealing a block lags writing it.
+        self.kv_written = 0
+
+    @property
+    def next_write_pos(self) -> int:
+        return len(self.tokens) - 1
+
+
+class TpuEngine:
+    def __init__(
+        self,
+        args: EngineArgs,
+        params: Any | None = None,
+        seed: int = 0,
+        event_sink=None,
+        sharding=None,  # dynamo_tpu.parallel.ModelSharding | None
+    ):
+        self.args = args
+        self.cfg = args.model
+        self._seed = seed
+        self._sharding = sharding
+        self._params = params
+        self._external_events = event_sink
+        self.pool = BlockPool(
+            args.num_kv_blocks,
+            args.block_size,
+            event_sink=self._on_pool_event,
+            enable_prefix_caching=args.prefix_caching,
+        )
+        self._cache: M.KVCache | None = None
+
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._mutex = threading.Lock()
+        self._wakeup = threading.Condition(self._mutex)
+        self._submissions: collections.deque[_Seq] = collections.deque()
+        self._waiting: collections.deque[_Seq] = collections.deque()
+        self._running: list[_Seq] = []
+        self._stopping = False
+        # Cumulative counters for metrics/bench.
+        self.total_generated = 0
+        self.total_prefilled = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> "TpuEngine":
+        self._loop = asyncio.get_running_loop()
+        await asyncio.to_thread(self._init_device_state)
+        self._thread = threading.Thread(target=self._run, name="tpu-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def start_sync(self) -> "TpuEngine":
+        """Synchronous start for non-asyncio drivers (bench.py)."""
+        self._init_device_state()
+        return self
+
+    def _init_device_state(self) -> None:
+        if self._params is None:
+            key = jax.random.PRNGKey(self._seed)
+            self._params = M.init_params(self.cfg, key, jnp.dtype(self.args.dtype))
+        self._cache = M.init_kv_cache(
+            self.cfg, self.args.num_kv_blocks, self.args.block_size, jnp.dtype(self.args.dtype)
+        )
+        if self._sharding is not None:
+            self._params = self._sharding.shard_params(self._params)
+            self._cache = M.KVCache(*self._sharding.shard_cache(self._cache))
+
+    async def stop(self) -> None:
+        with self._wakeup:
+            self._stopping = True
+            self._wakeup.notify()
+        if self._thread is not None:
+            await asyncio.to_thread(self._thread.join, 10.0)
+
+    # -- events / metrics -------------------------------------------------
+
+    def _on_pool_event(self, event: KvCacheEvent) -> None:
+        if self._external_events is not None:
+            self._external_events(event)
+
+    def metrics(self) -> ForwardPassMetrics:
+        with self._mutex:
+            running, waiting = len(self._running), len(self._waiting) + len(self._submissions)
+        return ForwardPassMetrics(
+            worker=WorkerStats(
+                request_active_slots=running,
+                request_total_slots=self.args.max_num_seqs,
+                num_requests_waiting=waiting,
+            ),
+            kv=KvStats(
+                kv_active_blocks=self.pool.num_active,
+                kv_total_blocks=self.pool.num_blocks - 1,
+                gpu_cache_usage_perc=self.pool.usage,
+                gpu_prefix_cache_hit_rate=self.pool.hit_rate,
+            ),
+        )
+
+    # -- async API --------------------------------------------------------
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        """AsyncEngine shape: PreprocessedRequest (or its dict) in →
+        LLMEngineOutput dicts out (token deltas; no text — Backend's job)."""
+        req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_dict(request)
+        # Validate wire input here (caller's coroutine) so malformed requests
+        # error this stream instead of reaching the shared scheduler thread.
+        if not req.token_ids:
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, error="empty prompt"
+            ).to_dict()
+            return
+        vocab = self.cfg.vocab_size
+        if any(not (0 <= int(t) < vocab) for t in req.token_ids):
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR,
+                error=f"token id out of range [0, {vocab})",
+            ).to_dict()
+            return
+        queue: asyncio.Queue = asyncio.Queue()
+        seq = _Seq(context.id, req, queue)
+        with self._wakeup:
+            if self._stopping:
+                raise RuntimeError("engine is stopping")
+            self._submissions.append(seq)
+            self._wakeup.notify()
+
+        async def watch_cancel():
+            await context.wait_cancelled()
+            with self._wakeup:
+                seq.cancelled = True
+                self._wakeup.notify()
+
+        watcher = asyncio.get_running_loop().create_task(watch_cancel())
+        try:
+            while True:
+                item = await queue.get()
+                if item is _SENTINEL_DONE:
+                    return
+                yield item
+                if isinstance(item, dict) and item.get("finish_reason"):
+                    return
+        finally:
+            watcher.cancel()
+            with self._wakeup:
+                seq.cancelled = True  # no-op if already finished
+
+    # -- scheduler loop (engine thread) -----------------------------------
+
+    def _run(self) -> None:
+        crashed = False
+        try:
+            while True:
+                with self._wakeup:
+                    while (
+                        not self._stopping
+                        and not self._submissions
+                        and not self._waiting
+                        and not self._running
+                    ):
+                        self._wakeup.wait()
+                    if self._stopping:
+                        break
+                    while self._submissions:
+                        self._waiting.append(self._submissions.popleft())
+                self._step()
+        except Exception:  # noqa: BLE001 — engine death must not be silent
+            crashed = True
+            log.exception("engine loop crashed")
+        finally:
+            # Flip stopping FIRST so late generate() calls are rejected
+            # instead of queueing onto a dead thread.
+            with self._wakeup:
+                self._stopping = True
+                leftovers = list(self._running) + list(self._waiting) + list(self._submissions)
+                self._running.clear()
+                self._waiting.clear()
+                self._submissions.clear()
+            reason = FinishReason.ERROR if crashed else FinishReason.CANCELLED
+            err = "engine loop crashed" if crashed else None
+            for seq in leftovers:
+                self._post(seq, LLMEngineOutput(finish_reason=reason, error=err).to_dict())
+                self._post_done(seq)
+
+    def _step(self) -> None:
+        self._reap_cancelled()
+        # Prefill-priority admission (one per step keeps decode cadence).
+        if self._waiting and len(self._running) < self.args.max_num_seqs:
+            seq = self._waiting.popleft()
+            if seq.cancelled:
+                self._post_done(seq)
+            else:
+                try:
+                    self._admit(seq)
+                except NoFreeBlocksError:
+                    self._waiting.appendleft(seq)  # try again when blocks free up
+                    if not self._running:
+                        # Deadlock: nothing to free. Fail the request.
+                        self._waiting.popleft()
+                        self._finish(seq, FinishReason.ERROR,
+                                     error="prompt does not fit in KV cache")
+                except Exception as e:  # noqa: BLE001 — contain per-request faults
+                    log.exception("admission failed for %s", seq.request_id)
+                    if seq.block_ids:
+                        self.pool.free_sequence(seq.block_ids)
+                        seq.block_ids = []
+                    self._finish(seq, FinishReason.ERROR, error=f"admission failed: {e}")
+        if self._running:
+            self._decode_iteration()
+
+    def _reap_cancelled(self) -> None:
+        for seq in [s for s in self._running if s.cancelled]:
+            self._finish(seq, FinishReason.CANCELLED)
+        for seq in [s for s in self._waiting if s.cancelled]:
+            self._waiting.remove(seq)
+            self._post_done(seq)
+
+    # -- admission / prefill ----------------------------------------------
+
+    def _admit(self, seq: _Seq) -> None:
+        bs = self.args.block_size
+        prompt = seq.tokens
+        plen = len(prompt)
+        if plen > self.args.max_model_len - 1:
+            self._finish(seq, FinishReason.ERROR, error="prompt exceeds max_model_len")
+            return
+        hashes = compute_block_hashes(prompt, bs)
+        # Never reuse the *entire* prompt: at least one suffix token must be
+        # computed to produce logits (vLLM rule).
+        max_hit = (plen - 1) // bs
+        hashes_matchable = hashes[:max_hit]
+        total_blocks = (plen + bs - 1) // bs
+        block_ids, n_hit = self.pool.allocate_sequence(hashes_matchable, total_blocks)
+        seq.block_ids = block_ids
+        seq.prefix_hit_blocks = n_hit
+        seq.block_seq = TokenBlockSequence(prompt, bs)
+        start = n_hit * bs
+
+        W = self.args.blocks_per_seq
+        table = np.zeros((W,), np.int32)
+        table[: len(block_ids)] = block_ids
+
+        # Chunked prefill over the suffix (chunks are block-aligned).
+        logits = None
+        pos = start
+        max_chunk = self.args.max_prefill_tokens
+        while pos < plen:
+            chunk = prompt[pos : pos + max_chunk]
+            t_pad = self.args.bucket_prefill(len(chunk))
+            toks = np.zeros((t_pad,), np.int32)
+            toks[: len(chunk)] = chunk
+            logits, self._cache = M.prefill(
+                self.cfg, self._params, self._cache,
+                jnp.asarray(toks), jnp.asarray(table),
+                jnp.int32(pos), jnp.int32(min(pos + len(chunk), plen)),
+            )
+            pos += len(chunk)
+        self.total_prefilled += plen - start
+
+        # Prompt positions are now resident in HBM; register their blocks.
+        seq.kv_written = plen
+        self._register_written_blocks(seq)
+
+        # First sampled token.
+        token = self._sample_rows(logits[None, :], [seq])[0]
+        self._running.append(seq)
+        self._emit_token(seq, token)
+
+    def _register_written_blocks(self, seq: _Seq) -> None:
+        """Register sealed blocks whose KV is fully written. A block sealed
+        by a just-sampled token must wait: that token's KV lands on the next
+        decode step. Registering early would let another request prefix-hit
+        a block with an unwritten tail slot."""
+        if seq.block_seq is None:
+            return
+        bs = self.args.block_size
+        while (
+            seq.registered_blocks < len(seq.block_seq.blocks)
+            and (seq.registered_blocks + 1) * bs <= seq.kv_written
+        ):
+            blk = seq.block_seq.blocks[seq.registered_blocks]
+            self.pool.register_block(
+                seq.block_ids[seq.registered_blocks],
+                blk.sequence_hash,
+                blk.parent_sequence_hash,
+            )
+            seq.registered_blocks += 1
+
+    # -- decode ------------------------------------------------------------
+
+    def _ensure_block(self, seq: _Seq) -> bool:
+        """Make sure the write position has a block; grow by one if needed."""
+        while len(seq.block_ids) * self.args.block_size <= seq.next_write_pos:
+            try:
+                seq.block_ids.append(self.pool.allocate_block())
+            except NoFreeBlocksError:
+                return False
+        return True
+
+    def _preempt(self, seq: _Seq) -> None:
+        """Recompute-preemption: free blocks, requeue with all tokens as the
+        new prompt (reference behaviour matches vLLM recompute mode)."""
+        log.warning("preempting request %s (KV pressure)", seq.request_id)
+        self._running.remove(seq)
+        self.pool.free_sequence(seq.block_ids)
+        seq.block_ids = []
+        seq.registered_blocks = 0
+        seq.kv_written = 0
+        seq.prompt_len = len(seq.tokens)
+        seq.block_seq = None
+        seq.preempted = True
+        self._waiting.appendleft(seq)
+
+    def _decode_iteration(self) -> None:
+        # Grow block tables; under KV pressure preempt newest-first. A lone
+        # sequence that cannot grow is finished (cache physically too small
+        # for prompt+generation) instead of preempt-looping forever.
+        while self._running:
+            blocked = next((s for s in self._running if not self._ensure_block(s)), None)
+            if blocked is None:
+                break
+            if len(self._running) == 1:
+                self._finish(blocked, FinishReason.LENGTH)
+            else:
+                self._preempt(self._running[-1])
+        if not self._running:
+            return
+        batch = list(self._running)
+        B = self.args.bucket_decode(len(batch))
+        W = self.args.blocks_per_seq
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, W), np.int32)
+        active = np.zeros((B,), bool)
+        for i, seq in enumerate(batch):
+            tokens[i] = seq.tokens[-1]
+            positions[i] = seq.next_write_pos
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            active[i] = True
+
+        logits, self._cache = M.decode_step(
+            self.cfg, self._params, self._cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(tables), jnp.asarray(active),
+        )
+        # The step just wrote each sequence's KV at `positions[i]`.
+        for i, seq in enumerate(batch):
+            seq.kv_written = int(positions[i]) + 1
+            self._register_written_blocks(seq)
+        sampled = self._sample_rows(logits, batch)
+        for i, seq in enumerate(batch):
+            self._emit_token(seq, int(sampled[i]))
+
+    def _sample_rows(self, logits: jax.Array, seqs: list[_Seq]) -> np.ndarray:
+        """Sample one token per row for the first len(seqs) rows."""
+        B = logits.shape[0]
+        temps = np.ones((B,), np.float32)
+        tks = np.zeros((B,), np.int32)
+        tps = np.ones((B,), np.float32)
+        freqs = np.zeros((B,), np.float32)
+        press = np.zeros((B,), np.float32)
+        seeds = np.zeros((B,), np.uint32)
+        steps = np.zeros((B,), np.int32)
+        for i, s in enumerate(seqs):
+            temps[i] = s.sampling.temperature
+            tks[i] = s.sampling.top_k or 0
+            tps[i] = s.sampling.top_p if s.sampling.top_p is not None else 1.0
+            freqs[i] = s.sampling.frequency_penalty
+            press[i] = s.sampling.presence_penalty
+            seeds[i] = s.sample_seed
+            steps[i] = s.emitted
+        if needs_full(tks.tolist(), tps.tolist(), freqs.tolist(), press.tolist()):
+            # Penalties need each row's generated-so-far tokens ([B, L],
+            # L bucketed pow2, -1 padded; empty rows penalize nothing).
+            max_gen = max((s.emitted for s in seqs), default=0)
+            L = 16
+            while L < max_gen:
+                L *= 2
+            pen = np.full((B, L), -1, np.int32)
+            for i, s in enumerate(seqs):
+                gen = s.tokens[s.prompt_len : s.prompt_len + L]
+                pen[i, : len(gen)] = gen
+            out = sample_full(
+                logits, jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
+                jnp.asarray(pen), jnp.asarray(freqs), jnp.asarray(press),
+                jnp.asarray(seeds), jnp.asarray(steps),
+            )
+        else:
+            out = sample_simple(logits, jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps))
+        return np.asarray(out)  # the one host sync per step
+
+    # -- token emission / finish ------------------------------------------
+
+    def _emit_token(self, seq: _Seq, token: int) -> None:
+        token = int(token)  # numpy scalar → msgpack-able python int
+        seq.tokens.append(token)
+        seq.emitted += 1
+        self.total_generated += 1
+        # Block-hash bookkeeping only; registration waits until the sealed
+        # block's KV is fully written (_register_written_blocks).
+        if seq.block_seq is not None:
+            seq.block_seq.append(token)
+        finish: FinishReason | None = None
+        if (
+            token in seq.eos_ids
+            and not seq.stop.ignore_eos
+            and seq.emitted >= seq.stop.min_tokens  # eos counts toward min (vLLM)
+        ):
+            finish = FinishReason.STOP
+        elif seq.stop.max_tokens is not None and seq.emitted >= seq.stop.max_tokens:
+            finish = FinishReason.LENGTH
+        elif len(seq.tokens) >= self.args.max_model_len:
+            finish = FinishReason.LENGTH
+        self._post(seq, LLMEngineOutput(token_ids=[token], finish_reason=finish).to_dict())
+        if finish is not None:
+            self._finish(seq, finish, already_posted=True)
+
+    def _finish(
+        self,
+        seq: _Seq,
+        reason: FinishReason,
+        error: str | None = None,
+        already_posted: bool = False,
+    ) -> None:
+        if seq in self._running:
+            self._running.remove(seq)
+        self.pool.free_sequence(seq.block_ids)
+        seq.block_ids = []
+        if not already_posted:
+            self._post(seq, LLMEngineOutput(finish_reason=reason, error=error).to_dict())
+        self._post_done(seq)
+
+    def _post(self, seq: _Seq, item: Any) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(seq.queue.put_nowait, item)
+
+    def _post_done(self, seq: _Seq) -> None:
+        self._post(seq, _SENTINEL_DONE)

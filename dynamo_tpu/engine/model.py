@@ -1,0 +1,286 @@
+"""Functional Llama-family forward with a paged KV cache, in pure JAX.
+
+TPU-first design notes (this is the part the reference delegates to
+vLLM's CUDA kernels; here it is jnp/lax built for XLA:TPU):
+
+- All shapes static: callers pad token runs / batch sizes to buckets
+  (config.py) so each (bucket, variant) compiles once.
+- ``lax.scan`` over stacked layer parameters → one compiled layer body,
+  fast compiles even at 80 layers; the KV cache rides the scan carry and
+  is updated with ``dynamic_update_index_in_dim`` so XLA keeps it
+  in-place (callers donate it).
+- Paged attention is gather-based: KV pages are indexed out of the cache
+  with a block table and attended densely with masking. This is the
+  canonical XLA-friendly formulation; a Pallas flash/paged kernel slots
+  in behind the same signature (ops/ upgrade path).
+- GQA via reshape (no repeat): q [*, KVH, G, hd] against k [*, KVH, hd].
+- bf16 weights/activations; norms, rope, softmax and logits in fp32.
+
+Cache layout: k, v each ``[L, num_blocks, block_size, KVH, head_dim]``.
+Block 0 is a reserved garbage sink — padded positions write there.
+
+Reference parity: replaces the engine forward of vLLM workers
+(reference: components/backends/vllm/src/dynamo/vllm/main.py:90); block
+semantics line up with dynamo_tpu.tokens / the reference's
+lib/llm/src/tokens.rs so KV identity is consistent framework-wide.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.engine.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, N, bs, KVH, hd]
+    v: jax.Array
+
+
+def init_kv_cache(cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params (benchmarks / tests). Real checkpoints load via
+    engine.loader into the same pytree."""
+    d, i = cfg.hidden_size, cfg.intermediate_size
+    L = cfg.num_layers
+    keys = jax.random.split(key, 8)
+
+    def norm_init(k, fan_in, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    params: Params = {
+        "embed": norm_init(keys[0], d, (cfg.vocab_size, d)),
+        "layers": {
+            "wq": norm_init(keys[1], d, (L, d, cfg.q_size)),
+            "wk": norm_init(keys[2], d, (L, d, cfg.kv_size)),
+            "wv": norm_init(keys[3], d, (L, d, cfg.kv_size)),
+            "wo": norm_init(keys[4], cfg.q_size, (L, cfg.q_size, d)),
+            "w_gate": norm_init(keys[5], d, (L, d, i)),
+            "w_up": norm_init(keys[6], d, (L, d, i)),
+            "w_down": norm_init(keys[7], i, (L, i, d)),
+            "attn_norm": jnp.ones((L, d), dtype),
+            "mlp_norm": jnp.ones((L, d), dtype),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm_init(jax.random.fold_in(key, 99), d, (d, cfg.vocab_size))
+    return params
+
+
+def _rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    norm = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [..., T, heads, hd] (or [..., heads, hd] with
+    positions [...]); positions broadcast against x's leading dims."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freq = jnp.arange(half, dtype=jnp.float32)
+    inv_freq = theta ** (-freq / half)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(x, w_gate, w_up, w_down):
+    g = jnp.dot(x, w_gate)
+    u = jnp.dot(x, w_up)
+    return jnp.dot(jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u, w_down)
+
+
+def _logits(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
+    x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.dot(x, head).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Prefill: one (possibly prefix-cached) sequence, padded to a length bucket
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def prefill(
+    cfg: ModelConfig,
+    params: Params,
+    cache: KVCache,
+    tokens: jax.Array,       # [T_pad] suffix token ids (prompt minus cached prefix)
+    block_table: jax.Array,  # [W] int32 — blocks for the FULL sequence
+    start_pos: jax.Array,    # scalar int32 — first suffix position (block-aligned)
+    true_len: jax.Array,     # scalar int32 — true total length (prefix + suffix)
+) -> tuple[jax.Array, KVCache]:
+    """Run the suffix through the model, attending to cached prefix pages,
+    write suffix KV into the cache, return last-token logits [V].
+
+    Prefix caching contract: positions [0, start_pos) are already present
+    in the blocks named by ``block_table`` (whole blocks only); suffix
+    positions [start_pos, true_len) are computed here. start_pos=0 is the
+    no-reuse path."""
+    T = tokens.shape[0]
+    W = block_table.shape[0]
+    bs = cache.k.shape[2]
+    suffix_positions = start_pos + jnp.arange(T, dtype=jnp.int32)
+
+    x = params["embed"][tokens]  # [T, D]
+
+    # Masks (fp32 additive), fixed for all layers.
+    neg = jnp.float32(-1e9)
+    # suffix→suffix causal, masked beyond true length
+    sfx = jnp.arange(T, dtype=jnp.int32)
+    causal = (sfx[None, :] <= sfx[:, None]).astype(jnp.float32)
+    valid_sfx = (suffix_positions < true_len).astype(jnp.float32)
+    mask_ss = (1.0 - causal * valid_sfx[None, :]) * neg  # [T, T]
+    # suffix→prefix: every suffix token sees all prefix positions
+    ctx = jnp.arange(W * bs, dtype=jnp.int32)
+    mask_sp = jnp.where(ctx[None, :] < start_pos, 0.0, neg)  # [1, W*bs]
+    mask_sp = jnp.broadcast_to(mask_sp, (T, W * bs))
+
+    # Suffix block scatter targets: suffix-local block b lands in global
+    # block table slot start_pos//bs + b (start_pos is block-aligned).
+    nb = T // bs
+    sfx_block_ids = lax.dynamic_slice(
+        jnp.concatenate([block_table, jnp.zeros((nb,), jnp.int32)]),
+        (start_pos // bs,), (nb,),
+    )
+    # Padded suffix blocks (beyond true_len) → garbage block 0.
+    blk_start = start_pos + jnp.arange(nb, dtype=jnp.int32) * bs
+    sfx_block_ids = jnp.where(blk_start < true_len, sfx_block_ids, 0)
+
+    scale = cfg.head_dim ** -0.5
+    G = cfg.num_heads // cfg.num_kv_heads
+
+    def layer(carry, xs):
+        x, k_cache, v_cache = carry
+        lp, layer_idx = xs
+        h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(h, lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
+        k = jnp.dot(h, lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        v = jnp.dot(h, lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        q = _rope(q, suffix_positions, cfg.rope_theta)
+        k = _rope(k, suffix_positions, cfg.rope_theta)
+
+        # Write suffix KV pages: [nb, bs, KVH, hd] scattered to block ids.
+        layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
+        layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
+        layer_k = layer_k.at[sfx_block_ids].set(k.reshape(nb, bs, cfg.num_kv_heads, cfg.head_dim))
+        layer_v = layer_v.at[sfx_block_ids].set(v.reshape(nb, bs, cfg.num_kv_heads, cfg.head_dim))
+        k_cache = lax.dynamic_update_index_in_dim(k_cache, layer_k, layer_idx, 0)
+        v_cache = lax.dynamic_update_index_in_dim(v_cache, layer_v, layer_idx, 0)
+
+        # Prefix pages (gathered dense) + suffix (already in registers).
+        pk = layer_k[block_table].reshape(W * bs, cfg.num_kv_heads, cfg.head_dim)
+        pv = layer_v[block_table].reshape(W * bs, cfg.num_kv_heads, cfg.head_dim)
+
+        qg = q.reshape(T, cfg.num_kv_heads, G, cfg.head_dim)
+        # scores vs prefix pages / vs suffix
+        s_p = jnp.einsum("tkgh,ckh->tkgc", qg, pk).astype(jnp.float32) * scale
+        s_s = jnp.einsum("tkgh,skh->tkgs", qg, k).astype(jnp.float32) * scale
+        s_p = s_p + mask_sp[:, None, None, :]
+        s_s = s_s + mask_ss[:, None, None, :]
+        s = jnp.concatenate([s_p, s_s], axis=-1)
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        p_p, p_s = p[..., : W * bs], p[..., W * bs :]
+        o = jnp.einsum("tkgc,ckh->tkgh", p_p, pv) + jnp.einsum("tkgs,skh->tkgh", p_s, v)
+        o = o.reshape(T, cfg.q_size)
+        x = x + jnp.dot(o, lp["wo"])
+
+        h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return (x, k_cache, v_cache), None
+
+    layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, k_cache, v_cache), _ = lax.scan(layer, (x, cache.k, cache.v), (params["layers"], layer_ids))
+
+    last = jnp.clip(true_len - start_pos - 1, 0, T - 1)
+    logits = _logits(cfg, params, x[last])
+    return logits, KVCache(k_cache, v_cache)
+
+
+# ---------------------------------------------------------------------------
+# Decode: one token for each of B sequences, padded to a batch bucket
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def decode_step(
+    cfg: ModelConfig,
+    params: Params,
+    cache: KVCache,
+    tokens: jax.Array,        # [B] int32 — current token per sequence
+    positions: jax.Array,     # [B] int32 — position of that token (seq_len-1)
+    block_tables: jax.Array,  # [B, W] int32
+    active: jax.Array,        # [B] bool — padding rows are False
+) -> tuple[jax.Array, KVCache]:
+    """One decode step for a batch. Writes each sequence's new KV at its
+    position, attends over its pages, returns logits [B, V] (fp32)."""
+    B = tokens.shape[0]
+    W = block_tables.shape[1]
+    bs = cache.k.shape[2]
+
+    x = params["embed"][tokens]  # [B, D]
+
+    blk = jnp.where(active, block_tables[jnp.arange(B), positions // bs], 0)
+    off = jnp.where(active, positions % bs, 0)
+
+    ctx = jnp.arange(W * bs, dtype=jnp.int32)
+    # token at `positions` attends [0, positions]
+    mask = jnp.where(ctx[None, :] <= positions[:, None], 0.0, jnp.float32(-1e9))  # [B, W*bs]
+
+    scale = cfg.head_dim ** -0.5
+    G = cfg.num_heads // cfg.num_kv_heads
+
+    def layer(carry, xs):
+        x, k_cache, v_cache = carry
+        lp, layer_idx = xs
+        h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(h, lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
+        k = jnp.dot(h, lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        v = jnp.dot(h, lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+        layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
+        layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
+        layer_k = layer_k.at[blk, off].set(k)  # batched scatter [B, KVH, hd]
+        layer_v = layer_v.at[blk, off].set(v)
+        k_cache = lax.dynamic_update_index_in_dim(k_cache, layer_k, layer_idx, 0)
+        v_cache = lax.dynamic_update_index_in_dim(v_cache, layer_v, layer_idx, 0)
+
+        pk = layer_k[block_tables].reshape(B, W * bs, cfg.num_kv_heads, cfg.head_dim)
+        pv = layer_v[block_tables].reshape(B, W * bs, cfg.num_kv_heads, cfg.head_dim)
+
+        qg = q.reshape(B, cfg.num_kv_heads, G, cfg.head_dim)
+        s = jnp.einsum("bkgh,bckh->bkgc", qg, pk).astype(jnp.float32) * scale
+        s = s + mask[:, None, None, :]
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bkgc,bckh->bkgh", p, pv).reshape(B, cfg.q_size)
+        x = x + jnp.dot(o, lp["wo"])
+
+        h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return (x, k_cache, v_cache), None
+
+    layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    (x, k_cache, v_cache), _ = lax.scan(layer, (x, cache.k, cache.v), (params["layers"], layer_ids))
+
+    logits = _logits(cfg, params, x)  # [B, V]
+    return logits, KVCache(k_cache, v_cache)

@@ -1,0 +1,108 @@
+"""On-device batched sampling.
+
+Two jitted variants, chosen host-side per batch (static shapes, no traced
+branching):
+
+- ``sample_simple``: greedy / temperature via the Gumbel-max trick — the
+  hot path for benchmarks and most traffic; no sort, no penalties.
+- ``sample_full``: frequency/presence penalties + exact top-k + top-p
+  (nucleus) via a full descending sort. Used only when a batch contains a
+  request that asks for any of those.
+
+Per-row PRNG keys: each sequence samples with its own key, derived inside
+jit from (row_seed, emission_index) — row_seed is the request's ``seed``
+when given (else a per-request random), so seeded requests are
+reproducible regardless of batch composition or restarts.
+
+Temperature <= 0 means greedy (argmax) for that row in both variants.
+
+Reference parity: sampling options mapping in the reference preprocessor
+(lib/llm/src/preprocessor.rs); execution happens in-engine, as vLLM does
+for the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_GREEDY_EPS = 1e-5
+
+
+def _row_gumbel(seeds: jax.Array, steps: jax.Array, V: int) -> jax.Array:
+    """Per-row gumbel noise from (seed, emission-index) pairs → [B, V]."""
+
+    def one(s, e):
+        key = jax.random.fold_in(jax.random.PRNGKey(s), e)
+        return jax.random.gumbel(key, (V,), jnp.float32)
+
+    return jax.vmap(one)(seeds, steps)
+
+
+@jax.jit
+def sample_simple(
+    logits: jax.Array,        # [B, V] fp32
+    temperature: jax.Array,   # [B] fp32
+    seeds: jax.Array,         # [B] uint32 per-row seed
+    steps: jax.Array,         # [B] int32 per-row emission index
+) -> jax.Array:
+    greedy = temperature < _GREEDY_EPS
+    temp = jnp.where(greedy, 1.0, temperature)
+    scaled = logits / temp[:, None]
+    gumbel = _row_gumbel(seeds, steps, logits.shape[1])
+    noisy = jnp.where(greedy[:, None], logits, scaled + gumbel)
+    return jnp.argmax(noisy, axis=-1).astype(jnp.int32)
+
+
+@jax.jit
+def sample_full(
+    logits: jax.Array,         # [B, V] fp32
+    temperature: jax.Array,    # [B]
+    top_k: jax.Array,          # [B] int32, 0 = off
+    top_p: jax.Array,          # [B] fp32, 1.0 = off
+    penalty_tokens: jax.Array,  # [B, L] int32 previously generated ids, -1 pad
+    freq_penalty: jax.Array,   # [B] fp32
+    pres_penalty: jax.Array,   # [B] fp32
+    seeds: jax.Array,          # [B] uint32
+    steps: jax.Array,          # [B] int32
+) -> jax.Array:
+    B, V = logits.shape
+
+    # Frequency/presence penalties (OpenAI semantics) over generated tokens.
+    valid = penalty_tokens >= 0
+    safe = jnp.where(valid, penalty_tokens, 0)
+    counts = jnp.zeros((B, V), jnp.float32).at[
+        jnp.arange(B)[:, None], safe
+    ].add(valid.astype(jnp.float32))
+    logits = logits - freq_penalty[:, None] * counts
+    logits = logits - pres_penalty[:, None] * (counts > 0).astype(jnp.float32)
+
+    greedy = temperature < _GREEDY_EPS
+    temp = jnp.where(greedy, 1.0, temperature)
+    scaled = logits / temp[:, None]
+
+    svals, sidx = jax.lax.top_k(scaled, V)  # descending sort
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+    k = jnp.where(top_k <= 0, V, top_k)[:, None]
+    keep_k = ranks < k
+    probs = jax.nn.softmax(svals, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    # nucleus: keep tokens whose preceding cumulative mass < top_p
+    keep_p = cum_before < top_p[:, None]
+    keep = keep_k & keep_p
+    keep = keep.at[:, 0].set(True)  # never mask the argmax
+    masked = jnp.where(keep, svals, -jnp.inf)
+
+    gumbel = _row_gumbel(seeds, steps, V)
+    pick = jnp.argmax(jnp.where(greedy[:, None], masked, masked + gumbel), axis=-1)
+    return jnp.take_along_axis(sidx, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def needs_full(top_ks, top_ps, freqs, press) -> bool:
+    """Host-side variant choice for a batch."""
+    return (
+        any(k and k > 0 for k in top_ks)
+        or any(p is not None and p < 1.0 for p in top_ps)
+        or any(f for f in freqs)
+        or any(p for p in press)
+    )

@@ -1,0 +1,16 @@
+"""KV-cache-aware routing.
+
+Reference analogue: lib/llm/src/kv_router/ — the headline subsystem
+(3x TTFT claim): workers publish KV cache block events + load metrics;
+the frontend maintains a global radix tree over block hashes and routes
+each request to the worker with the best (prefix-overlap, load) cost.
+"""
+
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    WorkerStats,
+)
+
+__all__ = ["KvCacheEvent", "ForwardPassMetrics", "WorkerStats", "KvStats"]

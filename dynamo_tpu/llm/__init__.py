@@ -1,0 +1,29 @@
+"""LLM protocol layer.
+
+Fills the role of the reference's ``dynamo-llm`` Rust crate protocol/
+preprocessing surface (reference: lib/llm/src/{protocols,preprocessor.rs,
+backend.rs,model_card}): OpenAI-compatible request/response types, SSE
+codec, tokenization with incremental detokenization, chat templating,
+stop-condition handling, and model deployment cards.
+
+The compute engine itself lives in ``dynamo_tpu.engine``; KV-aware routing
+in ``dynamo_tpu.kv_router``.
+"""
+
+from dynamo_tpu.llm.protocols import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+__all__ = [
+    "FinishReason",
+    "LLMEngineOutput",
+    "PreprocessedRequest",
+    "SamplingOptions",
+    "StopConditions",
+    "ModelDeploymentCard",
+]

@@ -1,0 +1,211 @@
+"""OpenAI → engine-ready preprocessing: chat templating, tokenization,
+sampling/stop mapping; plus the response-side DeltaGenerator.
+
+Reference analogue: ``OpenAIPreprocessor`` (lib/llm/src/preprocessor.rs:
+92-144,320) with minijinja chat templates (preprocessor/prompt/template/)
+— here jinja2, same template contract as HF `chat_template`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jinja2
+
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.protocols import (
+    ChatCompletionRequest,
+    ChatMessage,
+    CompletionRequest,
+    OpenAIError,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+    chat_chunk,
+    completion_chunk,
+    gen_request_id,
+    usage_dict,
+)
+from dynamo_tpu.llm.tokenizer import Tokenizer, load_tokenizer
+
+# Generic chat template used when the model card carries none. Matches the
+# widely-used ChatML-ish shape; ByteTokenizer round-trips it exactly.
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>\n{{ message.content }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+class ChatTemplate:
+    def __init__(self, source: str | None = None):
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            autoescape=False,
+            undefined=jinja2.StrictUndefined,
+            trim_blocks=True,
+            lstrip_blocks=True,
+        )
+        # HF templates call raise_exception(); provide it.
+        env.globals["raise_exception"] = _raise_template_exception
+        self._template = env.from_string(source or DEFAULT_CHAT_TEMPLATE)
+
+    def render(self, messages: list[ChatMessage], add_generation_prompt: bool = True) -> str:
+        try:
+            return self._template.render(
+                messages=[m.to_dict() for m in messages],
+                add_generation_prompt=add_generation_prompt,
+                bos_token="",
+                eos_token="",
+            )
+        except jinja2.UndefinedError as e:
+            raise OpenAIError(f"chat template error: {e}", status=500) from e
+
+
+def _raise_template_exception(msg: str):
+    raise OpenAIError(f"chat template rejected request: {msg}")
+
+
+class OpenAIPreprocessor:
+    """Stateless per-model request preprocessor. Built from a model card;
+    owns the tokenizer and chat template."""
+
+    def __init__(self, card: ModelDeploymentCard, tokenizer: Tokenizer | None = None):
+        self.card = card
+        self.tokenizer = tokenizer or load_tokenizer(card.tokenizer)
+        self.template = ChatTemplate(card.chat_template)
+        eos = list(card.eos_token_ids) or list(self.tokenizer.eos_token_ids)
+        self._eos_ids = eos
+
+    # -- request side -----------------------------------------------------
+
+    def _common(
+        self,
+        req: ChatCompletionRequest | CompletionRequest,
+        token_ids: list[int],
+        annotations: dict[str, Any],
+    ) -> PreprocessedRequest:
+        if not token_ids:
+            raise OpenAIError("prompt must not be empty")
+        if len(token_ids) >= self.card.context_length:
+            raise OpenAIError(
+                f"prompt ({len(token_ids)} tokens) exceeds model context length "
+                f"({self.card.context_length})"
+            )
+        sampling = SamplingOptions(
+            temperature=1.0 if req.temperature is None else req.temperature,
+            top_p=1.0 if req.top_p is None else req.top_p,
+            top_k=int(req.top_k or 0),
+            seed=req.seed,
+            frequency_penalty=getattr(req, "frequency_penalty", None) or 0.0,
+            presence_penalty=getattr(req, "presence_penalty", None) or 0.0,
+        )
+        # Budget: explicit max_tokens, else whatever fits in context.
+        budget = self.card.context_length - len(token_ids)
+        max_tokens = min(req.max_tokens, budget) if req.max_tokens else budget
+        stop = StopConditions(
+            max_tokens=max_tokens,
+            stop=list(req.stop),
+            min_tokens=int(req.min_tokens or 0),
+            ignore_eos=req.ignore_eos,
+        )
+        return PreprocessedRequest(
+            model=self.card.name,
+            token_ids=token_ids,
+            sampling=sampling,
+            stop=stop,
+            eos_token_ids=self._eos_ids,
+            annotations=annotations,
+        )
+
+    def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        prompt = self.template.render(req.messages, add_generation_prompt=True)
+        token_ids = self.tokenizer.encode(prompt)
+        annotations: dict[str, Any] = {}
+        if "formatted_prompt" in req.annotations:
+            annotations["formatted_prompt"] = prompt
+        if "token_ids" in req.annotations:
+            annotations["token_ids"] = token_ids
+        return self._common(req, token_ids, annotations)
+
+    def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
+        if isinstance(req.prompt, list):
+            token_ids = [int(t) for t in req.prompt]
+        else:
+            token_ids = self.tokenizer.encode(req.prompt)
+        annotations: dict[str, Any] = {}
+        if "token_ids" in req.annotations:
+            annotations["token_ids"] = token_ids
+        return self._common(req, token_ids, annotations)
+
+
+class DeltaGenerator:
+    """Turns Backend text deltas into OpenAI SSE chunk payloads and the
+    final aggregated response (reference: preprocessor.rs DeltaGenerator +
+    protocols/openai/*/aggregator.rs)."""
+
+    def __init__(
+        self,
+        model: str,
+        kind: str = "chat",
+        request_id: str | None = None,
+        prompt_tokens: int = 0,
+    ):
+        assert kind in ("chat", "completion")
+        self.kind = kind
+        self.model = model
+        self.id = request_id or gen_request_id("chatcmpl" if kind == "chat" else "cmpl")
+        self.created = int(time.time())
+        self.prompt_tokens = prompt_tokens
+        self.completion_tokens = 0
+        self.text_parts: list[str] = []
+        self.finish_reason: str | None = None
+        self._first = True
+
+    def usage(self) -> dict[str, int]:
+        return usage_dict(self.prompt_tokens, self.completion_tokens)
+
+    def on_delta(self, text: str | None, n_tokens: int, finish_reason: str | None) -> list[dict]:
+        """→ list of SSE chunk payload dicts for this engine delta."""
+        self.completion_tokens += n_tokens
+        chunks: list[dict] = []
+        if text:
+            self.text_parts.append(text)
+        if self.kind == "chat":
+            if self._first:
+                self._first = False
+                chunks.append(chat_chunk(self.id, self.model, self.created, role="assistant", content=""))
+            if text:
+                chunks.append(chat_chunk(self.id, self.model, self.created, content=text))
+            if finish_reason:
+                self.finish_reason = finish_reason
+                chunks.append(
+                    chat_chunk(
+                        self.id, self.model, self.created,
+                        finish_reason=finish_reason, usage=self.usage(),
+                    )
+                )
+        else:
+            if text:
+                chunks.append(completion_chunk(self.id, self.model, self.created, text=text))
+            if finish_reason:
+                self.finish_reason = finish_reason
+                chunks.append(
+                    completion_chunk(
+                        self.id, self.model, self.created,
+                        finish_reason=finish_reason, usage=self.usage(),
+                    )
+                )
+        return chunks
+
+    def final_response(self) -> dict:
+        """Aggregated non-streaming response."""
+        from dynamo_tpu.llm.protocols import chat_completion, completion_response
+
+        text = "".join(self.text_parts)
+        finish = self.finish_reason or "stop"
+        if self.kind == "chat":
+            return chat_completion(self.id, self.model, self.created, text, finish, self.usage())
+        return completion_response(self.id, self.model, self.created, text, finish, self.usage())

@@ -1,0 +1,500 @@
+"""Wire protocols: internal engine request/response + OpenAI compatibility.
+
+Reference analogue: ``PreprocessedRequest``/``LLMEngineOutput`` and the
+OpenAI protocol types + SSE codec (reference: lib/llm/src/protocols/
+common/llm_backend.rs, protocols/openai/, protocols/codec.rs:755).
+
+Everything here serializes to plain msgpack/JSON-able dicts — these types
+cross process boundaries (frontend → router → worker) on the framed-TCP
+request plane, so they must stay schema-stable and language-neutral.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Iterable
+
+
+class FinishReason(str, Enum):
+    STOP = "stop"           # hit a stop string / stop token / EOS
+    LENGTH = "length"       # hit max_tokens or context limit
+    CANCELLED = "cancelled"  # client disconnected or cancelled
+    ERROR = "error"
+
+    @classmethod
+    def parse(cls, v: str | None) -> "FinishReason | None":
+        return None if v is None else cls(v)
+
+
+@dataclass
+class SamplingOptions:
+    """Sampling knobs forwarded to the engine's on-device sampler."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0          # 0 = disabled
+    seed: int | None = None
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "temperature": self.temperature,
+            "top_p": self.top_p,
+            "top_k": self.top_k,
+            "seed": self.seed,
+            "frequency_penalty": self.frequency_penalty,
+            "presence_penalty": self.presence_penalty,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SamplingOptions":
+        return cls(
+            temperature=float(d.get("temperature", 1.0)),
+            top_p=float(d.get("top_p", 1.0)),
+            top_k=int(d.get("top_k", 0)),
+            seed=d.get("seed"),
+            frequency_penalty=float(d.get("frequency_penalty", 0.0)),
+            presence_penalty=float(d.get("presence_penalty", 0.0)),
+        )
+
+
+@dataclass
+class StopConditions:
+    """When generation must end.
+
+    ``stop`` strings are enforced by the Backend operator (which sees
+    detokenized text); token-level conditions are enforced in the engine.
+    """
+
+    max_tokens: int | None = None
+    stop: list[str] = field(default_factory=list)
+    stop_token_ids: list[int] = field(default_factory=list)
+    min_tokens: int = 0
+    ignore_eos: bool = False
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "max_tokens": self.max_tokens,
+            "stop": list(self.stop),
+            "stop_token_ids": list(self.stop_token_ids),
+            "min_tokens": self.min_tokens,
+            "ignore_eos": self.ignore_eos,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "StopConditions":
+        return cls(
+            max_tokens=d.get("max_tokens"),
+            stop=list(d.get("stop") or []),
+            stop_token_ids=list(d.get("stop_token_ids") or []),
+            min_tokens=int(d.get("min_tokens", 0)),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+        )
+
+
+@dataclass
+class PreprocessedRequest:
+    """The tokenized, engine-ready request produced by the preprocessor
+    (reference: lib/llm/src/protocols/common/preprocessor.rs)."""
+
+    model: str
+    token_ids: list[int]
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    eos_token_ids: list[int] = field(default_factory=list)
+    # Router-injected hint: how many prefix blocks the chosen worker already
+    # holds (reference: lib/llm/src/kv_router.rs:299-369).
+    estimated_prefix_hit_num_blocks: int | None = None
+    annotations: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "model": self.model,
+            "token_ids": list(self.token_ids),
+            "sampling": self.sampling.to_dict(),
+            "stop": self.stop.to_dict(),
+            "eos_token_ids": list(self.eos_token_ids),
+            "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
+            "annotations": dict(self.annotations),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PreprocessedRequest":
+        return cls(
+            model=d["model"],
+            token_ids=list(d["token_ids"]),
+            sampling=SamplingOptions.from_dict(d.get("sampling") or {}),
+            stop=StopConditions.from_dict(d.get("stop") or {}),
+            eos_token_ids=list(d.get("eos_token_ids") or []),
+            estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
+            annotations=dict(d.get("annotations") or {}),
+        )
+
+
+@dataclass
+class LLMEngineOutput:
+    """One streamed delta from the engine (reference: lib/llm/src/protocols/
+    common/llm_backend.rs LLMEngineOutput).
+
+    ``token_ids`` are the *new* tokens in this delta. ``text`` is filled by
+    the Backend operator after incremental detokenization; engines emit
+    tokens only.
+    """
+
+    token_ids: list[int] = field(default_factory=list)
+    text: str | None = None
+    finish_reason: FinishReason | None = None
+    cum_log_probs: float | None = None
+    # Disaggregation: prefill workers return KV block descriptors here.
+    kv_transfer_params: dict[str, Any] | None = None
+    # Error detail when finish_reason == ERROR.
+    error: str | None = None
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"token_ids": list(self.token_ids)}
+        if self.text is not None:
+            d["text"] = self.text
+        if self.finish_reason is not None:
+            d["finish_reason"] = self.finish_reason.value
+        if self.cum_log_probs is not None:
+            d["cum_log_probs"] = self.cum_log_probs
+        if self.kv_transfer_params is not None:
+            d["kv_transfer_params"] = self.kv_transfer_params
+        if self.error is not None:
+            d["error"] = self.error
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "LLMEngineOutput":
+        return cls(
+            token_ids=list(d.get("token_ids") or []),
+            text=d.get("text"),
+            finish_reason=FinishReason.parse(d.get("finish_reason")),
+            cum_log_probs=d.get("cum_log_probs"),
+            kv_transfer_params=d.get("kv_transfer_params"),
+            error=d.get("error"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# OpenAI API surface (validation + response builders)
+# ---------------------------------------------------------------------------
+
+
+class OpenAIError(Exception):
+    """Maps to an OpenAI-style error JSON body + HTTP status."""
+
+    def __init__(self, message: str, status: int = 400, err_type: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = status
+        self.err_type = err_type
+
+    def body(self) -> dict[str, Any]:
+        return {"error": {"message": str(self), "type": self.err_type, "code": self.status}}
+
+
+@dataclass
+class ChatMessage:
+    role: str
+    content: str
+    name: str | None = None
+
+    @classmethod
+    def parse(cls, d: Any) -> "ChatMessage":
+        if not isinstance(d, dict) or "role" not in d:
+            raise OpenAIError("each message must be an object with a 'role'")
+        content = d.get("content")
+        if content is None:
+            content = ""
+        if isinstance(content, list):  # multimodal-style parts: concatenate text parts
+            content = "".join(
+                p.get("text", "") for p in content if isinstance(p, dict) and p.get("type") == "text"
+            )
+        if not isinstance(content, str):
+            raise OpenAIError("message content must be a string or content-part list")
+        return cls(role=str(d["role"]), content=content, name=d.get("name"))
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {"role": self.role, "content": self.content}
+        if self.name:
+            d["name"] = self.name
+        return d
+
+
+def _opt_float(d: dict, key: str, lo: float, hi: float) -> float | None:
+    v = d.get(key)
+    if v is None:
+        return None
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        raise OpenAIError(f"'{key}' must be a number") from None
+    if not lo <= v <= hi:
+        raise OpenAIError(f"'{key}' must be in [{lo}, {hi}]")
+    return v
+
+
+def _parse_stop(d: dict) -> list[str]:
+    stop = d.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        return [stop]
+    if isinstance(stop, list) and all(isinstance(s, str) for s in stop):
+        if len(stop) > 16:
+            raise OpenAIError("'stop' supports at most 16 sequences")
+        return stop
+    raise OpenAIError("'stop' must be a string or list of strings")
+
+
+@dataclass
+class ChatCompletionRequest:
+    """Parsed+validated POST /v1/chat/completions body
+    (reference: lib/llm/src/protocols/openai/chat_completions/)."""
+
+    model: str
+    messages: list[ChatMessage]
+    stream: bool = False
+    max_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None          # extension (vLLM-compatible)
+    seed: int | None = None
+    n: int = 1
+    stop: list[str] = field(default_factory=list)
+    frequency_penalty: float | None = None
+    presence_penalty: float | None = None
+    min_tokens: int | None = None     # extension
+    ignore_eos: bool = False          # extension
+    annotations: list[str] = field(default_factory=list)  # nvext-style debug annotations
+    raw: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, d: Any) -> "ChatCompletionRequest":
+        if not isinstance(d, dict):
+            raise OpenAIError("request body must be a JSON object")
+        model = d.get("model")
+        if not model or not isinstance(model, str):
+            raise OpenAIError("'model' is required")
+        msgs = d.get("messages")
+        if not isinstance(msgs, list) or not msgs:
+            raise OpenAIError("'messages' must be a non-empty array")
+        max_tokens = d.get("max_tokens", d.get("max_completion_tokens"))
+        if max_tokens is not None and (not isinstance(max_tokens, int) or max_tokens < 1):
+            raise OpenAIError("'max_tokens' must be a positive integer")
+        n = d.get("n", 1)
+        if n != 1:
+            raise OpenAIError("'n' != 1 is not supported")
+        ext = d.get("nvext") or d.get("ext") or {}
+        return cls(
+            model=model,
+            messages=[ChatMessage.parse(m) for m in msgs],
+            stream=bool(d.get("stream", False)),
+            max_tokens=max_tokens,
+            temperature=_opt_float(d, "temperature", 0.0, 2.0),
+            top_p=_opt_float(d, "top_p", 0.0, 1.0),
+            top_k=d.get("top_k"),
+            seed=d.get("seed"),
+            stop=_parse_stop(d),
+            frequency_penalty=_opt_float(d, "frequency_penalty", -2.0, 2.0),
+            presence_penalty=_opt_float(d, "presence_penalty", -2.0, 2.0),
+            min_tokens=d.get("min_tokens"),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+            annotations=list(ext.get("annotations") or []),
+            raw=d,
+        )
+
+
+@dataclass
+class CompletionRequest:
+    """Parsed+validated POST /v1/completions body."""
+
+    model: str
+    prompt: str | list[int]
+    stream: bool = False
+    max_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    seed: int | None = None
+    echo: bool = False
+    stop: list[str] = field(default_factory=list)
+    min_tokens: int | None = None
+    ignore_eos: bool = False
+    annotations: list[str] = field(default_factory=list)
+    raw: dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, d: Any) -> "CompletionRequest":
+        if not isinstance(d, dict):
+            raise OpenAIError("request body must be a JSON object")
+        model = d.get("model")
+        if not model or not isinstance(model, str):
+            raise OpenAIError("'model' is required")
+        prompt = d.get("prompt")
+        if isinstance(prompt, list) and all(isinstance(t, int) for t in prompt):
+            pass  # pre-tokenized prompt
+        elif not isinstance(prompt, str):
+            raise OpenAIError("'prompt' must be a string or list of token ids")
+        max_tokens = d.get("max_tokens")
+        if max_tokens is not None and (not isinstance(max_tokens, int) or max_tokens < 1):
+            raise OpenAIError("'max_tokens' must be a positive integer")
+        ext = d.get("nvext") or d.get("ext") or {}
+        return cls(
+            model=model,
+            prompt=prompt,
+            stream=bool(d.get("stream", False)),
+            max_tokens=max_tokens,
+            temperature=_opt_float(d, "temperature", 0.0, 2.0),
+            top_p=_opt_float(d, "top_p", 0.0, 1.0),
+            top_k=d.get("top_k"),
+            seed=d.get("seed"),
+            echo=bool(d.get("echo", False)),
+            stop=_parse_stop(d),
+            min_tokens=d.get("min_tokens"),
+            ignore_eos=bool(d.get("ignore_eos", False)),
+            annotations=list(ext.get("annotations") or []),
+            raw=d,
+        )
+
+
+def gen_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict[str, int]:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def chat_chunk(
+    request_id: str,
+    model: str,
+    created: int,
+    *,
+    content: str | None = None,
+    role: str | None = None,
+    finish_reason: str | None = None,
+    usage: dict[str, int] | None = None,
+) -> dict[str, Any]:
+    """One `chat.completion.chunk` SSE payload."""
+    delta: dict[str, Any] = {}
+    if role is not None:
+        delta["role"] = role
+    if content is not None:
+        delta["content"] = content
+    body: dict[str, Any] = {
+        "id": request_id,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+    }
+    if usage is not None:
+        body["usage"] = usage
+    return body
+
+
+def chat_completion(
+    request_id: str,
+    model: str,
+    created: int,
+    content: str,
+    finish_reason: str,
+    usage: dict[str, int],
+) -> dict[str, Any]:
+    return {
+        "id": request_id,
+        "object": "chat.completion",
+        "created": created,
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": usage,
+    }
+
+
+def completion_chunk(
+    request_id: str,
+    model: str,
+    created: int,
+    *,
+    text: str = "",
+    finish_reason: str | None = None,
+    usage: dict[str, int] | None = None,
+) -> dict[str, Any]:
+    body: dict[str, Any] = {
+        "id": request_id,
+        "object": "text_completion",
+        "created": created,
+        "model": model,
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason, "logprobs": None}],
+    }
+    if usage is not None:
+        body["usage"] = usage
+    return body
+
+
+def completion_response(
+    request_id: str,
+    model: str,
+    created: int,
+    text: str,
+    finish_reason: str,
+    usage: dict[str, int],
+) -> dict[str, Any]:
+    body = completion_chunk(request_id, model, created, text=text, finish_reason=finish_reason)
+    body["usage"] = usage
+    return body
+
+
+def model_list(models: Iterable[str], owned_by: str = "dynamo-tpu") -> dict[str, Any]:
+    now = int(time.time())
+    return {
+        "object": "list",
+        "data": [
+            {"id": m, "object": "model", "created": now, "owned_by": owned_by} for m in models
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# SSE codec (reference: lib/llm/src/protocols/codec.rs:755)
+# ---------------------------------------------------------------------------
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_event(data: str) -> bytes:
+    return f"data: {data}\n\n".encode()
+
+
+def parse_sse_lines(chunks: Iterable[bytes]) -> Iterable[str]:
+    """Parse an SSE byte stream into `data:` payload strings ("[DONE]"
+    included). Test/client helper; tolerant of split chunks."""
+    buf = b""
+    for chunk in chunks:
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            for line in event.split(b"\n"):
+                if line.startswith(b"data: "):
+                    yield line[6:].decode()
+                elif line.startswith(b"data:"):
+                    yield line[5:].decode()

@@ -1,0 +1,185 @@
+"""Tokenizer abstraction + incremental detokenization.
+
+Reference analogue: lib/llm/src/tokenizers.rs (HF `tokenizers` wrapper with
+``DecodeStream`` incremental detokenization at tokenizers.rs:586).
+
+Two implementations:
+
+- ``HFTokenizer``: wraps the HuggingFace ``tokenizers`` library loaded from
+  a local ``tokenizer.json`` (or a directory containing one). The real
+  path for production models.
+- ``ByteTokenizer``: a self-contained UTF-8 byte-level tokenizer (vocab =
+  256 bytes + specials). Needs no model files, so every test and the
+  mocker can exercise the full tokenize→generate→detokenize path without
+  network or fixtures.
+
+``DecodeStream`` implements the standard prefix-window incremental decode:
+hold output while the tail of the decoded window is an incomplete UTF-8 /
+merge sequence, emit only once the text stabilizes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Protocol, Sequence
+
+__all__ = [
+    "Tokenizer",
+    "ByteTokenizer",
+    "HFTokenizer",
+    "DecodeStream",
+    "load_tokenizer",
+]
+
+_REPLACEMENT = "�"
+
+
+class Tokenizer(Protocol):
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str: ...
+
+    @property
+    def eos_token_ids(self) -> list[int]: ...
+
+    @property
+    def vocab_size(self) -> int: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + specials. BOS=256, EOS=257, PAD=258."""
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+
+    def __init__(self, add_bos: bool = False):
+        self.add_bos = add_bos
+
+    def encode(self, text: str) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if self.add_bos:
+            ids.insert(0, self.BOS)
+        return ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        data = bytes(i for i in ids if i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return [self.EOS]
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+
+class HFTokenizer:
+    """HuggingFace `tokenizers` wrapper, loaded from local files only
+    (zero-egress environment: no hub downloads)."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer as _Tok
+
+        tok_file = path
+        if os.path.isdir(path):
+            tok_file = os.path.join(path, "tokenizer.json")
+        self._tok = _Tok.from_file(tok_file)
+        self._eos_ids = self._discover_eos(path)
+
+    def _discover_eos(self, path: str) -> list[int]:
+        # generation_config.json / tokenizer_config.json carry eos ids for
+        # HF model dirs; fall back to common eos token strings.
+        base = path if os.path.isdir(path) else os.path.dirname(path)
+        for fname in ("generation_config.json", "config.json"):
+            p = os.path.join(base, fname)
+            if os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        cfg = json.load(f)
+                    eos = cfg.get("eos_token_id")
+                    if isinstance(eos, int):
+                        return [eos]
+                    if isinstance(eos, list):
+                        return [int(e) for e in eos]
+                except (OSError, ValueError):
+                    pass
+        out = []
+        for tok in ("</s>", "<|end_of_text|>", "<|eot_id|>", "<|endoftext|>", "<|im_end|>"):
+            tid = self._tok.token_to_id(tok)
+            if tid is not None:
+                out.append(tid)
+        return out
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return list(self._eos_ids)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+
+def load_tokenizer(spec: dict) -> Tokenizer:
+    """Build a tokenizer from a ModelDeploymentCard tokenizer spec:
+    {"type": "byte"} or {"type": "hf", "path": "..."}."""
+    kind = spec.get("type", "byte")
+    if kind == "byte":
+        return ByteTokenizer(add_bos=bool(spec.get("add_bos", False)))
+    if kind == "hf":
+        return HFTokenizer(spec["path"])
+    raise ValueError(f"unknown tokenizer type: {kind!r}")
+
+
+class DecodeStream:
+    """Incremental detokenizer: feed token ids one at a time, get text
+    deltas that never split a multi-byte character or merge region.
+
+    Algorithm (prefix-window, as used across HF serving stacks): keep
+    ``prefix_offset``/``read_offset`` into the id list; each step decode
+    ids[prefix_offset:] and emit the part beyond the previously-read text
+    unless the window currently ends in an incomplete sequence (detected
+    via U+FFFD at the tail).
+    """
+
+    def __init__(self, tokenizer: Tokenizer, skip_special_tokens: bool = True):
+        self.tokenizer = tokenizer
+        self.skip_special = skip_special_tokens
+        self.ids: list[int] = []
+        self.prefix_offset = 0
+        self.read_offset = 0
+
+    def step(self, token_id: int) -> str | None:
+        """Returns the newly-stable text, or None if held back."""
+        self.ids.append(int(token_id))
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset : self.read_offset], self.skip_special
+        )
+        new_text = self.tokenizer.decode(self.ids[self.prefix_offset :], self.skip_special)
+        if len(new_text) > len(prefix_text) and not new_text.endswith(_REPLACEMENT):
+            out = new_text[len(prefix_text) :]
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+            return out
+        return None
+
+    def flush(self) -> str | None:
+        """Emit whatever is still held (end of stream), replacement chars
+        and all."""
+        new_text = self.tokenizer.decode(self.ids[self.prefix_offset :], self.skip_special)
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset : self.read_offset], self.skip_special
+        )
+        if len(new_text) > len(prefix_text):
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+            return new_text[len(prefix_text) :]
+        return None

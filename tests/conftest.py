@@ -12,6 +12,13 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The TPU-tunnel sitecustomize imports jax at interpreter startup, so the
+# env vars above are too late for platform selection — override via config
+# (still before any backend is initialized).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import asyncio  # noqa: E402
 
 import pytest  # noqa: E402

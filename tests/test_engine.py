@@ -1,0 +1,438 @@
+"""Engine tests (CPU, 8 virtual devices via conftest).
+
+Correctness strategy mirrors the reference's engine-trust model: the paged
+model is cross-checked against an independent naive dense implementation
+written here (different code path, same params), then the continuous-
+batching engine is exercised through its async API.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
+from dynamo_tpu.engine import model as M
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.protocols import FinishReason, PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+
+CFG = ModelConfig()  # test-tiny
+
+
+# ---------------------------------------------------------------------------
+# Naive reference forward (dense causal attention, no paging)
+# ---------------------------------------------------------------------------
+
+
+def naive_forward(cfg: ModelConfig, params, token_ids: list[int]) -> np.ndarray:
+    """Logits for every position, computed with plain dense attention."""
+    x = params["embed"][jnp.asarray(token_ids)]
+    T = len(token_ids)
+    positions = jnp.arange(T)
+    G = cfg.num_heads // cfg.num_kv_heads
+
+    def rms(h, w):
+        hf = h.astype(jnp.float32)
+        return (hf * jax.lax.rsqrt(jnp.mean(hf * hf, -1, keepdims=True) + cfg.rms_norm_eps)
+                * w.astype(jnp.float32)).astype(h.dtype)
+
+    lp = params["layers"]
+    for li in range(cfg.num_layers):
+        h = rms(x, lp["attn_norm"][li])
+        q = (h @ lp["wq"][li]).reshape(T, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"][li]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"][li]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        q = M._rope(q, positions, cfg.rope_theta)
+        k = M._rope(k, positions, cfg.rope_theta)
+        qg = q.reshape(T, cfg.num_kv_heads, G, cfg.head_dim)
+        s = jnp.einsum("tkgh,skh->tkgs", qg, k).astype(jnp.float32) * cfg.head_dim**-0.5
+        mask = jnp.where(jnp.arange(T)[None, :] <= jnp.arange(T)[:, None], 0.0, -1e9)
+        s = s + mask[:, None, None, :]
+        p = jax.nn.softmax(s, -1).astype(x.dtype)
+        o = jnp.einsum("tkgs,skh->tkgh", p, v).reshape(T, cfg.q_size)
+        x = x + o @ lp["wo"][li]
+        h = rms(x, lp["mlp_norm"][li])
+        g = h @ lp["w_gate"][li]
+        u = h @ lp["w_up"][li]
+        x = x + (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u) @ lp["w_down"][li]
+    x = rms(x, params["final_norm"])
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return np.asarray((x @ head).astype(jnp.float32))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+
+
+def test_prefill_matches_naive(params):
+    bs = 4
+    cache = M.init_kv_cache(CFG, 16, bs, jnp.float32)
+    prompt = list(range(1, 11))  # 10 tokens
+    table = np.zeros((8,), np.int32)
+    table[:3] = [1, 2, 3]
+    t_pad = 12
+    toks = np.zeros((t_pad,), np.int32)
+    toks[: len(prompt)] = prompt
+    logits, cache = M.prefill(
+        CFG, params, cache, jnp.asarray(toks), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(len(prompt)),
+    )
+    ref = naive_forward(CFG, params, prompt)
+    np.testing.assert_allclose(np.asarray(logits), ref[-1], rtol=2e-4, atol=2e-4)
+
+
+def test_decode_matches_naive(params):
+    bs = 4
+    cache = M.init_kv_cache(CFG, 16, bs, jnp.float32)
+    prompt = list(range(1, 10))  # 9 tokens → block 3 partially filled
+    table = np.zeros((8,), np.int32)
+    table[:3] = [1, 2, 3]
+    t_pad = 12
+    toks = np.zeros((t_pad,), np.int32)
+    toks[: len(prompt)] = prompt
+    _, cache = M.prefill(
+        CFG, params, cache, jnp.asarray(toks), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(len(prompt)),
+    )
+    # decode one new token (id 42) at position 9
+    full = prompt + [42]
+    tables = np.zeros((2, 8), np.int32)
+    tables[0, :3] = [1, 2, 3]
+    logits, cache = M.decode_step(
+        CFG, params, cache,
+        jnp.asarray(np.array([42, 0], np.int32)),
+        jnp.asarray(np.array([9, 0], np.int32)),
+        jnp.asarray(tables),
+        jnp.asarray(np.array([True, False])),
+    )
+    ref = naive_forward(CFG, params, full)
+    np.testing.assert_allclose(np.asarray(logits)[0], ref[-1], rtol=2e-4, atol=2e-4)
+
+
+def test_prefix_cached_prefill_matches_full(params):
+    """Prefill with start_pos>0 over cached blocks == prefill from scratch."""
+    bs = 4
+    prompt = list(range(7, 27))  # 20 tokens = 5 blocks
+    table = np.zeros((8,), np.int32)
+    table[:5] = [1, 2, 3, 4, 5]
+    t_pad = 20
+
+    cache = M.init_kv_cache(CFG, 16, bs, jnp.float32)
+    toks = np.zeros((t_pad,), np.int32)
+    toks[:20] = prompt
+    full_logits, cache = M.prefill(
+        CFG, params, cache, jnp.asarray(toks), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(20),
+    )
+    # Now pretend the first 3 blocks (12 tokens) were cache hits: rerun only
+    # the suffix against the SAME cache (prefix blocks already populated).
+    sfx = np.zeros((8,), np.int32)
+    sfx[:8] = prompt[12:]
+    sfx_logits, cache = M.prefill(
+        CFG, params, cache, jnp.asarray(sfx), jnp.asarray(table),
+        jnp.int32(12), jnp.int32(20),
+    )
+    np.testing.assert_allclose(
+        np.asarray(sfx_logits), np.asarray(full_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+# ---------------------------------------------------------------------------
+# Block pool
+# ---------------------------------------------------------------------------
+
+
+def test_pool_prefix_reuse_and_events():
+    events = []
+    pool = BlockPool(8, 4, event_sink=events.append)
+    ids, hit = pool.allocate_sequence([101, 102], 3)
+    assert hit == 0 and len(ids) == 3
+    pool.register_block(ids[0], 101, None)
+    pool.register_block(ids[1], 102, 101)
+    assert [e.kind for e in events] == ["stored", "stored"]
+    pool.free_sequence(ids)
+    # Same prefix → reuse both registered blocks.
+    ids2, hit2 = pool.allocate_sequence([101, 102], 3)
+    assert hit2 == 2 and ids2[:2] == ids[:2]
+    pool.free_sequence(ids2)
+
+
+def test_pool_eviction_emits_removed():
+    events = []
+    pool = BlockPool(4, 4, event_sink=events.append)  # 3 usable
+    ids, _ = pool.allocate_sequence([], 3)
+    for i, bid in enumerate(ids):
+        pool.register_block(bid, 100 + i, None)
+    pool.free_sequence(ids)          # all cached now
+    ids2, hit = pool.allocate_sequence([999], 3)  # no match → must evict all
+    assert hit == 0
+    kinds = [e.kind for e in events]
+    assert kinds.count("removed") >= 1
+    pool.free_sequence(ids2)
+
+
+def test_pool_exhaustion_raises():
+    pool = BlockPool(4, 4)
+    pool.allocate_sequence([], 3)
+    with pytest.raises(NoFreeBlocksError):
+        pool.allocate_sequence([], 1)
+
+
+# ---------------------------------------------------------------------------
+# Engine (async API)
+# ---------------------------------------------------------------------------
+
+
+def make_args(**kw) -> EngineArgs:
+    defaults = dict(
+        model=CFG, block_size=4, num_kv_blocks=64, max_num_seqs=4,
+        max_model_len=128, max_prefill_tokens=64, dtype="float32",
+    )
+    defaults.update(kw)
+    return EngineArgs(**defaults)
+
+
+def greedy_request(prompt, max_tokens=8, **kw) -> PreprocessedRequest:
+    req = PreprocessedRequest(model="t", token_ids=list(prompt))
+    req.sampling.temperature = 0.0
+    req.stop.max_tokens = max_tokens
+    for k, v in kw.items():
+        setattr(req.stop, k, v)
+    return req
+
+
+async def run_one(engine, req, ctx=None):
+    outs = []
+    async for item in engine.generate(req, ctx or Context()):
+        outs.append(item)
+    return outs
+
+
+def collect_tokens(outs):
+    return [t for o in outs for t in o.get("token_ids", [])]
+
+
+def test_engine_greedy_deterministic():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            a = await run_one(engine, greedy_request([1, 2, 3, 4, 5], 8))
+            b = await run_one(engine, greedy_request([1, 2, 3, 4, 5], 8))
+            assert collect_tokens(a) == collect_tokens(b)
+            assert len(collect_tokens(a)) == 8
+            assert a[-1]["finish_reason"] == "length"
+            return a
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_prefix_cache_hit_and_same_output():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            prompt = list(range(1, 21))  # 20 tokens = 5 blocks of 4
+            a = await run_one(engine, greedy_request(prompt, 6))
+            assert engine.pool.hit_blocks == 0
+            b = await run_one(engine, greedy_request(prompt, 6))
+            # max-hit rule: (20-1)//4 = 4 blocks reusable
+            assert engine.pool.hit_blocks == 4
+            assert collect_tokens(a) == collect_tokens(b)
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_eos_stops_generation():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            prompt = [5, 6, 7, 8]
+            first = collect_tokens(await run_one(engine, greedy_request(prompt, 4)))
+            # Re-run declaring the first generated token as EOS → immediate stop.
+            req = greedy_request(prompt, 4)
+            req.eos_token_ids = [first[0]]
+            outs = await run_one(engine, req)
+            toks = collect_tokens(outs)
+            assert toks == [first[0]]
+            assert outs[-1]["finish_reason"] == "stop"
+            # ignore_eos generates past it
+            req2 = greedy_request(prompt, 4)
+            req2.eos_token_ids = [first[0]]
+            req2.stop.ignore_eos = True
+            assert len(collect_tokens(await run_one(engine, req2))) == 4
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_concurrent_requests():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            prompts = [[i, i + 1, i + 2] for i in range(1, 9)]
+            results = await asyncio.gather(
+                *(run_one(engine, greedy_request(p, 5)) for p in prompts)
+            )
+            for outs in results:
+                assert len(collect_tokens(outs)) == 5
+                assert outs[-1]["finish_reason"] == "length"
+            # batched decode must agree with solo decode
+            solo = await run_one(engine, greedy_request(prompts[0], 5))
+            assert collect_tokens(results[0]) == collect_tokens(solo)
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_cancellation():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            ctx = Context()
+            req = greedy_request([1, 2, 3], 10_000)
+            req.stop.max_tokens = None  # run "forever" (until max_model_len)
+            got = []
+
+            async def consume():
+                async for item in engine.generate(req, ctx):
+                    got.append(item)
+                    if len(got) == 3:
+                        ctx.cancel()
+
+            await asyncio.wait_for(consume(), timeout=30)
+            assert got, "should have received some tokens"
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_preemption_recovers():
+    async def go():
+        # Tiny pool: 2 concurrent long generations must force preemption.
+        engine = await TpuEngine(
+            make_args(num_kv_blocks=14, max_model_len=32, max_num_seqs=2)
+        ).start()
+        try:
+            p1, p2 = [1, 2, 3, 4, 5, 6], [9, 8, 7, 6, 5, 4]
+            r1, r2 = await asyncio.gather(
+                run_one(engine, greedy_request(p1, 20)),
+                run_one(engine, greedy_request(p2, 20)),
+            )
+            # Both finish; preempted one recomputes and still yields 20 tokens
+            # (token-for-token identical to a solo run, since greedy).
+            solo1 = await run_one(engine, greedy_request(p1, 20))
+            assert collect_tokens(r1) == collect_tokens(solo1)
+            assert len(collect_tokens(r2)) == 20
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_prefix_hit_after_sealed_tail_block_is_correct():
+    """Regression: a block sealed by the final sampled token must NOT be
+    prefix-hit later — its tail KV was never written (the token would only
+    be written by a next decode step that never ran)."""
+
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        fresh = await TpuEngine(make_args()).start()
+        try:
+            prompt = [1, 2, 3, 4]  # 1 full block of 4
+            a = collect_tokens(await run_one(engine, greedy_request(prompt, 4)))
+            # a[3] sealed block 1 at emit time; its KV is unwritten.
+            follow = prompt + a
+            b_warm = collect_tokens(await run_one(engine, greedy_request(follow, 3)))
+            b_fresh = collect_tokens(await run_one(fresh, greedy_request(follow, 3)))
+            assert b_warm == b_fresh
+        finally:
+            await engine.stop()
+            await fresh.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_seeded_sampling_reproducible():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            def seeded(seed):
+                req = greedy_request([3, 1, 4, 1, 5], 8)
+                req.sampling.temperature = 0.9
+                req.sampling.seed = seed
+                return req
+
+            a = collect_tokens(await run_one(engine, seeded(7)))
+            b = collect_tokens(await run_one(engine, seeded(7)))
+            c = collect_tokens(await run_one(engine, seeded(8)))
+            assert a == b
+            assert a != c  # overwhelmingly likely with temp 0.9
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_frequency_penalty_discourages_repeats():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            req = greedy_request([2, 2, 2], 12)
+            base = collect_tokens(await run_one(engine, req))
+            req2 = greedy_request([2, 2, 2], 12)
+            req2.sampling.frequency_penalty = 2.0
+            pen = collect_tokens(await run_one(engine, req2))
+            # greedy with a strong penalty must diverge from unpenalized
+            # greedy whenever the base repeats a token
+            if len(set(base)) < len(base):
+                assert pen != base
+            # penalized run has strictly fewer repeats than an all-same run
+            assert len(set(pen)) > 1 or len(set(base)) == 1
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_rejects_bad_input_without_dying():
+    """Malformed requests error their own stream; the engine survives."""
+
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            bad_empty = await run_one(engine, greedy_request([], 4))
+            assert bad_empty[-1]["finish_reason"] == "error"
+            bad_range = await run_one(engine, greedy_request([1, -5], 4))
+            assert bad_range[-1]["finish_reason"] == "error"
+            ok = await run_one(engine, greedy_request([1, 2, 3], 4))
+            assert ok[-1]["finish_reason"] == "length"
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_metrics_snapshot():
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            await run_one(engine, greedy_request([1, 2, 3], 3))
+            m = engine.metrics()
+            assert m.worker.request_total_slots == 4
+            assert m.kv.kv_total_blocks == 63
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())

@@ -1,0 +1,292 @@
+"""LLM protocol layer tests: tokenizer/DecodeStream, preprocessor, backend
+stop jail, protocols round-trips, model cards."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, StopJail
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, model_key, parse_model_key
+from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
+from dynamo_tpu.llm.protocols import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    FinishReason,
+    LLMEngineOutput,
+    OpenAIError,
+    PreprocessedRequest,
+    parse_sse_lines,
+    sse_event,
+)
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+from dynamo_tpu.runtime.engine import Context, collect
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello wörld 漢字 🎉"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+
+
+def test_decode_stream_never_splits_multibyte():
+    tok = ByteTokenizer()
+    text = "héllo 漢字 🎉 done"
+    ids = tok.encode(text)
+    ds = DecodeStream(tok)
+    pieces = []
+    for t in ids:
+        p = ds.step(t)
+        if p is not None:
+            pieces.append(p)
+    tail = ds.flush()
+    if tail:
+        pieces.append(tail)
+    assert "".join(pieces) == text
+    # no piece may contain a replacement char (would mean a split char)
+    assert all("�" not in p for p in pieces)
+
+
+# -- protocols ---------------------------------------------------------------
+
+
+def test_preprocessed_request_roundtrip():
+    req = PreprocessedRequest(model="m", token_ids=[1, 2, 3])
+    req.stop.max_tokens = 7
+    req.sampling.temperature = 0.5
+    d = req.to_dict()
+    back = PreprocessedRequest.from_dict(d)
+    assert back.model == "m" and back.token_ids == [1, 2, 3]
+    assert back.stop.max_tokens == 7 and back.sampling.temperature == 0.5
+
+
+def test_chat_request_validation():
+    with pytest.raises(OpenAIError):
+        ChatCompletionRequest.parse({"model": "m", "messages": []})
+    with pytest.raises(OpenAIError):
+        ChatCompletionRequest.parse({"messages": [{"role": "user", "content": "x"}]})
+    r = ChatCompletionRequest.parse(
+        {"model": "m", "messages": [{"role": "user", "content": "hi"}], "stop": "END",
+         "max_tokens": 5, "temperature": 0.1}
+    )
+    assert r.stop == ["END"] and r.max_tokens == 5
+
+
+def test_completion_request_token_prompt():
+    r = CompletionRequest.parse({"model": "m", "prompt": [1, 2, 3]})
+    assert r.prompt == [1, 2, 3]
+
+
+def test_sse_codec_roundtrip():
+    chunks = [sse_event('{"a": 1}'), b"data: [DONE]\n\n"]
+    got = list(parse_sse_lines(chunks))
+    assert got == ['{"a": 1}', "[DONE]"]
+
+
+def test_model_key_roundtrip():
+    key = model_key("ns", "llama-3", 0xBEEF)
+    assert parse_model_key(key) == ("ns", "llama-3", 0xBEEF)
+    assert parse_model_key("instances/x/y") is None
+
+
+def test_model_card_bytes_roundtrip():
+    card = ModelDeploymentCard(name="Meta/Llama-X", context_length=123, migration_limit=3)
+    back = ModelDeploymentCard.from_bytes(card.to_bytes())
+    assert back.name == "Meta/Llama-X" and back.context_length == 123
+    assert back.slug == "meta-llama-x"
+
+
+# -- preprocessor ------------------------------------------------------------
+
+
+def make_pre(context_length=512) -> OpenAIPreprocessor:
+    card = ModelDeploymentCard(name="test-model", context_length=context_length)
+    return OpenAIPreprocessor(card)
+
+
+def test_preprocess_chat_renders_template_and_tokenizes():
+    pre = make_pre()
+    req = ChatCompletionRequest.parse(
+        {"model": "test-model",
+         "messages": [{"role": "user", "content": "hi"}],
+         "nvext": {"annotations": ["formatted_prompt", "token_ids"]}}
+    )
+    out = pre.preprocess_chat(req)
+    prompt = out.annotations["formatted_prompt"]
+    assert "<|user|>" in prompt and prompt.endswith("<|assistant|>\n")
+    assert out.token_ids == pre.tokenizer.encode(prompt)
+    assert out.stop.max_tokens == 512 - len(out.token_ids)
+
+
+def test_preprocess_rejects_oversized_prompt():
+    pre = make_pre(context_length=4)
+    req = ChatCompletionRequest.parse(
+        {"model": "m", "messages": [{"role": "user", "content": "much too long"}]}
+    )
+    with pytest.raises(OpenAIError):
+        pre.preprocess_chat(req)
+
+
+def test_max_tokens_clamped_to_context():
+    pre = make_pre(context_length=64)
+    req = CompletionRequest.parse({"model": "m", "prompt": "abc", "max_tokens": 10_000})
+    out = pre.preprocess_completion(req)
+    assert out.stop.max_tokens == 64 - 3
+
+
+# -- stop jail ---------------------------------------------------------------
+
+
+def test_stop_jail_holds_and_releases():
+    j = StopJail(["STOP"])
+    out, hit = j.push("hello S")
+    assert out == "hello " and not hit  # "S" jailed
+    out, hit = j.push("T")
+    assert out == "" and not hit        # "ST" jailed
+    out, hit = j.push("ill going")
+    assert out == "STill going" and not hit  # mismatch → release
+
+
+def test_stop_jail_truncates_on_match():
+    j = StopJail(["END"])
+    out, hit = j.push("result: 42 END extra")
+    assert out == "result: 42 " and hit
+
+
+def test_stop_jail_multiple_sequences_earliest_wins():
+    j = StopJail(["ZZZ", "b"])
+    out, hit = j.push("a b c ZZZ")
+    assert hit and out == "a "
+
+
+# -- backend -----------------------------------------------------------------
+
+
+class FakeTokenEngine:
+    """Emits the given token ids one per delta."""
+
+    def __init__(self, token_ids, finish=FinishReason.LENGTH):
+        self.token_ids = token_ids
+        self.finish = finish
+
+    async def generate(self, request, context):
+        for i, t in enumerate(self.token_ids):
+            last = i == len(self.token_ids) - 1
+            yield LLMEngineOutput(
+                token_ids=[t], finish_reason=self.finish if last else None
+            ).to_dict()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def backend_collect(engine, req):
+    tok = ByteTokenizer()
+    backend = Backend(engine, tok)
+
+    async def go():
+        return await collect(backend.generate(req, Context()))
+
+    return run(go())
+
+
+def test_backend_detokenizes_stream():
+    tok = ByteTokenizer()
+    ids = tok.encode("hello world")
+    req = PreprocessedRequest(model="m", token_ids=[1])
+    outs = backend_collect(FakeTokenEngine(ids), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    assert text == "hello world"
+    assert outs[-1]["finish_reason"] == "length"
+
+
+def test_backend_stop_string_truncates():
+    tok = ByteTokenizer()
+    ids = tok.encode("the answer END hidden")
+    req = PreprocessedRequest(model="m", token_ids=[1])
+    req.stop.stop = ["END"]
+    outs = backend_collect(FakeTokenEngine(ids), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    assert text == "the answer "
+    assert outs[-1]["finish_reason"] == "stop"
+
+
+def test_backend_eos_token_stops():
+    tok = ByteTokenizer()
+    ids = tok.encode("ok") + [ByteTokenizer.EOS] + tok.encode("never")
+    req = PreprocessedRequest(model="m", token_ids=[1], eos_token_ids=[ByteTokenizer.EOS])
+    outs = backend_collect(FakeTokenEngine(ids), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    assert text == "ok"
+    assert outs[-1]["finish_reason"] == "stop"
+
+
+def test_backend_ignore_eos():
+    tok = ByteTokenizer()
+    ids = tok.encode("a") + [ByteTokenizer.EOS] + tok.encode("b")
+    req = PreprocessedRequest(model="m", token_ids=[1], eos_token_ids=[ByteTokenizer.EOS])
+    req.stop.ignore_eos = True
+    outs = backend_collect(FakeTokenEngine(ids), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    assert text == "ab"
+
+
+def test_backend_min_tokens_defers_eos():
+    tok = ByteTokenizer()
+    ids = [ByteTokenizer.EOS] + tok.encode("xy")
+    req = PreprocessedRequest(model="m", token_ids=[1], eos_token_ids=[ByteTokenizer.EOS])
+    req.stop.min_tokens = 2
+    outs = backend_collect(FakeTokenEngine(ids), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    # eos at position 1 ignored (min_tokens=2); stream runs to the end
+    assert "x" in text
+
+
+def test_backend_eos_flushes_jailed_text():
+    """Regression: text held in the stop jail when an eos arrives is real
+    output and must be flushed, not dropped."""
+    tok = ByteTokenizer()
+    ids = tok.encode("a#") + [ByteTokenizer.EOS]
+    req = PreprocessedRequest(model="m", token_ids=[1], eos_token_ids=[ByteTokenizer.EOS])
+    req.stop.stop = ["##"]  # "#" gets jailed as a possible prefix
+    outs = backend_collect(FakeTokenEngine(ids), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    assert text == "a#"
+    assert outs[-1]["finish_reason"] == "stop"
+
+
+def test_backend_flush_path_stop_match_reports_stop():
+    """Regression: a stop string discovered in the end-of-stream flush must
+    report finish_reason 'stop', not the engine's reason."""
+    tok = ByteTokenizer()
+    ids = tok.encode("x END")
+    req = PreprocessedRequest(model="m", token_ids=[1])
+    req.stop.stop = ["END"]
+    # Engine claims LENGTH on the last token; "END" only resolves at flush.
+    outs = backend_collect(FakeTokenEngine(ids, finish=FinishReason.LENGTH), req)
+    text = "".join(o.get("text") or "" for o in outs)
+    assert text == "x "
+    assert outs[-1]["finish_reason"] == "stop"
+
+
+# -- delta generator ---------------------------------------------------------
+
+
+def test_delta_generator_chat_stream_and_aggregate():
+    gen = DeltaGenerator(model="m", kind="chat")
+    chunks = []
+    chunks += gen.on_delta("Hel", 1, None)
+    chunks += gen.on_delta("lo", 1, None)
+    chunks += gen.on_delta(None, 0, "stop")
+    # first chunk carries the role
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    text = "".join(c["choices"][0]["delta"].get("content") or "" for c in chunks)
+    assert text == "Hello"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    final = gen.final_response()
+    assert final["choices"][0]["message"]["content"] == "Hello"
+    assert final["usage"]["completion_tokens"] == 2
