@@ -125,11 +125,6 @@ class TpuEngine:
         self._thread.start()
         return self
 
-    def start_sync(self) -> "TpuEngine":
-        """Synchronous start for non-asyncio drivers (bench.py)."""
-        self._init_device_state()
-        return self
-
     def _init_device_state(self) -> None:
         if self._params is None:
             key = jax.random.PRNGKey(self._seed)

@@ -118,8 +118,7 @@ def _logits(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def prefill(
+def prefill_impl(
     cfg: ModelConfig,
     params: Params,
     cache: KVCache,
@@ -220,8 +219,7 @@ def prefill(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def decode_step(
+def decode_step_impl(
     cfg: ModelConfig,
     params: Params,
     cache: KVCache,
@@ -284,3 +282,8 @@ def decode_step(
 
     logits = _logits(cfg, params, x)  # [B, V]
     return logits, KVCache(k_cache, v_cache)
+
+
+# Jitted entry points (static model config, donated cache).
+prefill = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(prefill_impl)
+decode_step = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(decode_step_impl)

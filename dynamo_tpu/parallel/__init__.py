@@ -1,0 +1,12 @@
+"""Parallelism: device meshes + sharding rules.
+
+The reference delegates intra-model parallelism to its engines' NCCL
+(reference: components/backends/trtllm/src/dynamo/trtllm/utils/
+trtllm_utils.py:131-143, SURVEY §2.6); here the engine is ours, so TP/DP
+live in-repo the TPU way: a ``jax.sharding.Mesh`` with NamedShardings on
+params/cache/batch, XLA inserting the collectives over ICI.
+"""
+
+from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
+
+__all__ = ["build_mesh", "ModelSharding"]

@@ -1,0 +1,93 @@
+"""Mesh construction + model sharding rules (tensor / data parallel).
+
+TP layout (Megatron-style column→row, expressed as shardings — XLA
+derives the collectives; reference analogue is engine-internal NCCL TP,
+SURVEY §2.6):
+
+- attention: wq/wk/wv sharded on the head output dim ("column"), wo on
+  the head input dim ("row") → one implicit all-reduce per attention
+  block; KV cache sharded on the kv-head axis so paged reads/writes stay
+  device-local.
+- MLP: w_gate/w_up column-sharded on intermediate, w_down row-sharded →
+  one all-reduce per MLP.
+- embed / lm_head / norms replicated (logits land replicated; sampling
+  is tiny). Vocab sharding is a later optimization.
+
+DP: the engine batch dimension can additionally shard over a ``dp`` axis
+(used by the multichip dryrun); production DP-attention runs one worker
+process per dp rank, as the reference does (dsr1_dep.sh:86-105).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def build_mesh(tp: int = 1, dp: int = 1, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = tp * dp
+    if len(devices) < need:
+        raise ValueError(f"mesh {dp}x{tp} needs {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(dp, tp)
+    return Mesh(grid, (DP_AXIS, TP_AXIS))
+
+
+class ModelSharding:
+    """Sharding rules for one model on one mesh. Passed to TpuEngine;
+    ``shard_params``/``shard_cache`` place arrays, ``batch_spec`` shards
+    engine step inputs over dp."""
+
+    def __init__(self, mesh: Mesh, cfg: ModelConfig):
+        self.mesh = mesh
+        self.cfg = cfg
+        tp = mesh.shape[TP_AXIS]
+        if cfg.num_heads % tp:
+            raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
+        if cfg.num_kv_heads % tp:
+            raise ValueError(f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
+        if cfg.intermediate_size % tp:
+            raise ValueError(f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}")
+
+    def _ns(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def param_shardings(self) -> dict[str, Any]:
+        rep = self._ns()
+        col = self._ns(None, None, TP_AXIS)   # [L, D, out] — shard out
+        row = self._ns(None, TP_AXIS, None)   # [L, in, D] — shard in
+        shardings = {
+            "embed": rep,
+            "final_norm": rep,
+            "layers": {
+                "wq": col, "wk": col, "wv": col, "wo": row,
+                "w_gate": col, "w_up": col, "w_down": row,
+                "attn_norm": rep, "mlp_norm": rep,
+            },
+        }
+        if not self.cfg.tie_embeddings:
+            shardings["lm_head"] = rep
+        return shardings
+
+    def cache_spec(self) -> P:
+        # [L, num_blocks, block_size, KVH, hd] — shard kv heads.
+        return P(None, None, None, TP_AXIS, None)
+
+    def batch_spec(self) -> P:
+        return P(DP_AXIS)
+
+    def shard_params(self, params: Any) -> Any:
+        return jax.device_put(params, self.param_shardings())
+
+    def shard_cache(self, cache) -> tuple[jax.Array, jax.Array]:
+        ns = self._ns(*self.cache_spec())
+        return jax.device_put(cache.k, ns), jax.device_put(cache.v, ns)
