@@ -27,10 +27,12 @@ import numpy as np
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama-1b")
-    p.add_argument("--num-requests", type=int, default=32)
+    p.add_argument("--num-requests", type=int, default=128)
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--gen-len", type=int, default=128)
-    p.add_argument("--max-num-seqs", type=int, default=16)
+    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--decode-steps", type=int, default=32,
+                   help="fused decode substeps per host sync")
     p.add_argument("--cpu", action="store_true", help="force CPU + tiny model (dev)")
     return p.parse_args()
 
@@ -56,7 +58,9 @@ async def bench(args) -> dict:
     device = str(jax.devices()[0])
 
     block_size = 16
-    seq_len = args.prompt_len + args.gen_len
+    # Headroom so multi-step windows never fall back to the per-step path
+    # mid-run (which would compile inside the timed section).
+    seq_len = args.prompt_len + args.gen_len + args.decode_steps
     blocks_per_seq = (seq_len + block_size - 1) // block_size + 1
     eargs = EngineArgs(
         model=model,
@@ -66,6 +70,7 @@ async def bench(args) -> dict:
         max_model_len=(blocks_per_seq + 1) * block_size,
         max_prefill_tokens=max(512, args.prompt_len),
         dtype="float32" if args.cpu else "bfloat16",
+        decode_steps=args.decode_steps,
     )
     engine = await TpuEngine(eargs, seed=0).start()
 
@@ -87,16 +92,17 @@ async def bench(args) -> dict:
                 first_token_t.append(time.perf_counter())
         return n
 
-    # Warmup: ramp through ALL decode batch buckets + the prefill bucket.
-    # Admission is one request per step, so each warmup request must live
-    # long enough (≥ ~2×max_num_seqs steps) for concurrency to actually
-    # reach the largest bucket — otherwise bucket-max compiles inside the
-    # timed section.
+    # Warmup: compile every decode batch bucket (the measured run's batch
+    # occupancy drifts through them as requests finish) + the prefill
+    # bucket. The K=1 fallback path stays cold by design: the measured run
+    # cannot reach it (greedy sampling + decode_steps of max_model_len
+    # headroom + a 2x-provisioned block pool).
     t0 = time.perf_counter()
-    warm = [make_req(i) for i in range(args.max_num_seqs)]
-    for w in warm:
-        w.stop.max_tokens = 2 * args.max_num_seqs + 8
-    await asyncio.gather(*(run_one(w) for w in warm))
+    for n in eargs.decode_buckets:
+        warm = [make_req(i) for i in range(n)]
+        for w in warm:
+            w.stop.max_tokens = args.decode_steps + 2
+        await asyncio.gather(*(run_one(w) for w in warm))
     warmup_s = time.perf_counter() - t0
 
     # TTFT: single request, quiet engine.

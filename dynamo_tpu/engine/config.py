@@ -113,6 +113,10 @@ class EngineArgs:
     tp: int = 1
     enforce_eager: bool = False          # skip jit (debug)
     prefix_caching: bool = True
+    # Fused decode substeps per host sync (model.multi_decode). >1 is the
+    # key throughput lever when host↔device roundtrips are slow; tokens
+    # stream in bursts of this size. 1 = classic per-step loop.
+    decode_steps: int = 8
 
     def __post_init__(self):
         if self.max_model_len % self.block_size:

@@ -10,9 +10,13 @@ and load metrics.
 Threading model: JAX dispatch is blocking, so the scheduler loop runs in a
 dedicated thread; asyncio callers submit requests through a lock-guarded
 queue and receive ``LLMEngineOutput`` dicts on per-request asyncio queues
-via ``loop.call_soon_threadsafe``. One host↔device sync per decode step
-(the sampled token ids), which is the standard cost of host-driven
-continuous batching; everything else stays on device.
+via ``loop.call_soon_threadsafe``.
+
+Host↔device sync budget (the latency cost model): one sync per
+``decode_steps``-token fused window (model.multi_decode feeds sampled
+tokens back on device) and one per admission wave (all first tokens
+sampled together). Per-step syncing (decode_steps=1) is the fallback for
+full-sampler batches and near-max_model_len sequences.
 """
 
 from __future__ import annotations
@@ -31,7 +35,7 @@ import jax.numpy as jnp
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import model as M
 from dynamo_tpu.engine.config import EngineArgs
-from dynamo_tpu.engine.sampler import needs_full, sample_full, sample_simple
+from dynamo_tpu.engine.sampler import needs_full, row_needs_full, sample_full, sample_simple
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.engine import Context
@@ -41,6 +45,10 @@ from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
 log = get_logger("engine")
 
 _SENTINEL_DONE = object()
+
+
+class RequestValidationError(Exception):
+    """Client error (clean rejection, no stack trace)."""
 
 
 class _Seq:
@@ -132,6 +140,11 @@ class TpuEngine:
         self._cache = M.init_kv_cache(
             self.cfg, self.args.num_kv_blocks, self.args.block_size, jnp.dtype(self.args.dtype)
         )
+        if self._sharding is None and self.args.tp > 1:
+            # EngineArgs.tp is the CLI-level knob; explicit sharding= wins.
+            from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
+
+            self._sharding = ModelSharding(build_mesh(tp=self.args.tp), self.cfg)
         if self._sharding is not None:
             self._params = self._sharding.shard_params(self._params)
             self._cache = M.KVCache(*self._sharding.shard_cache(self._cache))
@@ -253,27 +266,54 @@ class TpuEngine:
 
     def _step(self) -> None:
         self._reap_cancelled()
-        # Prefill-priority admission (one per step keeps decode cadence).
-        if self._waiting and len(self._running) < self.args.max_num_seqs:
+        # Prefill-priority admission. Prefill dispatches are async; the
+        # whole admission wave shares ONE first-token sampling sync — on
+        # high-latency host↔device links a per-admission sync dominates.
+        # The wave is budgeted to ~one max_prefill_tokens chunk so running
+        # decodes are not starved by a long burst of arrivals.
+        admitted: list[tuple[_Seq, jax.Array]] = []
+        wave_budget = self.args.max_prefill_tokens
+        while (
+            self._waiting
+            and len(self._running) + len(admitted) < self.args.max_num_seqs
+            and (wave_budget > 0 or not admitted)
+        ):
             seq = self._waiting.popleft()
             if seq.cancelled:
                 self._post_done(seq)
-            else:
-                try:
-                    self._admit(seq)
-                except NoFreeBlocksError:
-                    self._waiting.appendleft(seq)  # try again when blocks free up
-                    if not self._running:
-                        # Deadlock: nothing to free. Fail the request.
-                        self._waiting.popleft()
-                        self._finish(seq, FinishReason.ERROR,
-                                     error="prompt does not fit in KV cache")
-                except Exception as e:  # noqa: BLE001 — contain per-request faults
-                    log.exception("admission failed for %s", seq.request_id)
-                    if seq.block_ids:
-                        self.pool.free_sequence(seq.block_ids)
-                        seq.block_ids = []
-                    self._finish(seq, FinishReason.ERROR, error=f"admission failed: {e}")
+                continue
+            wave_budget -= len(seq.tokens)
+            try:
+                logits = self._prefill_seq(seq)
+            except NoFreeBlocksError:
+                self._waiting.appendleft(seq)  # try again when blocks free up
+                if not self._running and not admitted:
+                    # Deadlock: nothing to free. Fail the request.
+                    self._waiting.popleft()
+                    self._finish(seq, FinishReason.ERROR,
+                                 error="prompt does not fit in KV cache")
+                break
+            except RequestValidationError as e:
+                self._finish(seq, FinishReason.ERROR, error=str(e))
+                continue
+            except Exception as e:  # noqa: BLE001 — contain per-request faults
+                log.exception("admission failed for %s", seq.request_id)
+                if seq.block_ids:
+                    self.pool.free_sequence(seq.block_ids)
+                    seq.block_ids = []
+                self._finish(seq, FinishReason.ERROR, error=f"admission failed: {e}")
+                continue
+            admitted.append((seq, logits))
+        if admitted:
+            # Pad the wave to a decode bucket so sampling compiles once per
+            # bucket, not once per distinct wave size.
+            B = self.args.bucket_decode(len(admitted))
+            rows = [l for _, l in admitted]
+            rows += [rows[0]] * (B - len(rows))
+            first = self._sample_rows(jnp.stack(rows), [s for s, _ in admitted])
+            for i, (seq, _) in enumerate(admitted):
+                self._running.append(seq)
+                self._emit_tokens(seq, [int(first[i])])
         if self._running:
             self._decode_iteration()
 
@@ -286,13 +326,14 @@ class TpuEngine:
 
     # -- admission / prefill ----------------------------------------------
 
-    def _admit(self, seq: _Seq) -> None:
+    def _prefill_seq(self, seq: _Seq) -> jax.Array:
+        """Allocate + chunked prefill; returns last-token logits [V]
+        (async, not synced). Raises on resource/validation failure."""
         bs = self.args.block_size
         prompt = seq.tokens
         plen = len(prompt)
         if plen > self.args.max_model_len - 1:
-            self._finish(seq, FinishReason.ERROR, error="prompt exceeds max_model_len")
-            return
+            raise RequestValidationError("prompt exceeds max_model_len")
         hashes = compute_block_hashes(prompt, bs)
         # Never reuse the *entire* prompt: at least one suffix token must be
         # computed to produce logits (vLLM rule).
@@ -329,11 +370,8 @@ class TpuEngine:
         # Prompt positions are now resident in HBM; register their blocks.
         seq.kv_written = plen
         self._register_written_blocks(seq)
-
-        # First sampled token.
-        token = self._sample_rows(logits[None, :], [seq])[0]
-        self._running.append(seq)
-        self._emit_token(seq, token)
+        assert logits is not None  # plen >= 1 → at least one chunk ran
+        return logits
 
     def _register_written_blocks(self, seq: _Seq) -> None:
         """Register sealed blocks whose KV is fully written. A block sealed
@@ -357,9 +395,11 @@ class TpuEngine:
 
     # -- decode ------------------------------------------------------------
 
-    def _ensure_block(self, seq: _Seq) -> bool:
-        """Make sure the write position has a block; grow by one if needed."""
-        while len(seq.block_ids) * self.args.block_size <= seq.next_write_pos:
+    def _ensure_block(self, seq: _Seq, lookahead: int = 1) -> bool:
+        """Cover write positions [next_write_pos, next_write_pos+lookahead)
+        with blocks; grow as needed."""
+        last_pos = seq.next_write_pos + lookahead - 1
+        while len(seq.block_ids) * self.args.block_size <= last_pos:
             try:
                 seq.block_ids.append(self.pool.allocate_block())
             except NoFreeBlocksError:
@@ -381,11 +421,21 @@ class TpuEngine:
         self._waiting.appendleft(seq)
 
     def _decode_iteration(self) -> None:
-        # Grow block tables; under KV pressure preempt newest-first. A lone
-        # sequence that cannot grow is finished (cache physically too small
-        # for prompt+generation) instead of preempt-looping forever.
+        # Fused multi-step when every sequence has headroom and the batch
+        # only needs simple sampling; else classic per-step.
+        K = max(1, self.args.decode_steps)
+        if K > 1:
+            for s in self._running:
+                if len(s.tokens) + K > self.args.max_model_len or self._needs_full_sampler(s):
+                    K = 1
+                    break
+        # Grow block tables K ahead; under KV pressure preempt newest-first.
+        # A lone sequence that cannot grow is finished (cache physically too
+        # small for prompt+generation) instead of preempt-looping forever.
         while self._running:
-            blocked = next((s for s in self._running if not self._ensure_block(s)), None)
+            blocked = next(
+                (s for s in self._running if not self._ensure_block(s, lookahead=K)), None
+            )
             if blocked is None:
                 break
             if len(self._running) == 1:
@@ -407,18 +457,44 @@ class TpuEngine:
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
 
-        logits, self._cache = M.decode_step(
-            self.cfg, self._params, self._cache,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(tables), jnp.asarray(active),
-        )
-        # The step just wrote each sequence's KV at `positions[i]`.
-        for i, seq in enumerate(batch):
-            seq.kv_written = int(positions[i]) + 1
-            self._register_written_blocks(seq)
-        sampled = self._sample_rows(logits, batch)
-        for i, seq in enumerate(batch):
-            self._emit_token(seq, int(sampled[i]))
+        if K > 1:
+            temps = np.ones((B,), np.float32)
+            seeds = np.zeros((B,), np.uint32)
+            steps0 = np.zeros((B,), np.int32)
+            for i, s in enumerate(batch):
+                temps[i] = s.sampling.temperature
+                seeds[i] = s.sample_seed
+                steps0[i] = s.emitted
+            greedy_only = bool(all(s.sampling.temperature < 1e-5 for s in batch))
+            toks, self._cache = M.multi_decode(
+                self.cfg, K, greedy_only, self._params, self._cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(active),
+                jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
+            )
+            toks_np = np.asarray(toks)  # [K, B] — the one host sync
+            for i, seq in enumerate(batch):
+                seq.kv_written = int(positions[i]) + K
+                self._register_written_blocks(seq)
+                self._emit_tokens(seq, [int(toks_np[j, i]) for j in range(K)])
+        else:
+            logits, self._cache = M.decode_step(
+                self.cfg, self._params, self._cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jnp.asarray(active),
+            )
+            # The step just wrote each sequence's KV at `positions[i]`.
+            for i, seq in enumerate(batch):
+                seq.kv_written = int(positions[i]) + 1
+                self._register_written_blocks(seq)
+            sampled = self._sample_rows(logits, batch)
+            for i, seq in enumerate(batch):
+                self._emit_tokens(seq, [int(sampled[i])])
+
+    @staticmethod
+    def _needs_full_sampler(seq: _Seq) -> bool:
+        s = seq.sampling
+        return row_needs_full(s.top_k, s.top_p, s.frequency_penalty, s.presence_penalty)
 
     def _sample_rows(self, logits: jax.Array, seqs: list[_Seq]) -> np.ndarray:
         """Sample one token per row for the first len(seqs) rows."""
@@ -460,27 +536,36 @@ class TpuEngine:
 
     # -- token emission / finish ------------------------------------------
 
-    def _emit_token(self, seq: _Seq, token: int) -> None:
-        token = int(token)  # numpy scalar → msgpack-able python int
-        seq.tokens.append(token)
-        seq.emitted += 1
-        self.total_generated += 1
-        # Block-hash bookkeeping only; registration waits until the sealed
-        # block's KV is fully written (_register_written_blocks).
-        if seq.block_seq is not None:
-            seq.block_seq.append(token)
+    def _emit_tokens(self, seq: _Seq, toks: list[int]) -> None:
+        """Append sampled tokens (a multi-step window or a single token),
+        truncating at the first stop condition. Posts ONE output delta with
+        the kept tokens — tokens past a mid-window stop are wasted device
+        work, never surfaced."""
+        kept: list[int] = []
         finish: FinishReason | None = None
-        if (
-            token in seq.eos_ids
-            and not seq.stop.ignore_eos
-            and seq.emitted >= seq.stop.min_tokens  # eos counts toward min (vLLM)
-        ):
-            finish = FinishReason.STOP
-        elif seq.stop.max_tokens is not None and seq.emitted >= seq.stop.max_tokens:
-            finish = FinishReason.LENGTH
-        elif len(seq.tokens) >= self.args.max_model_len:
-            finish = FinishReason.LENGTH
-        self._post(seq, LLMEngineOutput(token_ids=[token], finish_reason=finish).to_dict())
+        for token in toks:
+            token = int(token)  # numpy scalar → msgpack-able python int
+            seq.tokens.append(token)
+            seq.emitted += 1
+            self.total_generated += 1
+            kept.append(token)
+            # Block-hash bookkeeping only; registration waits until the
+            # sealed block's KV is fully written (_register_written_blocks).
+            if seq.block_seq is not None:
+                seq.block_seq.append(token)
+            if (
+                token in seq.eos_ids
+                and not seq.stop.ignore_eos
+                and seq.emitted >= seq.stop.min_tokens  # eos counts toward min (vLLM)
+            ):
+                finish = FinishReason.STOP
+            elif seq.stop.max_tokens is not None and seq.emitted >= seq.stop.max_tokens:
+                finish = FinishReason.LENGTH
+            elif len(seq.tokens) >= self.args.max_model_len:
+                finish = FinishReason.LENGTH
+            if finish is not None:
+                break
+        self._post(seq, LLMEngineOutput(token_ids=kept, finish_reason=finish).to_dict())
         if finish is not None:
             self._finish(seq, finish, already_posted=True)
 

@@ -98,11 +98,19 @@ def sample_full(
     return jnp.take_along_axis(sidx, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
 
+def row_needs_full(top_k, top_p, freq_penalty, pres_penalty) -> bool:
+    """Does one request's sampling config require the full sampler? The
+    single source of truth for the simple/full split."""
+    return bool(
+        (top_k and top_k > 0)
+        or (top_p is not None and top_p < 1.0)
+        or freq_penalty
+        or pres_penalty
+    )
+
+
 def needs_full(top_ks, top_ps, freqs, press) -> bool:
     """Host-side variant choice for a batch."""
-    return (
-        any(k and k > 0 for k in top_ks)
-        or any(p is not None and p < 1.0 for p in top_ps)
-        or any(f for f in freqs)
-        or any(p for p in press)
+    return any(
+        row_needs_full(k, p, f, pr) for k, p, f, pr in zip(top_ks, top_ps, freqs, press)
     )

@@ -406,6 +406,35 @@ def test_engine_frequency_penalty_discourages_repeats():
     asyncio.run(go())
 
 
+def test_engine_multi_step_matches_single_step():
+    """Fused multi_decode (decode_steps>1) must reproduce the per-step
+    path exactly — greedy and seeded sampling."""
+
+    async def go():
+        multi = await TpuEngine(make_args(decode_steps=8)).start()
+        single = await TpuEngine(make_args(decode_steps=1)).start()
+        try:
+            prompt = [4, 5, 6, 7, 8]
+            a = collect_tokens(await run_one(multi, greedy_request(prompt, 13)))
+            b = collect_tokens(await run_one(single, greedy_request(prompt, 13)))
+            assert a == b and len(a) == 13
+
+            def seeded():
+                r = greedy_request(prompt, 13)
+                r.sampling.temperature = 0.8
+                r.sampling.seed = 123
+                return r
+
+            c = collect_tokens(await run_one(multi, seeded()))
+            d = collect_tokens(await run_one(single, seeded()))
+            assert c == d
+        finally:
+            await multi.stop()
+            await single.stop()
+
+    asyncio.run(go())
+
+
 def test_engine_rejects_bad_input_without_dying():
     """Malformed requests error their own stream; the engine survives."""
 

@@ -83,8 +83,8 @@ def test_sharded_engine_matches_unsharded_greedy():
         r.stop.max_tokens = 8
         return r
 
-    async def run_engine(sharding):
-        engine = await TpuEngine(args, sharding=sharding, seed=0).start()
+    async def run_engine(engine_args):
+        engine = await TpuEngine(engine_args, seed=0).start()
         try:
             out = []
             async for item in engine.generate(req(), Context()):
@@ -93,7 +93,7 @@ def test_sharded_engine_matches_unsharded_greedy():
         finally:
             await engine.stop()
 
-    plain = asyncio.run(run_engine(None))
-    mesh = build_mesh(tp=2, dp=1)
-    sharded = asyncio.run(run_engine(ModelSharding(mesh, CFG)))
+    # tp=2 in EngineArgs builds the mesh + shardings internally.
+    plain = asyncio.run(run_engine(args.replace(tp=1)))
+    sharded = asyncio.run(run_engine(args))
     assert plain == sharded
