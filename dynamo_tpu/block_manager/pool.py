@@ -67,6 +67,12 @@ class BlockPool:
 
     # -- events -----------------------------------------------------------
 
+    def set_event_sink(self, sink: EventSink | None) -> None:
+        """Late-bind the event sink (workers construct engine-then-
+        broadcaster). Events emitted before binding are recoverable via
+        snapshot()."""
+        self._event_sink = sink
+
     def _emit(self, event: KvCacheEvent) -> None:
         if self._event_sink is not None:
             self._event_id += 1
@@ -208,6 +214,15 @@ class BlockPool:
     def free_sequence(self, block_ids: list[int]) -> None:
         for bid in block_ids:
             self._unref(bid)
+
+    def snapshot(self) -> list[tuple[int, int | None]]:
+        """All currently-registered (hash, parent_hash) pairs in original
+        registration order (parents before children — dict insertion
+        order). Used to seed a new KV-event subscriber."""
+        out = []
+        for h, bid in self._cached.items():
+            out.append((h, self._blocks[bid].parent_hash))
+        return out
 
     def clear(self) -> None:
         """Drop every cached (ref 0) block — admin /clear_kv_blocks path

@@ -1,0 +1,68 @@
+"""ApproxKvIndexer: predicted-cache index for engines that publish no KV
+events.
+
+Reference analogue: lib/llm/src/kv_router/approx.rs:166-294 — on each
+routing decision, optimistically record the request's blocks as present
+on the chosen worker with a TTL (the reference uses 120 s, matching
+typical engine cache residency); expired entries lapse lazily. Same
+``find_matches`` interface as the real index.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+
+from dynamo_tpu.kv_router.indexer import OverlapScores, WorkerId
+
+DEFAULT_TTL_S = 120.0
+
+
+class ApproxKvIndexer:
+    def __init__(self, ttl_s: float = DEFAULT_TTL_S, clock=time.monotonic):
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._by_hash: dict[int, dict[WorkerId, float]] = {}  # hash → worker → expiry
+        self._heap: list[tuple[float, int, WorkerId]] = []
+
+    def _expire(self) -> None:
+        now = self._clock()
+        while self._heap and self._heap[0][0] <= now:
+            _, h, w = heapq.heappop(self._heap)
+            workers = self._by_hash.get(h)
+            if workers is not None:
+                exp = workers.get(w)
+                if exp is not None and exp <= now:
+                    del workers[w]
+                    if not workers:
+                        del self._by_hash[h]
+
+    def record_routing(self, worker: WorkerId, seq_hashes: list[int]) -> None:
+        """The request was sent to `worker`: assume its blocks will be (or
+        are) cached there for the TTL."""
+        exp = self._clock() + self.ttl_s
+        for h in seq_hashes:
+            self._by_hash.setdefault(h, {})[worker] = exp
+            heapq.heappush(self._heap, (exp, h, worker))
+
+    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+        self._expire()
+        scores: dict[WorkerId, int] = {}
+        alive: set[WorkerId] | None = None
+        for depth, h in enumerate(seq_hashes, start=1):
+            present = self._by_hash.get(h)
+            if not present:
+                break
+            current = set(present) if alive is None else (alive & set(present))
+            if not current:
+                break
+            for w in current:
+                scores[w] = depth
+            alive = current
+        return OverlapScores(scores)
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        for h in [h for h, ws in self._by_hash.items() if worker in ws]:
+            self._by_hash[h].pop(worker, None)
+            if not self._by_hash[h]:
+                del self._by_hash[h]

@@ -1,0 +1,146 @@
+"""Global KV index: which worker holds which cached blocks.
+
+Reference analogue: the radix tree + event-driven indexer
+(reference: lib/llm/src/kv_router/indexer.rs:222-446,641-766).
+
+Because block identity is the *chained* sequence hash (tokens.py), the
+"radix tree" collapses to a hash-keyed node table: a node's key already
+encodes its whole prefix, so matching a request is walking its hash list
+until a miss, accumulating per-worker consecutive-match depth. Node
+children links exist for cascade-removal bookkeeping.
+
+The reference also hardens against event gaps with per-worker event_id
+tracking; we mirror that: a gap triggers a full drop of the worker's
+state (the subscription layer re-snapshots).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from dynamo_tpu.kv_router.protocols import CLEARED, REMOVED, STORED, KvCacheEvent
+
+WorkerId = int
+
+
+@dataclass
+class OverlapScores:
+    """worker → number of consecutive prompt blocks already cached there."""
+
+    scores: dict[WorkerId, int] = field(default_factory=dict)
+
+    def best(self) -> int:
+        return max(self.scores.values(), default=0)
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "children", "workers")
+
+    def __init__(self, h: int, parent: int | None):
+        self.hash = h
+        self.parent = parent
+        self.children: set[int] = set()
+        self.workers: set[WorkerId] = set()
+
+
+class RadixIndex:
+    """Single-threaded (asyncio) index over chained block hashes."""
+
+    def __init__(self):
+        self._nodes: dict[int, _Node] = {}
+        self._worker_blocks: dict[WorkerId, set[int]] = {}
+        self._worker_event_ids: dict[WorkerId, int] = {}
+
+    # -- queries ----------------------------------------------------------
+
+    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+        """Per-worker consecutive-prefix depth over the request's block
+        hash chain."""
+        scores: dict[WorkerId, int] = {}
+        alive: set[WorkerId] | None = None
+        for depth, h in enumerate(seq_hashes, start=1):
+            node = self._nodes.get(h)
+            if node is None or not node.workers:
+                break
+            current = node.workers if alive is None else (alive & node.workers)
+            if not current:
+                break
+            for w in current:
+                scores[w] = depth
+            alive = set(current)
+        return OverlapScores(scores)
+
+    def workers(self) -> set[WorkerId]:
+        return set(self._worker_blocks)
+
+    def num_blocks(self, worker: WorkerId) -> int:
+        return len(self._worker_blocks.get(worker, ()))
+
+    # -- event application -------------------------------------------------
+
+    def apply(self, worker: WorkerId, event: KvCacheEvent) -> bool:
+        """Apply one worker event. Returns False when an event-id gap was
+        detected (caller should drop + resubscribe the worker)."""
+        if event.event_id == 0:
+            # Pre-stream events (subscription reset marker / snapshot):
+            # outside the gap-tracked live sequence.
+            if event.kind == CLEARED:
+                self.remove_worker(worker)
+                return True
+        else:
+            last = self._worker_event_ids.get(worker)
+            if last is not None and event.event_id != last + 1:
+                self.remove_worker(worker)
+                return False
+            self._worker_event_ids[worker] = event.event_id
+        if event.kind == STORED:
+            for b in event.blocks:
+                self._store(worker, b.block_hash, b.parent_hash)
+        elif event.kind == REMOVED:
+            for h in event.block_hashes:
+                self._remove(worker, h)
+        elif event.kind == CLEARED:
+            blocks = self._worker_blocks.get(worker, set())
+            for h in list(blocks):
+                self._remove(worker, h)
+        return True
+
+    def _store(self, worker: WorkerId, h: int, parent: int | None) -> None:
+        node = self._nodes.get(h)
+        if node is None:
+            node = self._nodes[h] = _Node(h, parent)
+            if parent is not None:
+                pnode = self._nodes.get(parent)
+                if pnode is not None:
+                    pnode.children.add(h)
+        node.workers.add(worker)
+        self._worker_blocks.setdefault(worker, set()).add(h)
+
+    def _remove(self, worker: WorkerId, h: int) -> None:
+        node = self._nodes.get(h)
+        if node is None:
+            return
+        node.workers.discard(worker)
+        blocks = self._worker_blocks.get(worker)
+        if blocks is not None:
+            blocks.discard(h)
+        self._prune(node)
+
+    def _prune(self, node: _Node) -> None:
+        # Iterative: block chains can be thousands deep (long contexts).
+        while not node.workers and not node.children:
+            self._nodes.pop(node.hash, None)
+            if node.parent is None:
+                return
+            pnode = self._nodes.get(node.parent)
+            if pnode is None:
+                return
+            pnode.children.discard(node.hash)
+            node = pnode
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        """Worker died or resubscribed: drop all its blocks."""
+        for h in list(self._worker_blocks.get(worker, ())):
+            self._remove(worker, h)
+        self._worker_blocks.pop(worker, None)
+        self._worker_event_ids.pop(worker, None)

@@ -1,0 +1,211 @@
+"""KvPushRouter: the KV-cache-aware routing engine.
+
+Reference analogue: ``KvRouter``/``KvPushRouter`` (reference: lib/llm/src/
+kv_router.rs:225-369): hash the request's prompt blocks, look up per-worker
+prefix overlap in the live index, pick the lowest-cost worker (softmax
+temperature), inject ``estimated_prefix_hit_num_blocks``, direct-route, and
+track the request in the active-sequence ledger until its stream ends.
+
+Index freshness: one KV-event stream subscription per live worker instance
+(publisher.KvEventSubscription), reconciled against discovery; a worker
+vanishing (lease expiry or stream death) drops its index state. Engines
+that publish no events run in ``use_kv_events=False`` mode with the
+TTL-predictive ApproxKvIndexer (reference: kv_router.rs:170-176).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.indexer import RadixIndex
+from dynamo_tpu.kv_router.publisher import KvEventSubscription
+from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.messaging import NoHandlerError, TruncatedStreamError
+from dynamo_tpu.runtime.push_router import NoInstancesError, PushRouter
+from dynamo_tpu.tokens import compute_block_hashes
+
+log = get_logger("kv_router")
+
+
+@dataclass
+class KvRouterConfig:
+    block_size: int = 16
+    overlap_score_weight: float = 1.0
+    router_temperature: float = 0.0
+    use_kv_events: bool = True
+    approx_ttl_s: float = 120.0
+    max_attempts: int = 3
+
+
+class KvPushRouter:
+    """AsyncEngine shape over a DIRECT PushRouter."""
+
+    def __init__(self, push_router: PushRouter, config: KvRouterConfig | None = None):
+        self.config = config or KvRouterConfig()
+        self.push = push_router
+        self.discovery = push_router.discovery
+        self.messaging = push_router.messaging
+        self.scheduler = KvScheduler(
+            KvSchedulerConfig(
+                overlap_score_weight=self.config.overlap_score_weight,
+                router_temperature=self.config.router_temperature,
+            )
+        )
+        self.active = ActiveSequences()
+        if self.config.use_kv_events:
+            self.index: RadixIndex | ApproxKvIndexer = RadixIndex()
+        else:
+            self.index = ApproxKvIndexer(ttl_s=self.config.approx_ttl_s)
+        self._subs: dict[int, KvEventSubscription] = {}
+        self._sub_started: dict[int, float] = {}
+        self._sync_task: asyncio.Task | None = None
+        self._resync = asyncio.Event()
+        self._bg_tasks: set[asyncio.Task] = set()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> "KvPushRouter":
+        if self.config.use_kv_events and self._sync_task is None:
+            self._reconcile()
+            self._sync_task = asyncio.get_running_loop().create_task(self._sync_loop())
+        return self
+
+    async def close(self) -> None:
+        if self._sync_task is not None:
+            self._sync_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._sync_task
+        for sub in list(self._subs.values()):
+            await sub.close()
+        self._subs.clear()
+
+    async def _sync_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            v = self.discovery.version  # read BEFORE reconcile: no lost wakeup
+            self._resync.clear()
+            self._reconcile()
+            waiter = loop.create_task(self.discovery.wait_changed(v))
+            resync = loop.create_task(self._resync.wait())
+            try:
+                await asyncio.wait({waiter, resync}, return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                waiter.cancel()
+                resync.cancel()
+
+    def _spawn(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+
+    def _reconcile(self) -> None:
+        assert isinstance(self.index, RadixIndex)
+        live = {i.instance_id: i for i in self.discovery.available()}
+        for wid in list(self._subs):
+            if wid not in live:
+                sub = self._subs.pop(wid)
+                self._spawn(sub.close())
+                self.index.remove_worker(wid)
+                self.active.remove_worker(wid)
+        for wid, inst in live.items():
+            if wid not in self._subs:
+                sub = KvEventSubscription(
+                    self.messaging, inst, self.index.apply, self._on_sub_end
+                )
+                self._subs[wid] = sub
+                self._sub_started[wid] = asyncio.get_running_loop().time()
+                sub.start()
+
+    def _on_sub_end(self, wid: int) -> None:
+        # Stream died (worker gone or event gap): drop state; if the worker
+        # is still discovered, the reconcile pass resubscribes fresh. A
+        # subscription that died young (endpoint missing/broken) is retried
+        # with a delay so a permanently-failing worker can't hot-loop us.
+        self._subs.pop(wid, None)
+        if isinstance(self.index, RadixIndex):
+            self.index.remove_worker(wid)
+        loop = asyncio.get_running_loop()
+        lifetime = loop.time() - self._sub_started.pop(wid, 0.0)
+        if lifetime < 1.0:
+            loop.call_later(1.0, self._resync.set)
+        else:
+            self._resync.set()
+
+    # -- routing ----------------------------------------------------------
+
+    def _place(self, token_ids: list[int], excluded: set[int] = frozenset()):
+        """Shared placement recipe: hash → overlap lookup → cost schedule.
+        → (Placement, hashes). Raises NoInstancesError when no candidate."""
+        bs = self.config.block_size
+        hashes = compute_block_hashes(token_ids, bs)
+        request_blocks = max(1, (len(token_ids) + bs - 1) // bs)
+        workers = [w for w in self.discovery.instance_ids() if w not in excluded]
+        if not workers:
+            raise NoInstancesError("no available instances")
+        overlaps = self.index.find_matches(hashes)
+        placement = self.scheduler.schedule(workers, request_blocks, overlaps, self.active)
+        return placement, hashes
+
+    def find_best_match(self, token_ids: list[int]) -> tuple[int, int]:
+        """→ (worker_instance_id, overlap_blocks) without routing — the
+        reference's `query_instance_id` surface (kv_router.rs:225-264)."""
+        placement, _ = self._place(token_ids)
+        return placement.worker, placement.overlap_blocks
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        token_ids = list(request.get("token_ids") or []) if isinstance(request, dict) else []
+
+        if isinstance(request, dict) and request.get("annotations", {}).get("query_instance_id"):
+            wid, overlap = self.find_best_match(token_ids)
+            yield {"worker_instance_id": wid, "overlap_blocks": overlap}
+            return
+
+        attempts = 0
+        excluded: set[int] = set()
+        last_err: Exception | None = None
+        while attempts < self.config.max_attempts:
+            attempts += 1
+            try:
+                placement, hashes = self._place(token_ids, excluded)
+            except NoInstancesError:
+                break
+            wid = placement.worker
+            if isinstance(request, dict):
+                request = dict(request)
+                request["estimated_prefix_hit_num_blocks"] = placement.overlap_blocks
+            self.active.add_request(
+                context.id, wid, placement.total_blocks, placement.overlap_blocks, len(token_ids)
+            )
+            if isinstance(self.index, ApproxKvIndexer):
+                self.index.record_routing(wid, hashes)
+            first = True
+            try:
+                async for item in self.push.generate(request, context, instance_id=wid):
+                    if first:
+                        first = False
+                        self.active.mark_prefill_complete(context.id)
+                    yield item
+                return
+            except (
+                NoInstancesError,  # worker vanished between placement and dispatch
+                TruncatedStreamError,
+                NoHandlerError,
+                ConnectionError,
+                OSError,
+            ) as e:
+                last_err = e
+                if not first:
+                    raise  # mid-stream death: Migration's responsibility
+                log.warning("kv route to %x failed pre-stream: %s", wid, e)
+                excluded.add(wid)
+                continue
+            finally:
+                self.active.free(context.id)
+        raise last_err or NoInstancesError("no available instances")

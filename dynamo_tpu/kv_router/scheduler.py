@@ -1,0 +1,87 @@
+"""KV-aware worker selection: overlap-weighted cost + softmax sampling.
+
+Reference analogue: lib/llm/src/kv_router/scheduler.rs —
+cost = ``overlap_score_weight × potential_prefill_blocks +
+potential_decode_blocks`` per worker, min-max normalized, then
+softmax-sampled with ``router_temperature`` (0 ⇒ deterministic argmin;
+scheduler.rs:272-340,356-439). Temperature>0 spreads bursts of identical
+prompts across workers instead of herding them onto one.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+
+WorkerId = int
+
+
+@dataclass
+class KvSchedulerConfig:
+    overlap_score_weight: float = 1.0
+    router_temperature: float = 0.0
+
+
+@dataclass
+class Placement:
+    worker: WorkerId
+    overlap_blocks: int
+    total_blocks: int
+
+
+class KvScheduler:
+    def __init__(self, config: KvSchedulerConfig | None = None, rng: random.Random | None = None):
+        self.config = config or KvSchedulerConfig()
+        self._rng = rng or random.Random()
+
+    def schedule(
+        self,
+        workers: list[WorkerId],
+        request_blocks: int,
+        overlaps: OverlapScores,
+        active: ActiveSequences,
+    ) -> Placement:
+        """Pick a worker for a request spanning ``request_blocks`` blocks."""
+        if not workers:
+            raise ValueError("no workers")
+        costs: list[float] = []
+        for w in workers:
+            overlap = min(overlaps.scores.get(w, 0), request_blocks)
+            potential_prefill = request_blocks - overlap
+            potential_decode = active.active_blocks(w) + request_blocks
+            costs.append(
+                self.config.overlap_score_weight * potential_prefill + potential_decode
+            )
+        idx = softmax_sample(costs, self.config.router_temperature, self._rng)
+        w = workers[idx]
+        return Placement(
+            worker=w,
+            overlap_blocks=min(overlaps.scores.get(w, 0), request_blocks),
+            total_blocks=request_blocks,
+        )
+
+
+def softmax_sample(costs: list[float], temperature: float, rng: random.Random) -> int:
+    """Sample an index ∝ softmax(-normalized_cost / temperature).
+    temperature <= 0 → argmin (ties broken at random, as the reference
+    does to avoid herding)."""
+    lo, hi = min(costs), max(costs)
+    if temperature <= 0.0 or hi == lo:
+        best = [i for i, c in enumerate(costs) if c == lo]
+        return rng.choice(best)
+    norm = [(c - lo) / (hi - lo) for c in costs]
+    logits = [-n / temperature for n in norm]
+    m = max(logits)
+    exps = [math.exp(l - m) for l in logits]
+    total = sum(exps)
+    r = rng.random() * total
+    acc = 0.0
+    for i, e in enumerate(exps):
+        acc += e
+        if r <= acc:
+            return i
+    return len(costs) - 1

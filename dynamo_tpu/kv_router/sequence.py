@@ -1,0 +1,72 @@
+"""Active-sequence tracking: the router's view of each worker's load.
+
+Reference analogue: ``ActiveSequences``/``ActiveSequencesMultiWorker``
+(reference: lib/llm/src/kv_router/sequence.rs:51-232,240-521): per worker,
+the blocks and tokens of requests it is currently serving — *including*
+the request being placed ("potential" load) — with prefill-complete and
+free transitions. The cost scheduler reads these to balance load.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+WorkerId = int
+
+
+@dataclass
+class _ActiveReq:
+    worker: WorkerId
+    new_blocks: int      # blocks this request adds (non-overlapping)
+    tokens: int          # prompt tokens still prefilling (0 once complete)
+
+
+class ActiveSequences:
+    """Multi-worker active-request ledger (router-side bookkeeping only —
+    workers are the source of truth for their real usage)."""
+
+    def __init__(self):
+        self._reqs: dict[str, _ActiveReq] = {}
+        self._blocks: dict[WorkerId, int] = {}
+        self._prefill_tokens: dict[WorkerId, int] = {}
+        self._count: dict[WorkerId, int] = {}
+
+    def add_request(
+        self, request_id: str, worker: WorkerId, total_blocks: int, overlap_blocks: int, prompt_tokens: int
+    ) -> None:
+        new_blocks = max(0, total_blocks - overlap_blocks)
+        self._reqs[request_id] = _ActiveReq(worker, new_blocks, prompt_tokens)
+        self._blocks[worker] = self._blocks.get(worker, 0) + new_blocks
+        self._prefill_tokens[worker] = self._prefill_tokens.get(worker, 0) + prompt_tokens
+        self._count[worker] = self._count.get(worker, 0) + 1
+
+    def mark_prefill_complete(self, request_id: str) -> None:
+        req = self._reqs.get(request_id)
+        if req is not None and req.tokens:
+            self._prefill_tokens[req.worker] -= req.tokens
+            req.tokens = 0
+
+    def free(self, request_id: str) -> None:
+        req = self._reqs.pop(request_id, None)
+        if req is None:
+            return
+        self._blocks[req.worker] = self._blocks.get(req.worker, 0) - req.new_blocks
+        if req.tokens:
+            self._prefill_tokens[req.worker] -= req.tokens
+        self._count[req.worker] = self._count.get(req.worker, 0) - 1
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        for rid in [r for r, req in self._reqs.items() if req.worker == worker]:
+            self._reqs.pop(rid)
+        self._blocks.pop(worker, None)
+        self._prefill_tokens.pop(worker, None)
+        self._count.pop(worker, None)
+
+    def active_blocks(self, worker: WorkerId) -> int:
+        return self._blocks.get(worker, 0)
+
+    def prefill_tokens(self, worker: WorkerId) -> int:
+        return self._prefill_tokens.get(worker, 0)
+
+    def active_count(self, worker: WorkerId) -> int:
+        return self._count.get(worker, 0)

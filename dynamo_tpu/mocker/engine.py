@@ -1,0 +1,145 @@
+"""MockerEngine: streams deterministic tokens with simulated timing while
+driving a real BlockPool (prefix caching, eviction, KV events, metrics).
+
+Timing model (reference: mocker/scheduler.rs cost model, simplified):
+TTFT = ``ttft_ms`` + ``prefill_ms_per_token`` × uncached-prompt-tokens;
+then one token every ``itl_ms``. A ``speedup`` divides everything for
+fast tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
+
+
+@dataclass
+class MockerArgs:
+    block_size: int = 16
+    num_kv_blocks: int = 512
+    max_num_seqs: int = 64
+    ttft_ms: float = 20.0
+    prefill_ms_per_token: float = 0.05
+    itl_ms: float = 5.0
+    speedup: float = 1.0
+
+    def scaled(self, ms: float) -> float:
+        return ms / (1000.0 * self.speedup)
+
+
+class MockerEngine:
+    """AsyncEngine shape: PreprocessedRequest dict in → LLMEngineOutput
+    dicts out. Echoes the prompt cyclically as its "generation"."""
+
+    def __init__(self, args: MockerArgs | None = None, event_sink=None):
+        self.args = args or MockerArgs()
+        self.pool = BlockPool(
+            self.args.num_kv_blocks, self.args.block_size, event_sink=event_sink
+        )
+        self._active = 0
+        self._waiting = 0
+        self._slots = asyncio.Semaphore(self.args.max_num_seqs)
+        self.total_generated = 0
+
+    def metrics(self) -> ForwardPassMetrics:
+        return ForwardPassMetrics(
+            worker=WorkerStats(
+                request_active_slots=self._active,
+                request_total_slots=self.args.max_num_seqs,
+                num_requests_waiting=self._waiting,
+            ),
+            kv=KvStats(
+                kv_active_blocks=self.pool.num_active,
+                kv_total_blocks=self.pool.num_blocks - 1,
+                gpu_cache_usage_perc=self.pool.usage,
+                gpu_prefix_cache_hit_rate=self.pool.hit_rate,
+            ),
+        )
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_dict(request)
+        if not req.token_ids:
+            yield LLMEngineOutput(finish_reason=FinishReason.ERROR, error="empty prompt").to_dict()
+            return
+        self._waiting += 1
+        acquired = False
+        try:
+            await self._slots.acquire()
+            acquired = True
+            self._waiting -= 1
+            self._active += 1
+            try:
+                async for item in self._run(req, context):
+                    yield item
+            finally:
+                self._active -= 1
+        finally:
+            if acquired:
+                self._slots.release()
+            else:
+                self._waiting -= 1  # abandoned while queued
+
+    async def _run(self, req: PreprocessedRequest, context: Context) -> AsyncIterator[dict]:
+        a = self.args
+        bs = a.block_size
+        prompt = req.token_ids
+        plen = len(prompt)
+        max_hit = (plen - 1) // bs
+        hashes = compute_block_hashes(prompt, bs)[:max_hit]
+        total_blocks = (plen + bs - 1) // bs
+        try:
+            block_ids, n_hit = self.pool.allocate_sequence(hashes, total_blocks)
+        except NoFreeBlocksError:
+            yield LLMEngineOutput(
+                finish_reason=FinishReason.ERROR, error="KV cache exhausted"
+            ).to_dict()
+            return
+        block_seq = TokenBlockSequence(prompt, bs)
+        try:
+            # Simulated prefill: cached prefix blocks are free.
+            uncached = plen - n_hit * bs
+            await asyncio.sleep(a.scaled(a.ttft_ms + a.prefill_ms_per_token * uncached))
+            for i, blk in enumerate(block_seq.blocks):
+                self.pool.register_block(block_ids[i], blk.sequence_hash, blk.parent_sequence_hash)
+
+            max_tokens = req.stop.max_tokens or 64
+            eos = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
+            emitted = 0
+            while emitted < max_tokens:
+                if emitted:
+                    await asyncio.sleep(a.scaled(a.itl_ms))
+                if context.cancelled:
+                    yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED).to_dict()
+                    return
+                token = prompt[emitted % plen]  # deterministic echo
+                if block_seq.total_tokens + 1 > len(block_ids) * bs:
+                    try:
+                        block_ids.append(self.pool.allocate_block())
+                    except NoFreeBlocksError:
+                        yield LLMEngineOutput(finish_reason=FinishReason.LENGTH).to_dict()
+                        return
+                sealed = block_seq.append(token)
+                emitted += 1
+                self.total_generated += 1
+                if sealed is not None:
+                    idx = len(block_seq.blocks) - 1
+                    self.pool.register_block(
+                        block_ids[idx], sealed.sequence_hash, sealed.parent_sequence_hash
+                    )
+                finish = None
+                if token in eos and not req.stop.ignore_eos and emitted >= req.stop.min_tokens:
+                    finish = FinishReason.STOP
+                elif emitted >= max_tokens:
+                    finish = FinishReason.LENGTH
+                yield LLMEngineOutput(token_ids=[token], finish_reason=finish).to_dict()
+                if finish is not None:
+                    return
+        finally:
+            self.pool.free_sequence(block_ids)

@@ -27,6 +27,7 @@ class DiscoveryClient:
         self._instances: dict[str, Instance] = {}
         self._down: set[int] = set()
         self._changed = asyncio.Event()
+        self._version = 0
         self._watch = None
         self._watch_task: asyncio.Task | None = None
         self._started = False
@@ -38,7 +39,7 @@ class DiscoveryClient:
         self._watch = await self._store.watch_prefix(self._prefix)
         for entry in self._watch.snapshot:
             self._instances[entry.key] = Instance.from_bytes(entry.value)
-        self._changed.set()
+        self._notify_changed()
         self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
         return self
 
@@ -54,7 +55,7 @@ class DiscoveryClient:
                     inst = self._instances.pop(ev.key, None)
                     if inst is not None:
                         self._down.discard(inst.instance_id)
-                self._changed.set()
+                self._notify_changed()
         except asyncio.CancelledError:
             pass
 
@@ -80,23 +81,50 @@ class DiscoveryClient:
         (reference: client.rs:134-143). Cleared when the watch shows the
         instance re-register or vanish."""
         self._down.add(instance_id)
+        self._notify_changed()
+
+    def _notify_changed(self) -> None:
+        self._version += 1
         self._changed.set()
+
+    @property
+    def version(self) -> int:
+        """Monotonic change counter. Read it before acting on the instance
+        set, then pass it to wait_changed to avoid lost wakeups."""
+        return self._version
+
+    async def wait_changed(self, seen_version: int, timeout: float | None = None) -> int:
+        """Block until the instance set has changed past ``seen_version``
+        (returns immediately if it already has — no lost-wakeup window).
+        Returns the current version."""
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        while self._version == seen_version:
+            self._changed.clear()
+            if self._version != seen_version:  # changed between check & clear
+                break
+            remaining = None if deadline is None else max(0.0, deadline - loop.time())
+            if remaining == 0.0:
+                break
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        return self._version
 
     async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[Instance]:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
-        while len(self.available()) < n:
+        while True:
+            v = self._version  # read BEFORE the state check (no lost wakeup)
+            if len(self.available()) >= n:
+                return self.available()
             remaining = deadline - loop.time()
             if remaining <= 0:
                 raise TimeoutError(
                     f"{self._prefix}: {len(self.available())}/{n} instances after {timeout}s"
                 )
-            self._changed.clear()
-            try:
-                await asyncio.wait_for(self._changed.wait(), remaining)
-            except asyncio.TimeoutError:
-                pass
-        return self.available()
+            await self.wait_changed(v, remaining)
 
     async def close(self) -> None:
         if self._watch_task is not None:

@@ -49,10 +49,17 @@ class SystemHealth:
 class ServeHandle:
     """Returned by Endpoint.serve; closes cleanly: deregister → drain."""
 
-    def __init__(self, runtime: "DistributedRuntime", inst: Instance, key: str):
+    def __init__(
+        self,
+        runtime: "DistributedRuntime",
+        inst: Instance,
+        key: str,
+        drain_timeout: float | None = None,
+    ):
         self.runtime = runtime
         self.instance = inst
         self.key = key
+        self.drain_timeout = drain_timeout
         self._closed = False
 
     async def close(self) -> None:
@@ -63,7 +70,12 @@ class ServeHandle:
             await self.runtime.store.delete(self.key)
         server = self.runtime._server
         if server is not None:
-            await server.drain(self.instance.subject, self.runtime.config.runtime.graceful_shutdown_timeout)
+            timeout = (
+                self.drain_timeout
+                if self.drain_timeout is not None
+                else self.runtime.config.runtime.graceful_shutdown_timeout
+            )
+            await server.drain(self.instance.subject, timeout)
         self.runtime.health.endpoint_health.pop(self.instance.subject, None)
 
 
@@ -80,12 +92,17 @@ class Endpoint:
     def subject(self) -> str:
         return endpoint_subject(self.namespace, self.component.name, self.name)
 
-    async def serve(self, handler: Handler) -> ServeHandle:
+    async def serve(self, handler: Handler, drain_timeout: float | None = None) -> ServeHandle:
         """Register a streaming handler and advertise a live instance.
 
         The handler has the AsyncEngine shape: (payload, Context) → async
-        iterator of msgpack-able payloads."""
-        return await self.component.namespace.runtime._serve(self, handler)
+        iterator of msgpack-able payloads.
+
+        ``drain_timeout`` overrides the graceful-shutdown wait for this
+        endpoint; 0 cancels in-flight streams immediately — required for
+        endpoints serving never-ending infrastructure streams (KV event
+        subscriptions)."""
+        return await self.component.namespace.runtime._serve(self, handler, drain_timeout)
 
     async def serve_engine(self, engine: AsyncEngine) -> ServeHandle:
         async def handler(payload: Any, ctx: Context):
@@ -182,7 +199,9 @@ class DistributedRuntime:
             ).start()
         return self._server
 
-    async def _serve(self, endpoint: Endpoint, handler: Handler) -> ServeHandle:
+    async def _serve(
+        self, endpoint: Endpoint, handler: Handler, drain_timeout: float | None = None
+    ) -> ServeHandle:
         server = await self._ensure_server()
         lease_id = await self.primary_lease()
         server.register(endpoint.subject, handler)
@@ -197,7 +216,7 @@ class DistributedRuntime:
         key = instance_key(inst.namespace, inst.component, inst.endpoint, lease_id)
         await self.store.put(key, inst.to_bytes(), lease_id=lease_id)
         self.health.set_endpoint_health(endpoint.subject, True)
-        handle = ServeHandle(self, inst, key)
+        handle = ServeHandle(self, inst, key, drain_timeout)
         self._handles.append(handle)
         log.info("serving %s as instance %x at %s:%d", endpoint.subject, lease_id, inst.host, inst.port)
         return handle

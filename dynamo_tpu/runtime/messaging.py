@@ -21,6 +21,7 @@ Wire frames (msgpack maps):
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import uuid
 from typing import Any, AsyncIterator, Awaitable, Callable
 
@@ -65,6 +66,8 @@ class EndpointServer:
         self._inflight: dict[str, int] = {}
         self._draining: set[str] = set()
         self._idle: dict[str, asyncio.Event] = {}
+        self._subject_ctxs: dict[str, set[Context]] = {}
+        self._writers: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> "EndpointServer":
         self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
@@ -90,24 +93,42 @@ class EndpointServer:
         return self._inflight.get(subject, 0)
 
     async def drain(self, subject: str, timeout: float = 30.0) -> None:
-        """Stop accepting new requests for subject; wait for in-flight ones.
+        """Stop accepting new requests for subject; wait up to ``timeout``
+        for in-flight ones, then cancel stragglers (long-lived
+        infrastructure streams — KV event subscriptions — never end on
+        their own; endpoints that serve them use timeout 0).
 
-        Graceful-shutdown path (reference: push_endpoint.rs graceful shutdown
-        with inflight counter)."""
+        Graceful-shutdown path (reference: push_endpoint.rs graceful
+        shutdown with inflight counter)."""
         self._draining.add(subject)
         if self._inflight.get(subject, 0) > 0:
-            try:
-                await asyncio.wait_for(self._idle[subject].wait(), timeout)
-            except asyncio.TimeoutError:
-                log.warning("drain timeout for %s (%d inflight)", subject, self._inflight[subject])
+            if timeout > 0:
+                try:
+                    await asyncio.wait_for(self._idle[subject].wait(), timeout)
+                except asyncio.TimeoutError:
+                    log.warning(
+                        "drain timeout for %s (%d inflight); cancelling",
+                        subject, self._inflight[subject],
+                    )
+            for ctx in list(self._subject_ctxs.get(subject, ())):
+                ctx.cancel()
+            # One scheduling round for handlers to observe cancellation.
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self._idle[subject].wait(), 1.0)
         self.unregister(subject)
 
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Python 3.12 wait_closed() waits for ALL connections, and
+            # clients keep pooled connections open — close them ourselves.
+            for w in list(self._writers):
+                with contextlib.suppress(Exception):
+                    w.close()
             await self._server.wait_closed()
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
         write_lock = asyncio.Lock()
         tasks: dict[str, asyncio.Task] = {}
         contexts: dict[str, Context] = {}
@@ -136,6 +157,7 @@ class EndpointServer:
                     if ctx is not None:
                         ctx.cancel()
         finally:
+            self._writers.discard(writer)
             for ctx in contexts.values():
                 ctx.cancel()
             for task in list(tasks.values()):
@@ -161,6 +183,7 @@ class EndpointServer:
             return
         self._inflight[subject] += 1
         self._idle[subject].clear()
+        self._subject_ctxs.setdefault(subject, set()).add(ctx)
         token = set_current_trace(ctx.trace)
         try:
             async for item in handler(msg.get("payload"), ctx):
@@ -180,6 +203,7 @@ class EndpointServer:
                 pass
         finally:
             reset_current_trace(token)
+            self._subject_ctxs.get(subject, set()).discard(ctx)
             self._inflight[subject] -= 1
             if self._inflight[subject] == 0:
                 self._idle[subject].set()

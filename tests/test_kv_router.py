@@ -1,0 +1,251 @@
+"""KV router tests: radix index, scheduler cost/softmax, active sequences,
+approx indexer — then end-to-end: mocker workers over the runtime with a
+KvPushRouter concentrating prefix-sharing requests on the warm worker
+(the reference's router e2e shape, tests/router/test_router_e2e_with_mockers.py)."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.indexer import RadixIndex
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, StoredBlock
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig, softmax_sample
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.tokens import compute_block_hashes
+
+
+def stored(worker, h, parent=None, eid=1):
+    return worker, KvCacheEvent.stored([StoredBlock(h, parent)], event_id=eid)
+
+
+# -- radix index -------------------------------------------------------------
+
+
+def test_index_find_matches_consecutive_depth():
+    idx = RadixIndex()
+    # worker 1 has chain a->b->c; worker 2 has a->b
+    for eid, (h, p) in enumerate([(10, None), (20, 10), (30, 20)], start=1):
+        idx.apply(1, KvCacheEvent.stored([StoredBlock(h, p)], event_id=eid))
+    for eid, (h, p) in enumerate([(10, None), (20, 10)], start=1):
+        idx.apply(2, KvCacheEvent.stored([StoredBlock(h, p)], event_id=eid))
+    m = idx.find_matches([10, 20, 30])
+    assert m.scores == {1: 3, 2: 2}
+    m2 = idx.find_matches([10, 99])
+    assert m2.scores == {1: 1, 2: 1}
+    assert idx.find_matches([99]).scores == {}
+
+
+def test_index_removed_and_worker_drop():
+    idx = RadixIndex()
+    idx.apply(1, KvCacheEvent.stored([StoredBlock(10, None)], event_id=1))
+    idx.apply(1, KvCacheEvent.stored([StoredBlock(20, 10)], event_id=2))
+    idx.apply(1, KvCacheEvent.removed([20], event_id=3))
+    assert idx.find_matches([10, 20]).scores == {1: 1}
+    idx.remove_worker(1)
+    assert idx.find_matches([10]).scores == {}
+
+
+def test_index_event_gap_detected():
+    idx = RadixIndex()
+    assert idx.apply(1, KvCacheEvent.stored([StoredBlock(10, None)], event_id=1))
+    assert not idx.apply(1, KvCacheEvent.stored([StoredBlock(20, 10)], event_id=3))
+    assert idx.find_matches([10]).scores == {}  # worker state dropped
+
+
+def test_index_snapshot_events_bypass_gap_tracking():
+    idx = RadixIndex()
+    idx.apply(1, KvCacheEvent.cleared(event_id=0))
+    idx.apply(1, KvCacheEvent.stored([StoredBlock(10, None)], event_id=0))  # snapshot
+    assert idx.apply(1, KvCacheEvent.stored([StoredBlock(20, 10)], event_id=7))  # first live
+    assert idx.find_matches([10, 20]).scores == {1: 2}
+
+
+# -- scheduler ---------------------------------------------------------------
+
+
+def test_scheduler_prefers_overlap():
+    idx = RadixIndex()
+    for eid, (h, p) in enumerate([(1, None), (2, 1), (3, 2)], start=1):
+        idx.apply(7, KvCacheEvent.stored([StoredBlock(h, p)], event_id=eid))
+    sched = KvScheduler(KvSchedulerConfig(overlap_score_weight=1.0, router_temperature=0.0))
+    active = ActiveSequences()
+    placement = sched.schedule([7, 8], 4, idx.find_matches([1, 2, 3, 4]), active)
+    assert placement.worker == 7 and placement.overlap_blocks == 3
+
+
+def test_scheduler_balances_load_without_overlap():
+    sched = KvScheduler(KvSchedulerConfig(router_temperature=0.0))
+    active = ActiveSequences()
+    active.add_request("r1", 7, total_blocks=50, overlap_blocks=0, prompt_tokens=100)
+    placement = sched.schedule([7, 8], 4, RadixIndex().find_matches([]), active)
+    assert placement.worker == 8  # 7 is loaded
+
+
+def test_softmax_sample_temperature():
+    rng = random.Random(0)
+    costs = [1.0, 5.0, 9.0]
+    # temp 0 → argmin always
+    assert all(softmax_sample(costs, 0.0, rng) == 0 for _ in range(20))
+    # high temp → all indices appear
+    seen = {softmax_sample(costs, 10.0, rng) for _ in range(300)}
+    assert seen == {0, 1, 2}
+
+
+def test_active_sequences_lifecycle():
+    a = ActiveSequences()
+    a.add_request("r1", 1, total_blocks=10, overlap_blocks=4, prompt_tokens=160)
+    assert a.active_blocks(1) == 6 and a.prefill_tokens(1) == 160
+    a.mark_prefill_complete("r1")
+    assert a.prefill_tokens(1) == 0
+    a.free("r1")
+    assert a.active_blocks(1) == 0 and a.active_count(1) == 0
+
+
+def test_approx_indexer_ttl():
+    now = [0.0]
+    idx = ApproxKvIndexer(ttl_s=10.0, clock=lambda: now[0])
+    idx.record_routing(1, [10, 20])
+    assert idx.find_matches([10, 20]).scores == {1: 2}
+    now[0] = 11.0
+    assert idx.find_matches([10, 20]).scores == {}
+
+
+# -- e2e: mockers + KvPushRouter over the runtime ----------------------------
+
+
+BS = 4
+
+
+async def start_mock_worker(store_url, namespace="kvtest", component="backend"):
+    rt = await DistributedRuntime.create(store_url=store_url)
+    args = MockerArgs(block_size=BS, num_kv_blocks=256, speedup=1000.0)
+    engine = MockerEngine(args)
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+
+    comp = rt.namespace(namespace).component(component)
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await comp.endpoint("generate").serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    return rt, engine
+
+
+def make_request(prompt, max_tokens=4):
+    r = PreprocessedRequest(model="mock", token_ids=list(prompt))
+    r.stop.max_tokens = max_tokens
+    return r.to_dict()
+
+
+def test_kv_router_concentrates_prefix_traffic():
+    async def go():
+        url = "memory://kvr1"
+        rt_a, eng_a = await start_mock_worker(url)
+        rt_b, eng_b = await start_mock_worker(url)
+        rt_c = await DistributedRuntime.create(store_url=url)
+        ep = rt_c.namespace("kvtest").component("backend").endpoint("generate")
+        push = await ep.router(RouterMode.DIRECT)
+        await push.discovery.wait_for_instances(2)
+        router = await KvPushRouter(push, KvRouterConfig(block_size=BS)).start()
+        try:
+            shared_prefix = list(range(1, 17))  # 4 full blocks
+            # Request 1: lands somewhere, warms that worker.
+            ctx1 = Context()
+            out1 = [i async for i in router.generate(make_request(shared_prefix + [50]), ctx1)]
+            assert out1, "stream must produce deltas"
+            warm = ctx1.metadata["worker_instance_id"]
+            await asyncio.sleep(0.05)  # let kv events propagate
+            # Next requests share the prefix → must all hit the warm worker.
+            for i in range(6):
+                ctx = Context()
+                _ = [x async for x in router.generate(make_request(shared_prefix + [60 + i]), ctx)]
+                assert ctx.metadata["worker_instance_id"] == warm
+                await asyncio.sleep(0.02)
+            # Both engines exist but only the warm one generated everything.
+            warm_engine = eng_a if warm == await _wid(rt_a) else eng_b
+            cold_engine = eng_b if warm_engine is eng_a else eng_a
+            assert warm_engine.total_generated >= 7 * 4
+            assert cold_engine.total_generated == 0
+            assert warm_engine.pool.hit_blocks > 0  # prefix reuse actually happened
+        finally:
+            await router.close()
+            await rt_c.shutdown()
+            await rt_a.shutdown()
+            await rt_b.shutdown()
+
+    asyncio.run(go())
+
+
+async def _wid(rt):
+    return await rt.primary_lease()
+
+
+def test_kv_router_spreads_distinct_traffic():
+    async def go():
+        url = "memory://kvr2"
+        rt_a, eng_a = await start_mock_worker(url)
+        rt_b, eng_b = await start_mock_worker(url)
+        rt_c = await DistributedRuntime.create(store_url=url)
+        ep = rt_c.namespace("kvtest").component("backend").endpoint("generate")
+        push = await ep.router(RouterMode.DIRECT)
+        await push.discovery.wait_for_instances(2)
+        router = await KvPushRouter(push, KvRouterConfig(block_size=BS)).start()
+        try:
+            # Distinct prompts, issued concurrently: load-balancing term must
+            # spread them over both workers.
+            async def one(i):
+                ctx = Context()
+                prompt = [100 * i + j for j in range(1, 13)]
+                _ = [x async for x in router.generate(make_request(prompt, 8), ctx)]
+                return ctx.metadata["worker_instance_id"]
+
+            workers = await asyncio.gather(*(one(i) for i in range(1, 9)))
+            assert len(set(workers)) == 2
+        finally:
+            await router.close()
+            await rt_c.shutdown()
+            await rt_a.shutdown()
+            await rt_b.shutdown()
+
+    asyncio.run(go())
+
+
+def test_kv_router_survives_worker_death():
+    async def go():
+        url = "memory://kvr3"
+        rt_a, eng_a = await start_mock_worker(url)
+        rt_b, eng_b = await start_mock_worker(url)
+        rt_c = await DistributedRuntime.create(store_url=url)
+        ep = rt_c.namespace("kvtest").component("backend").endpoint("generate")
+        push = await ep.router(RouterMode.DIRECT)
+        await push.discovery.wait_for_instances(2)
+        router = await KvPushRouter(push, KvRouterConfig(block_size=BS)).start()
+        try:
+            ctx = Context()
+            _ = [x async for x in router.generate(make_request(list(range(1, 10))), ctx)]
+            # Kill one worker; router must still serve via the other.
+            await rt_a.shutdown()
+            await asyncio.sleep(0.05)
+            for i in range(4):
+                ctx = Context()
+                out = [x async for x in router.generate(make_request([7, 8, 9, i + 1]), ctx)]
+                assert out[-1].get("finish_reason") == "length"
+                assert ctx.metadata["worker_instance_id"] == await _wid(rt_b)
+        finally:
+            await router.close()
+            await rt_c.shutdown()
+            await rt_b.shutdown()
+
+    asyncio.run(go())

@@ -1,0 +1,76 @@
+"""Mocker engine tests: deterministic streams, KV events, prefix reuse,
+metrics — the no-hardware substrate for router e2e tests."""
+
+import asyncio
+
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.engine import Context, collect
+
+
+def fast_args(**kw) -> MockerArgs:
+    d = dict(block_size=4, num_kv_blocks=64, speedup=1000.0)
+    d.update(kw)
+    return MockerArgs(**d)
+
+
+def req(prompt, max_tokens=8) -> dict:
+    r = PreprocessedRequest(model="mock", token_ids=list(prompt))
+    r.stop.max_tokens = max_tokens
+    return r.to_dict()
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_mocker_streams_echo_tokens():
+    eng = MockerEngine(fast_args())
+    outs = run(collect(eng.generate(req([1, 2, 3], 5), Context())))
+    toks = [t for o in outs for t in o.get("token_ids", [])]
+    assert toks == [1, 2, 3, 1, 2]
+    assert outs[-1]["finish_reason"] == "length"
+
+
+def test_mocker_emits_kv_events_and_prefix_hits():
+    events = []
+    eng = MockerEngine(fast_args(), event_sink=events.append)
+    prompt = list(range(1, 13))  # 12 tokens = 3 blocks of 4
+    run(collect(eng.generate(req(prompt, 4), Context())))
+    stored = [e for e in events if e.kind == "stored"]
+    assert len(stored) >= 3  # 3 prompt blocks (+ generated seals)
+    hits_before = eng.pool.hit_blocks
+    run(collect(eng.generate(req(prompt, 4), Context())))
+    # max-hit rule: (12-1)//4 = 2 reusable blocks
+    assert eng.pool.hit_blocks - hits_before == 2
+
+
+def test_mocker_cancellation():
+    eng = MockerEngine(fast_args(speedup=1.0, itl_ms=50))
+
+    async def go():
+        ctx = Context()
+        got = []
+        async for item in eng.generate(req([1, 2, 3], 1000), ctx):
+            got.append(item)
+            if len(got) == 2:
+                ctx.cancel()
+        return got
+
+    outs = run(asyncio.wait_for(go(), timeout=10))
+    assert outs[-1]["finish_reason"] == "cancelled"
+
+
+def test_mocker_metrics_and_concurrency():
+    eng = MockerEngine(fast_args())
+
+    async def go():
+        rs = [collect(eng.generate(req([i, i + 1, i + 2], 6), Context())) for i in range(1, 9)]
+        results = await asyncio.gather(*rs)
+        return results
+
+    results = run(go())
+    assert all(r[-1]["finish_reason"] == "length" for r in results)
+    m = eng.metrics()
+    assert m.worker.request_active_slots == 0
+    assert m.kv.kv_total_blocks == 63
