@@ -1,0 +1,5 @@
+"""Frontend: OpenAI HTTP ingress + model discovery + routing.
+
+Reference analogue: ``python -m dynamo.frontend``
+(reference: components/frontend/src/dynamo/frontend/main.py:69-187).
+"""

@@ -1,0 +1,77 @@
+"""Frontend CLI: `python -m dynamo_tpu.frontend`.
+
+Flags mirror the reference frontend (components/frontend/src/dynamo/
+frontend/main.py:69-187): router mode, KV overlap weight, router
+temperature, KV-events toggle.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import signal
+
+from dynamo_tpu.kv_router.router import KvRouterConfig
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.pipeline import RouterSettings
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.push_router import RouterMode
+
+log = get_logger("frontend")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo_tpu.frontend")
+    p.add_argument("--store-url", default=None, help="control-plane store (tcp://host:port)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--namespace", default=None, help="only serve models from this namespace")
+    p.add_argument(
+        "--router-mode", choices=["round-robin", "random", "kv"], default="round-robin"
+    )
+    p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    p.add_argument("--router-temperature", type=float, default=0.0)
+    p.add_argument("--no-kv-events", action="store_true",
+                   help="KV mode without worker events (TTL-predictive index)")
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    rt = await DistributedRuntime.create(store_url=args.store_url)
+    settings = RouterSettings(mode=RouterMode(args.router_mode))
+    if settings.mode == RouterMode.KV:
+        settings.kv = KvRouterConfig(
+            overlap_score_weight=args.kv_overlap_score_weight,
+            router_temperature=args.router_temperature,
+            use_kv_events=not args.no_kv_events,
+        )
+    manager = ModelManager(rt, settings)
+    watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
+    http = await HttpService(
+        manager, rt.metrics, health=rt.health, host=args.host, port=args.port
+    ).start()
+    print(f"dynamo_tpu frontend: http://{args.host}:{http.port}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("frontend shutting down")
+    await http.close()
+    await watcher.close()
+    await manager.close()
+    await rt.shutdown()
+
+
+def main(argv=None) -> int:
+    asyncio.run(async_main(parse_args(argv)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,200 @@
+"""OpenAI-compatible HTTP ingress.
+
+Reference analogue: the axum HTTP service (reference: lib/llm/src/http/
+service/openai.rs:358 — /v1/chat/completions, :166 /v1/completions, :855
+/v1/models; service_v2.rs:67-172 builder; disconnect.rs SSE disconnect
+detection; metrics.rs:35-119 per-model metrics + inflight guards) — here
+on aiohttp.
+
+Also exposes the system surface (/health /live /metrics; reference:
+lib/runtime/src/http_server.rs:33-69) since both ride one server here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import time
+
+from aiohttp import web
+
+from dynamo_tpu.llm.discovery import ModelManager
+from dynamo_tpu.llm.protocols import (
+    SSE_DONE,
+    ChatCompletionRequest,
+    CompletionRequest,
+    OpenAIError,
+    model_list,
+    sse_event,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import current_trace, get_logger
+from dynamo_tpu.runtime.metrics import InflightGuard, MetricsRegistry
+from dynamo_tpu.runtime.push_router import NoInstancesError
+
+log = get_logger("http")
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        metrics: MetricsRegistry,
+        health=None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+    ):
+        self.manager = manager
+        self.health = health
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+        scope = metrics.child("http")
+        self.m_requests = scope.counter("http_requests_total", "HTTP requests")
+        self.m_inflight = scope.gauge("http_inflight", "In-flight requests")
+        self.m_duration = scope.histogram("http_request_duration_seconds", "Request duration")
+        self.m_ttft = scope.histogram("http_time_to_first_token_seconds", "Time to first token")
+        self.m_output_tokens = scope.counter("http_output_tokens_total", "Output tokens")
+        self._metrics_registry = metrics
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self.handle_chat)
+        app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/live", self.handle_live)
+        app.router.add_get("/metrics", self.handle_metrics)
+        return app
+
+    async def start(self) -> "HttpService":
+        self._runner = web.AppRunner(self.build_app(), access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            self.port = s._server.sockets[0].getsockname()[1]  # resolved when port=0
+            break
+        log.info("http service listening on %s:%d", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- system surface ----------------------------------------------------
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        ready = self.health.ready if self.health is not None else True
+        body = {"status": "ready" if ready else "notready", "models": self.manager.list_names()}
+        return web.json_response(body, status=200 if ready else 503)
+
+    async def handle_live(self, request: web.Request) -> web.Response:
+        live = self.health.live if self.health is not None else True
+        return web.json_response({"live": live}, status=200 if live else 503)
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=self._metrics_registry.render(), content_type="text/plain")
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response(model_list(self.manager.list_names()))
+
+    # -- inference surface -------------------------------------------------
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_inference(request, "chat")
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_inference(request, "completion")
+
+    async def _handle_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
+        endpoint = "chat" if kind == "chat" else "completions"
+        model = "unknown"
+        t0 = time.perf_counter()
+        try:
+            try:
+                body = await request.json()
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                raise OpenAIError("request body must be valid JSON") from None
+            req = ChatCompletionRequest.parse(body) if kind == "chat" else CompletionRequest.parse(body)
+            model = req.model
+            pipe = self.manager.get(req.model)
+            if pipe is None:
+                raise OpenAIError(f"model {req.model!r} not found", status=404, err_type="not_found_error")
+
+            ctx = Context(trace=current_trace())
+            with InflightGuard(self.m_inflight, model=model):
+                try:
+                    if req.stream:
+                        return await self._stream(request, pipe, req, ctx, model, endpoint, t0)
+                    return await self._aggregate(pipe, req, ctx, model, endpoint, t0)
+                finally:
+                    ctx.cancel()  # no-op if finished; frees worker if abandoned
+                    self.m_duration.observe(time.perf_counter() - t0, model=model)
+        except OpenAIError as e:
+            self.m_requests.inc(model=model, endpoint=endpoint, status=str(e.status))
+            return web.json_response(e.body(), status=e.status)
+        except NoInstancesError:
+            self.m_requests.inc(model=model, endpoint=endpoint, status="503")
+            err = OpenAIError("no workers available for this model", status=503, err_type="overloaded_error")
+            return web.json_response(err.body(), status=503)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — HTTP boundary
+            log.exception("inference request failed")
+            self.m_requests.inc(model=model, endpoint=endpoint, status="500")
+            err = OpenAIError("internal error", status=500, err_type="internal_error")
+            return web.json_response(err.body(), status=500)
+
+    async def _stream(
+        self, request: web.Request, pipe, req, ctx: Context, model: str, endpoint: str, t0: float
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            },
+        )
+        await resp.prepare(request)
+        first = True
+        last_gen = None
+        async for gen, chunk in pipe.run(req, ctx):
+            last_gen = gen
+            if chunk is None:
+                continue
+            if first:
+                first = False
+                self.m_ttft.observe(time.perf_counter() - t0, model=model)
+            try:
+                await resp.write(sse_event(json.dumps(chunk)))
+            except (ConnectionResetError, ConnectionError):
+                # Client went away: propagate cancellation upstream
+                # (reference: lib/llm/src/http/service/disconnect.rs).
+                ctx.cancel()
+                log.info("client disconnected mid-stream (%s)", ctx.id)
+                break
+        if last_gen is not None:
+            self.m_output_tokens.inc(last_gen.completion_tokens, model=model)
+        if not ctx.cancelled:
+            self.m_requests.inc(model=model, endpoint=endpoint, status="200")
+            with contextlib.suppress(ConnectionResetError, ConnectionError):
+                await resp.write(SSE_DONE)
+                await resp.write_eof()
+        return resp
+
+    async def _aggregate(
+        self, pipe, req, ctx: Context, model: str, endpoint: str, t0: float
+    ) -> web.Response:
+        gen = None
+        first = True
+        async for g, _chunk in pipe.run(req, ctx):
+            gen = g
+            if first:
+                first = False
+                self.m_ttft.observe(time.perf_counter() - t0, model=model)
+        assert gen is not None
+        self.m_output_tokens.inc(gen.completion_tokens, model=model)
+        self.m_requests.inc(model=model, endpoint=endpoint, status="200")
+        return web.json_response(gen.final_response())

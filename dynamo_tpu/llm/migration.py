@@ -1,0 +1,66 @@
+"""Migration operator: mid-stream fault tolerance by re-dispatch.
+
+Reference analogue: ``Migration`` (reference: lib/llm/src/migration.rs:
+38-60, docs/architecture/request_migration.md:46-90): sit between the
+Backend and the router, accumulate the tokens a worker has emitted, and
+when the stream dies mid-flight (worker crash → TruncatedStreamError),
+re-issue the request to another worker with the accumulated tokens
+appended to the prompt — the new worker prefills prompt+generated (prefix
+cache makes this cheap if blocks were shared) and generation continues
+seamlessly. Bounded by the model card's ``migration_limit``.
+
+Pre-stream failures are NOT handled here — the routers already retry
+those; this operator owns only the post-first-token window the routers
+deliberately re-raise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, Operator
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.messaging import TruncatedStreamError
+
+log = get_logger("migration")
+
+
+class Migration(Operator):
+    def __init__(self, inner: AsyncEngine, migration_limit: int = 0):
+        super().__init__(inner)
+        self.migration_limit = migration_limit
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        if not isinstance(request, dict):
+            async for item in self.inner.generate(request, context.child()):
+                yield item
+            return
+
+        request = dict(request)
+        migrations = 0
+        emitted: list[int] = []
+        while True:
+            try:
+                async for raw in self.inner.generate(request, context.child()):
+                    if isinstance(raw, dict) and raw.get("token_ids"):
+                        emitted.extend(raw["token_ids"])
+                    yield raw
+                return
+            except TruncatedStreamError:
+                if migrations >= self.migration_limit or context.cancelled:
+                    raise
+                migrations += 1
+                log.warning(
+                    "stream died mid-flight for %s; migrating (%d/%d, %d tokens carried)",
+                    context.id, migrations, self.migration_limit, len(emitted),
+                )
+                # Re-dispatch: generated tokens become part of the prompt;
+                # the generation budget shrinks by what was already emitted.
+                request = dict(request)
+                request["token_ids"] = list(request.get("token_ids") or []) + emitted
+                stop = dict(request.get("stop") or {})
+                if stop.get("max_tokens") is not None:
+                    stop["max_tokens"] = max(1, stop["max_tokens"] - len(emitted))
+                    request["stop"] = stop
+                emitted = []
+                continue

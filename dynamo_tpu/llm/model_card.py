@@ -37,6 +37,9 @@ class ModelDeploymentCard:
     chat_template: str | None = None  # jinja2 source; None → default template
     eos_token_ids: list[int] = field(default_factory=list)
     model_type: str = "chat"       # "chat" | "completions" | "embeddings"
+    # Where requests for this model are served (runtime addressing).
+    component: str = "backend"
+    endpoint: str = "generate"
     # Engine capability hints for routers/planners:
     max_batch_size: int | None = None
     total_kv_blocks: int | None = None
@@ -55,6 +58,8 @@ class ModelDeploymentCard:
             "chat_template": self.chat_template,
             "eos_token_ids": list(self.eos_token_ids),
             "model_type": self.model_type,
+            "component": self.component,
+            "endpoint": self.endpoint,
             "max_batch_size": self.max_batch_size,
             "total_kv_blocks": self.total_kv_blocks,
         }
@@ -70,6 +75,8 @@ class ModelDeploymentCard:
             chat_template=d.get("chat_template"),
             eos_token_ids=list(d.get("eos_token_ids") or []),
             model_type=d.get("model_type", "chat"),
+            component=d.get("component", "backend"),
+            endpoint=d.get("endpoint", "generate"),
             max_batch_size=d.get("max_batch_size"),
             total_kv_blocks=d.get("total_kv_blocks"),
         )

@@ -1,0 +1,120 @@
+"""Frontend pipeline assembly: one served model = preprocessor → router →
+backend → delta generation.
+
+Reference analogue: ``build_routed_pipeline`` — SegmentSource →
+OpenAIPreprocessor → Backend → Migration → KvPushRouter/PushRouter
+(reference: lib/llm/src/entrypoint/input/common.rs:183-261). Stage order
+here matches: tokens go out to workers raw; detokenization + stop-string
+enforcement happen frontend-side (Backend), which is also what lets the
+Migration operator re-dispatch with accumulated tokens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
+from dynamo_tpu.llm.protocols import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    FinishReason,
+    LLMEngineOutput,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+
+@dataclass
+class RouterSettings:
+    mode: RouterMode = RouterMode.ROUND_ROBIN
+    kv: KvRouterConfig | None = None
+
+
+class _RouterEngine:
+    """Adapts PushRouter (positional instance_id API) to the AsyncEngine
+    shape used by pipeline operators."""
+
+    def __init__(self, push: PushRouter):
+        self.push = push
+
+    def generate(self, request: Any, context: Context):
+        return self.push.generate(request, context)
+
+
+class ModelPipeline:
+    """Everything the frontend needs to serve one model."""
+
+    def __init__(
+        self,
+        namespace: str,
+        card: ModelDeploymentCard,
+        runtime,
+        settings: RouterSettings | None = None,
+    ):
+        self.namespace = namespace
+        self.card = card
+        self.runtime = runtime
+        self.settings = settings or RouterSettings()
+        self.preprocessor = OpenAIPreprocessor(card)
+        self.kv_router: KvPushRouter | None = None
+        self.backend: Backend | None = None
+        self.discovery = None
+
+    async def start(self) -> "ModelPipeline":
+        ep = (
+            self.runtime.namespace(self.namespace)
+            .component(self.card.component)
+            .endpoint(self.card.endpoint)
+        )
+        if self.settings.mode == RouterMode.KV:
+            push = await ep.router(RouterMode.DIRECT)
+            kv_cfg = self.settings.kv or KvRouterConfig()
+            kv_cfg.block_size = self.card.kv_cache_block_size
+            self.kv_router = await KvPushRouter(push, kv_cfg).start()
+            engine = self.kv_router
+        else:
+            push = await ep.router(self.settings.mode)
+            engine = _RouterEngine(push)
+        self.discovery = push.discovery
+        migration = Migration(engine, migration_limit=self.card.migration_limit)
+        self.backend = Backend(migration, self.preprocessor.tokenizer)
+        return self
+
+    async def close(self) -> None:
+        if self.kv_router is not None:
+            await self.kv_router.close()
+
+    # -- request execution -------------------------------------------------
+
+    async def run(
+        self,
+        req: ChatCompletionRequest | CompletionRequest,
+        context: Context,
+    ) -> AsyncIterator[tuple[DeltaGenerator, dict | None]]:
+        """Preprocess + stream. Yields (gen, chunk) pairs: chunk is an SSE
+        payload dict, or None for pure bookkeeping deltas. The caller owns
+        transport concerns (SSE vs aggregate)."""
+        kind = "chat" if isinstance(req, ChatCompletionRequest) else "completion"
+        if kind == "chat":
+            pre = self.preprocessor.preprocess_chat(req)
+        else:
+            pre = self.preprocessor.preprocess_completion(req)
+        gen = DeltaGenerator(self.card.name, kind=kind, prompt_tokens=len(pre.token_ids))
+        assert self.backend is not None, "pipeline not started"
+        async for raw in self.backend.generate(pre.to_dict(), context):
+            out = LLMEngineOutput.from_dict(raw)
+            if out.finish_reason == FinishReason.ERROR:
+                raise RuntimeError(out.error or "engine error")
+            finish = out.finish_reason.value if out.finish_reason else None
+            chunks = gen.on_delta(out.text, len(out.token_ids), finish)
+            if not chunks:
+                yield gen, None
+            for c in chunks:
+                yield gen, c
+            if finish is not None:
+                return

@@ -1,0 +1,8 @@
+"""Worker: hosts an inference engine (TPU or mocker) on the runtime.
+
+Reference analogue: the engine worker CLIs — ``python -m dynamo.vllm`` /
+``dynamo.mocker`` (reference: components/backends/vllm/src/dynamo/vllm/
+main.py:65-88, components/backends/mocker/src/dynamo/mocker/main.py) —
+except the engine is in-repo, so one worker hosts either the real
+TpuEngine or the CPU mocker.
+"""

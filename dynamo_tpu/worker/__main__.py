@@ -1,0 +1,152 @@
+"""Worker CLI: `python -m dynamo_tpu.worker`.
+
+Boots the engine, serves the ``generate`` endpoint plus the KV-event and
+load-metrics endpoints, and registers the model card — the frontend
+discovers the model via the store watch
+(reference worker startup flow: components/backends/vllm/src/dynamo/vllm/
+main.py:65-223).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import signal
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("worker")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo_tpu.worker")
+    p.add_argument("--store-url", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--model-name", default=None, help="served model name (defaults to preset name)")
+    p.add_argument("--engine", choices=["tpu", "mocker"], default="tpu")
+    p.add_argument("--preset", default="llama-1b", help="model preset (engine=tpu)")
+    p.add_argument("--tokenizer", default="byte", help='"byte" or "hf:<path>"')
+    p.add_argument("--context-length", type=int, default=None)
+    p.add_argument("--migration-limit", type=int, default=0)
+    # engine shape knobs
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-kv-blocks", type=int, default=2048)
+    p.add_argument("--max-num-seqs", type=int, default=16)
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--decode-steps", type=int, default=8)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    # mocker timing
+    p.add_argument("--mocker-ttft-ms", type=float, default=20.0)
+    p.add_argument("--mocker-itl-ms", type=float, default=5.0)
+    p.add_argument("--mocker-speedup", type=float, default=1.0)
+    return p.parse_args(argv)
+
+
+def tokenizer_spec(arg: str) -> dict:
+    if arg == "byte":
+        return {"type": "byte"}
+    if arg.startswith("hf:"):
+        return {"type": "hf", "path": arg[3:]}
+    raise SystemExit(f"unknown tokenizer spec {arg!r}")
+
+
+async def build_engine(args):
+    """→ (engine, model_card). Engine exposes .generate/.metrics/.pool."""
+    tok_spec = tokenizer_spec(args.tokenizer)
+    tokenizer = load_tokenizer(tok_spec)
+    eos_ids = list(tokenizer.eos_token_ids)
+    if args.engine == "mocker":
+        from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+
+        engine = MockerEngine(
+            MockerArgs(
+                block_size=args.block_size,
+                num_kv_blocks=args.num_kv_blocks,
+                max_num_seqs=args.max_num_seqs,
+                ttft_ms=args.mocker_ttft_ms,
+                itl_ms=args.mocker_itl_ms,
+                speedup=args.mocker_speedup,
+            )
+        )
+        name = args.model_name or "mock-model"
+        context_length = args.context_length or args.max_model_len
+    else:
+        from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+        from dynamo_tpu.engine.engine import TpuEngine
+
+        model = ModelConfig.preset(args.preset)
+        eargs = EngineArgs(
+            model=model,
+            block_size=args.block_size,
+            num_kv_blocks=args.num_kv_blocks,
+            max_num_seqs=args.max_num_seqs,
+            max_model_len=args.max_model_len,
+            dtype=args.dtype,
+            tp=args.tp,
+            decode_steps=args.decode_steps,
+        )
+        engine = await TpuEngine(eargs, seed=args.seed).start()
+        name = args.model_name or model.name
+        context_length = args.context_length or args.max_model_len
+    card = ModelDeploymentCard(
+        name=name,
+        tokenizer=tok_spec,
+        context_length=context_length,
+        kv_cache_block_size=args.block_size,
+        migration_limit=args.migration_limit,
+        eos_token_ids=eos_ids or [ByteTokenizer.EOS],
+        component=args.component,
+        endpoint=args.endpoint,
+        max_batch_size=args.max_num_seqs,
+        total_kv_blocks=args.num_kv_blocks,
+    )
+    return engine, card
+
+
+async def async_main(args) -> None:
+    rt = await DistributedRuntime.create(store_url=args.store_url)
+    engine, card = await build_engine(args)
+
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+
+    comp = rt.namespace(args.namespace).component(args.component)
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await comp.endpoint(args.endpoint).serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    await register_model(rt, args.namespace, card)
+    print(f"dynamo_tpu worker: serving {card.name} as {args.namespace}/{args.component}/{args.endpoint}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("worker shutting down")
+    stop_fn = getattr(engine, "stop", None)
+    if stop_fn is not None:
+        await stop_fn()
+    await rt.shutdown()
+
+
+def main(argv=None) -> int:
+    asyncio.run(async_main(parse_args(argv)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

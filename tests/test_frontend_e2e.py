@@ -1,0 +1,258 @@
+"""Frontend serving-slice tests.
+
+In-process: ModelManager + mocker workers + HttpService driven with an
+HTTP client (SSE streaming, aggregation, model list, errors).
+
+Spawned-process: store + mocker worker CLIs + frontend CLI — the
+reference's ManagedProcess e2e shape (reference: tests/serve/,
+tests/router/test_router_e2e_with_mockers.py:26-80).
+"""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+from dynamo_tpu.llm.pipeline import RouterSettings
+from dynamo_tpu.llm.protocols import parse_sse_lines
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.push_router import RouterMode
+
+from procutil import ManagedProcess
+
+
+async def start_worker(store_url, name="mock-model", namespace="e2e"):
+    """In-process mocker worker publishing a model card."""
+    rt = await DistributedRuntime.create(store_url=store_url)
+    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0))
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+    comp = rt.namespace(namespace).component("backend")
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await comp.endpoint("generate").serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    card = ModelDeploymentCard(
+        name=name,
+        kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS],
+        context_length=512,
+    )
+    await register_model(rt, namespace, card)
+    return rt, engine
+
+
+async def start_frontend(store_url, mode=RouterMode.ROUND_ROBIN):
+    rt = await DistributedRuntime.create(store_url=store_url)
+    manager = ModelManager(rt, RouterSettings(mode=mode))
+    watcher = await ModelWatcher(rt, manager).start()
+    http = await HttpService(
+        manager, rt.metrics, health=rt.health, host="127.0.0.1", port=0
+    ).start()
+    return rt, manager, watcher, http
+
+
+def chat_body(text="hello frontend", **kw):
+    body = {
+        "model": "mock-model",
+        "messages": [{"role": "user", "content": text}],
+        "max_tokens": 8,
+    }
+    body.update(kw)
+    return body
+
+
+def test_frontend_serves_chat_stream_and_aggregate():
+    async def go():
+        url = "memory://fe1"
+        wrt, _eng = await start_worker(url)
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                # model list reflects discovery
+                r = await client.get(f"{base}/v1/models")
+                assert r.status_code == 200
+                assert [m["id"] for m in r.json()["data"]] == ["mock-model"]
+
+                # streaming chat
+                chunks = []
+                async with client.stream(
+                    "POST", f"{base}/v1/chat/completions", json=chat_body(stream=True)
+                ) as resp:
+                    assert resp.status_code == 200
+                    raw = [c async for c in resp.aiter_bytes()]
+                events = list(parse_sse_lines(raw))
+                assert events[-1] == "[DONE]"
+                payloads = [json.loads(e) for e in events[:-1]]
+                text = "".join(
+                    p["choices"][0]["delta"].get("content") or "" for p in payloads
+                )
+                assert len(text) > 0
+                assert payloads[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+                assert payloads[-1]["usage"]["completion_tokens"] == 8
+                assert payloads[-1]["usage"]["prompt_tokens"] > 0
+
+                # aggregated chat
+                r = await client.post(f"{base}/v1/chat/completions", json=chat_body())
+                assert r.status_code == 200
+                body = r.json()
+                assert body["object"] == "chat.completion"
+                assert body["choices"][0]["message"]["content"]
+
+                # completions endpoint
+                r = await client.post(
+                    f"{base}/v1/completions",
+                    json={"model": "mock-model", "prompt": "abc", "max_tokens": 4},
+                )
+                assert r.status_code == 200
+                assert r.json()["object"] == "text_completion"
+
+                # errors
+                r = await client.post(f"{base}/v1/chat/completions", json={"model": "nope", "messages": [{"role": "user", "content": "x"}]})
+                assert r.status_code == 404
+                r = await client.post(f"{base}/v1/chat/completions", json={"model": "mock-model"})
+                assert r.status_code == 400
+
+                # health + metrics
+                r = await client.get(f"{base}/health")
+                assert r.status_code == 200 and r.json()["status"] == "ready"
+                r = await client.get(f"{base}/metrics")
+                assert "dynamo_tpu_http_requests_total" in r.text
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(go())
+
+
+def test_frontend_model_lifecycle_follows_workers():
+    async def go():
+        url = "memory://fe2"
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=10) as client:
+                r = await client.get(f"{base}/v1/models")
+                assert r.json()["data"] == []
+                wrt, _ = await start_worker(url)
+                await asyncio.sleep(0.1)
+                r = await client.get(f"{base}/v1/models")
+                assert [m["id"] for m in r.json()["data"]] == ["mock-model"]
+                # worker leaves → model disappears, requests 404
+                await wrt.shutdown()
+                await asyncio.sleep(0.1)
+                r = await client.get(f"{base}/v1/models")
+                assert r.json()["data"] == []
+                r = await client.post(f"{base}/v1/chat/completions", json=chat_body())
+                assert r.status_code == 404
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+
+    asyncio.run(go())
+
+
+def test_frontend_kv_mode_e2e():
+    async def go():
+        url = "memory://fe3"
+        w1, e1 = await start_worker(url)
+        w2, e2 = await start_worker(url)
+        frt, manager, watcher, http = await start_frontend(url, mode=RouterMode.KV)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                shared = "repeat this very long shared prefix " * 3
+                for i in range(6):
+                    r = await client.post(
+                        f"{base}/v1/chat/completions", json=chat_body(shared + str(i))
+                    )
+                    assert r.status_code == 200
+                    await asyncio.sleep(0.02)
+            # All traffic concentrated on one worker (prefix affinity).
+            assert (e1.total_generated == 0) != (e2.total_generated == 0)
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await w1.shutdown()
+            await w2.shutdown()
+
+    asyncio.run(go())
+
+
+# -- spawned-process e2e ------------------------------------------------------
+
+
+@pytest.mark.e2e
+def test_cli_serving_slice_spawned_processes():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        store_port = s.getsockname()[1]
+    store_url = f"tcp://127.0.0.1:{store_port}"
+
+    with ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.store_server", "--host", "127.0.0.1", "--port", str(store_port)],
+        name="store",
+    ) as store:
+        store.wait_for(r"store server: tcp://")
+        with ManagedProcess(
+            ["-m", "dynamo_tpu.mocker", "--store-url", store_url,
+             "--mocker-speedup", "50", "--model-name", "cli-model"],
+            name="worker",
+        ) as worker:
+            worker.wait_for(r"serving cli-model")
+            with ManagedProcess(
+                ["-m", "dynamo_tpu.frontend", "--store-url", store_url,
+                 "--host", "127.0.0.1", "--port", "0", "--router-mode", "kv"],
+                name="frontend",
+            ) as frontend:
+                m = frontend.wait_for(r"frontend: http://127\.0\.0\.1:(\d+)")
+                port = int(m.group(1))
+                base = f"http://127.0.0.1:{port}"
+
+                async def drive():
+                    async with httpx.AsyncClient(timeout=30) as client:
+                        for _ in range(100):
+                            r = await client.get(f"{base}/v1/models")
+                            if r.json()["data"]:
+                                break
+                            await asyncio.sleep(0.1)
+                        assert r.json()["data"][0]["id"] == "cli-model"
+                        r = await client.post(
+                            f"{base}/v1/chat/completions",
+                            json={"model": "cli-model",
+                                  "messages": [{"role": "user", "content": "spawned hello"}],
+                                  "max_tokens": 6},
+                        )
+                        assert r.status_code == 200
+                        assert r.json()["choices"][0]["message"]["content"]
+
+                        # SIGKILL the worker mid-everything: model must vanish.
+                        worker.kill()
+                        for _ in range(150):
+                            r = await client.get(f"{base}/v1/models")
+                            if not r.json()["data"]:
+                                break
+                            await asyncio.sleep(0.1)
+                        assert r.json()["data"] == []
+
+                asyncio.run(drive())

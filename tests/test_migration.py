@@ -1,0 +1,115 @@
+"""Request-migration fault tolerance.
+
+Reference analogue: tests/fault_tolerance/test_request_migration.py:
+289-323 — kill the serving worker mid-stream; with migration enabled the
+stream completes on another worker; without it the client sees the
+truncation.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.pipeline import _RouterEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.messaging import TruncatedStreamError
+from dynamo_tpu.runtime.push_router import RouterMode
+
+from procutil import ManagedProcess
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_worker(store_url):
+    return ManagedProcess(
+        ["-m", "dynamo_tpu.mocker", "--store-url", store_url,
+         "--mocker-itl-ms", "30", "--model-name", "mig-model"],
+        name="worker",
+    )
+
+
+def request(max_tokens=40) -> dict:
+    req = PreprocessedRequest(model="mig-model", token_ids=[1, 2, 3, 4, 5])
+    req.stop.max_tokens = max_tokens
+    return req.to_dict()
+
+
+@pytest.mark.e2e
+def test_migration_completes_stream_after_worker_kill():
+    port = free_port()
+    store_url = f"tcp://127.0.0.1:{port}"
+    with ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.store_server", "--host", "127.0.0.1", "--port", str(port)],
+        name="store",
+    ) as store:
+        store.wait_for(r"store server: tcp://")
+        with spawn_worker(store_url) as w1:
+            w1.wait_for(r"serving mig-model")
+
+            async def drive():
+                rt = await DistributedRuntime.create(store_url=store_url)
+                try:
+                    ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+                    push = await ep.router(RouterMode.ROUND_ROBIN)
+                    await push.discovery.wait_for_instances(1)
+                    migration = Migration(_RouterEngine(push), migration_limit=3)
+
+                    ctx = Context()
+                    tokens = []
+                    killed = False
+                    with spawn_worker(store_url) as w2:
+                        async for item in migration.generate(request(40), ctx):
+                            tokens.extend(item.get("token_ids") or [])
+                            if len(tokens) == 5 and not killed:
+                                # second worker is up before we kill the first
+                                await push.discovery.wait_for_instances(2)
+                                w1.kill()
+                                killed = True
+                        assert killed
+                        assert len(tokens) == 40, f"stream incomplete: {len(tokens)} tokens"
+                        assert item.get("finish_reason") == "length"
+                finally:
+                    await rt.shutdown()
+
+            asyncio.run(drive())
+
+
+@pytest.mark.e2e
+def test_no_migration_surfaces_truncation():
+    port = free_port()
+    store_url = f"tcp://127.0.0.1:{port}"
+    with ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.store_server", "--host", "127.0.0.1", "--port", str(port)],
+        name="store",
+    ) as store:
+        store.wait_for(r"store server: tcp://")
+        with spawn_worker(store_url) as w1:
+            w1.wait_for(r"serving mig-model")
+
+            async def drive():
+                rt = await DistributedRuntime.create(store_url=store_url)
+                try:
+                    ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+                    push = await ep.router(RouterMode.ROUND_ROBIN)
+                    await push.discovery.wait_for_instances(1)
+                    migration = Migration(_RouterEngine(push), migration_limit=0)
+                    ctx = Context()
+                    tokens = []
+                    with pytest.raises(TruncatedStreamError):
+                        async for item in migration.generate(request(40), ctx):
+                            tokens.extend(item.get("token_ids") or [])
+                            if len(tokens) == 5:
+                                w1.kill()
+                    assert 0 < len(tokens) < 40
+                finally:
+                    await rt.shutdown()
+
+            asyncio.run(drive())
