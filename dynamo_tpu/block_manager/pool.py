@@ -20,6 +20,7 @@ contract) and never allocated.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable
 
@@ -61,6 +62,10 @@ class BlockPool:
         self._lru: OrderedDict[int, None] = OrderedDict()  # block_id → None, oldest first
         self._event_sink = event_sink
         self._event_id = 0
+        # Mutations run on the engine scheduler thread while snapshot()/
+        # metrics run on the asyncio loop thread (kv_events subscribers,
+        # load_metrics) — every public method takes this lock.
+        self._lock = threading.RLock()
         # prefix-cache observability
         self.hit_blocks = 0
         self.miss_blocks = 0
@@ -84,7 +89,8 @@ class BlockPool:
     @property
     def num_free(self) -> int:
         """Blocks obtainable right now (free list + evictable cached)."""
-        return len(self._free) + len(self._lru)
+        with self._lock:
+            return len(self._free) + len(self._lru)
 
     @property
     def num_active(self) -> int:
@@ -108,13 +114,14 @@ class BlockPool:
         content, so greedy front-matching is exact.)"""
         if not self.enable_prefix_caching:
             return []
-        out: list[int] = []
-        for h in seq_hashes:
-            bid = self._cached.get(h)
-            if bid is None:
-                break
-            out.append(bid)
-        return out
+        with self._lock:
+            out: list[int] = []
+            for h in seq_hashes:
+                bid = self._cached.get(h)
+                if bid is None:
+                    break
+                out.append(bid)
+            return out
 
     def allocate_sequence(self, seq_hashes: list[int], total_blocks: int) -> tuple[list[int], int]:
         """Allocate ``total_blocks`` for a sequence whose complete-prompt
@@ -122,28 +129,30 @@ class BlockPool:
 
         → (block_ids, num_hit_blocks). Raises NoFreeBlocksError (nothing
         allocated) if the pool can't satisfy the request."""
-        hits = self.match_prefix(seq_hashes)
-        need_new = total_blocks - len(hits)
-        if need_new > len(self._free) + len(self._lru) - self._lru_overlap(hits):
-            raise NoFreeBlocksError(f"need {need_new}, have {self.num_free}")
-        # Claim hits first (removes them from the evictable LRU).
-        for bid in hits:
-            self._ref(bid)
-        block_ids = list(hits)
-        try:
-            for _ in range(need_new):
-                block_ids.append(self._pop_free())
-        except NoFreeBlocksError:
-            for bid in block_ids:
-                self._unref(bid)
-            raise
-        self.hit_blocks += len(hits)
-        self.miss_blocks += max(0, len(seq_hashes) - len(hits))
-        return block_ids, len(hits)
+        with self._lock:
+            hits = self.match_prefix(seq_hashes)
+            need_new = total_blocks - len(hits)
+            if need_new > len(self._free) + len(self._lru) - self._lru_overlap(hits):
+                raise NoFreeBlocksError(f"need {need_new}, have {self.num_free}")
+            # Claim hits first (removes them from the evictable LRU).
+            for bid in hits:
+                self._ref(bid)
+            block_ids = list(hits)
+            try:
+                for _ in range(need_new):
+                    block_ids.append(self._pop_free())
+            except NoFreeBlocksError:
+                for bid in block_ids:
+                    self._unref(bid)
+                raise
+            self.hit_blocks += len(hits)
+            self.miss_blocks += max(0, len(seq_hashes) - len(hits))
+            return block_ids, len(hits)
 
     def allocate_block(self) -> int:
         """One fresh block (decode growth). Raises NoFreeBlocksError."""
-        return self._pop_free()
+        with self._lock:
+            return self._pop_free()
 
     def _lru_overlap(self, hits: list[int]) -> int:
         # hits currently in LRU will leave it on _ref; they don't reduce
@@ -198,40 +207,49 @@ class BlockPool:
         (same hash, concurrent fill), the caller keeps its copy but the
         canonical cache entry stays with the first — returns the canonical
         block id."""
-        b = self._blocks[bid]
-        canonical = self._cached.get(seq_hash)
-        if canonical is not None:
-            return canonical  # already registered (this block or a twin): no re-emit
-        b.seq_hash = seq_hash
-        b.parent_hash = parent_hash
-        if self.enable_prefix_caching:
-            self._cached[seq_hash] = bid
-            self._emit(KvCacheEvent.stored([StoredBlock(seq_hash, parent_hash)]))
-        return bid
+        with self._lock:
+            b = self._blocks[bid]
+            canonical = self._cached.get(seq_hash)
+            if canonical is not None:
+                return canonical  # already registered (this block or a twin): no re-emit
+            b.seq_hash = seq_hash
+            b.parent_hash = parent_hash
+            if self.enable_prefix_caching:
+                self._cached[seq_hash] = bid
+                self._emit(KvCacheEvent.stored([StoredBlock(seq_hash, parent_hash)]))
+            return bid
 
     # -- release ----------------------------------------------------------
 
     def free_sequence(self, block_ids: list[int]) -> None:
-        for bid in block_ids:
-            self._unref(bid)
+        with self._lock:
+            for bid in block_ids:
+                self._unref(bid)
 
     def snapshot(self) -> list[tuple[int, int | None]]:
         """All currently-registered (hash, parent_hash) pairs in original
         registration order (parents before children — dict insertion
-        order). Used to seed a new KV-event subscriber."""
-        out = []
-        for h, bid in self._cached.items():
-            out.append((h, self._blocks[bid].parent_hash))
-        return out
+        order). Used to seed a new KV-event subscriber. Thread-safe: may
+        be called from the asyncio loop while the engine thread mutates."""
+        with self._lock:
+            return [(h, self._blocks[bid].parent_hash) for h, bid in self._cached.items()]
 
-    def clear(self) -> None:
+    def clear(self) -> int:
         """Drop every cached (ref 0) block — admin /clear_kv_blocks path
-        (reference: lib/llm/src/http/service/clear_kv_blocks.rs)."""
-        for bid in list(self._lru):
-            self._lru.pop(bid)
-            b = self._blocks[bid]
-            if b.seq_hash is not None:
-                self._cached.pop(b.seq_hash, None)
-                b.seq_hash = None
-            self._free.append(bid)
-        self._emit(KvCacheEvent.cleared())
+        (reference: lib/llm/src/http/service/clear_kv_blocks.rs). Emits a
+        `removed` event for exactly the hashes dropped: blocks still
+        referenced by running sequences stay registered, so a blanket
+        `cleared` would desync remote radix indexers. → count dropped."""
+        with self._lock:
+            dropped: list[int] = []
+            for bid in list(self._lru):
+                self._lru.pop(bid)
+                b = self._blocks[bid]
+                if b.seq_hash is not None:
+                    self._cached.pop(b.seq_hash, None)
+                    dropped.append(b.seq_hash)
+                    b.seq_hash = None
+                self._free.append(bid)
+            if dropped:
+                self._emit(KvCacheEvent.removed(dropped))
+            return len(dropped)

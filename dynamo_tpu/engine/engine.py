@@ -415,7 +415,9 @@ class TpuEngine:
         seq.block_ids = []
         seq.registered_blocks = 0
         seq.kv_written = 0
-        seq.prompt_len = len(seq.tokens)
+        # prompt_len stays at the ORIGINAL prompt length: it delimits the
+        # penalty token window (generated = tokens[prompt_len:]), which must
+        # survive preemption; _prefill_seq re-runs over seq.tokens anyway.
         seq.block_seq = None
         seq.preempted = True
         self._waiting.appendleft(seq)

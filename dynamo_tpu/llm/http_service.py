@@ -150,6 +150,16 @@ class HttpService:
     async def _stream(
         self, request: web.Request, pipe, req, ctx: Context, model: str, endpoint: str, t0: float
     ) -> web.StreamResponse:
+        # Pull the FIRST pipeline item before opening the SSE stream: lazy
+        # preprocessing (template render, context-length validation) raises
+        # on first __anext__, and those must surface as a clean 4xx — once
+        # resp.prepare() runs, the 200 is on the wire.
+        stream = pipe.run(req, ctx).__aiter__()
+        try:
+            head = await stream.__anext__()
+        except StopAsyncIteration:
+            head = None
+
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -160,24 +170,44 @@ class HttpService:
         await resp.prepare(request)
         first = True
         last_gen = None
-        async for gen, chunk in pipe.run(req, ctx):
-            last_gen = gen
-            if chunk is None:
-                continue
-            if first:
-                first = False
-                self.m_ttft.observe(time.perf_counter() - t0, model=model)
-            try:
-                await resp.write(sse_event(json.dumps(chunk)))
-            except (ConnectionResetError, ConnectionError):
-                # Client went away: propagate cancellation upstream
-                # (reference: lib/llm/src/http/service/disconnect.rs).
-                ctx.cancel()
-                log.info("client disconnected mid-stream (%s)", ctx.id)
-                break
+        failed = False
+        try:
+            while head is not None:
+                gen, chunk = head
+                last_gen = gen
+                if chunk is not None:
+                    if first:
+                        first = False
+                        self.m_ttft.observe(time.perf_counter() - t0, model=model)
+                    try:
+                        await resp.write(sse_event(json.dumps(chunk)))
+                    except (ConnectionResetError, ConnectionError):
+                        # Client went away: propagate cancellation upstream
+                        # (reference: lib/llm/src/http/service/disconnect.rs).
+                        ctx.cancel()
+                        log.info("client disconnected mid-stream (%s)", ctx.id)
+                        break
+                try:
+                    head = await stream.__anext__()
+                except StopAsyncIteration:
+                    head = None
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — mid-stream: SSE error, not a 2nd response
+            failed = True
+            if not isinstance(e, OpenAIError):
+                log.exception("stream failed mid-flight (%s)", ctx.id)
+            err = e if isinstance(e, OpenAIError) else OpenAIError(
+                "stream failed", status=500, err_type="internal_error"
+            )
+            self.m_requests.inc(model=model, endpoint=endpoint, status=str(err.status))
+            with contextlib.suppress(ConnectionResetError, ConnectionError):
+                await resp.write(sse_event(json.dumps(err.body())))
+                await resp.write(SSE_DONE)
+                await resp.write_eof()
         if last_gen is not None:
             self.m_output_tokens.inc(last_gen.completion_tokens, model=model)
-        if not ctx.cancelled:
+        if not ctx.cancelled and not failed:
             self.m_requests.inc(model=model, endpoint=endpoint, status="200")
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 await resp.write(SSE_DONE)

@@ -55,12 +55,25 @@ class Migration(Operator):
                     context.id, migrations, self.migration_limit, len(emitted),
                 )
                 # Re-dispatch: generated tokens become part of the prompt;
-                # the generation budget shrinks by what was already emitted.
+                # the generation budget (max AND min) shrinks by what was
+                # already emitted so the client-requested lengths hold.
                 request = dict(request)
                 request["token_ids"] = list(request.get("token_ids") or []) + emitted
                 stop = dict(request.get("stop") or {})
                 if stop.get("max_tokens") is not None:
                     stop["max_tokens"] = max(1, stop["max_tokens"] - len(emitted))
-                    request["stop"] = stop
+                if stop.get("min_tokens"):
+                    stop["min_tokens"] = max(0, stop["min_tokens"] - len(emitted))
+                request["stop"] = stop
+                # Seeded sampling: the new worker's emission index restarts
+                # at 0, so fold the carried-token count into the seed — the
+                # continuation draws fresh noise instead of replaying the
+                # gumbel indices the dead worker already consumed. (A
+                # migrated seeded stream is a fresh draw, not a bitwise
+                # continuation — same stance as engine restart.)
+                sampling = dict(request.get("sampling") or {})
+                if sampling.get("seed") is not None:
+                    sampling["seed"] = (int(sampling["seed"]) + 0x9E3779B1 * len(emitted)) & 0x7FFFFFFF
+                    request["sampling"] = sampling
                 emitted = []
                 continue

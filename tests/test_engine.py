@@ -184,6 +184,29 @@ def test_pool_exhaustion_raises():
         pool.allocate_sequence([], 1)
 
 
+def test_pool_clear_emits_exact_removed_hashes():
+    """clear() drops only ref-0 cached blocks and emits `removed` with
+    exactly those hashes — referenced blocks stay registered so remote
+    indexers don't desync (ADVICE r2)."""
+    events = []
+    pool = BlockPool(8, 4, event_sink=events.append)
+    held, _ = pool.allocate_sequence([], 2)
+    pool.register_block(held[0], 11, None)
+    pool.register_block(held[1], 12, 11)
+    idle, _ = pool.allocate_sequence([], 2)
+    pool.register_block(idle[0], 21, None)
+    pool.register_block(idle[1], 22, 21)
+    pool.free_sequence(idle)  # → cached, evictable
+    events.clear()
+    dropped = pool.clear()
+    assert dropped == 2
+    assert len(events) == 1 and events[0].kind == "removed"
+    assert sorted(events[0].block_hashes) == [21, 22]
+    # Held blocks still prefix-matchable; idle ones gone.
+    assert pool.match_prefix([11, 12]) == held
+    assert pool.match_prefix([21]) == []
+
+
 # ---------------------------------------------------------------------------
 # Engine (async API)
 # ---------------------------------------------------------------------------
