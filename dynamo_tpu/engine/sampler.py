@@ -54,33 +54,41 @@ def sample_simple(
     return jnp.argmax(noisy, axis=-1).astype(jnp.int32)
 
 
-@jax.jit
-def sample_full(
-    logits: jax.Array,         # [B, V] fp32
-    temperature: jax.Array,    # [B]
-    top_k: jax.Array,          # [B] int32, 0 = off
-    top_p: jax.Array,          # [B] fp32, 1.0 = off
-    penalty_tokens: jax.Array,  # [B, L] int32 previously generated ids, -1 pad
-    freq_penalty: jax.Array,   # [B] fp32
-    pres_penalty: jax.Array,   # [B] fp32
-    seeds: jax.Array,          # [B] uint32
-    steps: jax.Array,          # [B] int32
-) -> jax.Array:
-    B, V = logits.shape
-
-    # Frequency/presence penalties (OpenAI semantics) over generated tokens.
+def token_counts(penalty_tokens: jax.Array, V: int) -> jax.Array:
+    """[B, L] generated ids (-1 pad) → [B, V] fp32 occurrence counts."""
+    B = penalty_tokens.shape[0]
     valid = penalty_tokens >= 0
     safe = jnp.where(valid, penalty_tokens, 0)
-    counts = jnp.zeros((B, V), jnp.float32).at[
+    return jnp.zeros((B, V), jnp.float32).at[
         jnp.arange(B)[:, None], safe
     ].add(valid.astype(jnp.float32))
-    logits = logits - freq_penalty[:, None] * counts
-    logits = logits - pres_penalty[:, None] * (counts > 0).astype(jnp.float32)
 
+
+def apply_penalties(
+    logits: jax.Array,        # [B, V] fp32
+    counts: jax.Array,        # [B, V] fp32 occurrence counts of generated ids
+    freq_penalty: jax.Array,  # [B] fp32
+    pres_penalty: jax.Array,  # [B] fp32
+) -> jax.Array:
+    """OpenAI frequency/presence penalties over generated tokens."""
+    logits = logits - freq_penalty[:, None] * counts
+    return logits - pres_penalty[:, None] * (counts > 0).astype(jnp.float32)
+
+
+def sample_step(
+    logits: jax.Array,       # [B, V] fp32 (penalties already applied)
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,        # [B] int32, 0 = off
+    top_p: jax.Array,        # [B] fp32, 1.0 = off
+    gumbel: jax.Array,       # [B, V] fp32 noise
+) -> jax.Array:
+    """Exact top-k + top-p (nucleus) + temperature + gumbel-max. The core
+    shared by the standalone full sampler and the fused decode loop."""
     greedy = temperature < _GREEDY_EPS
     temp = jnp.where(greedy, 1.0, temperature)
     scaled = logits / temp[:, None]
 
+    V = logits.shape[1]
     svals, sidx = jax.lax.top_k(scaled, V)  # descending sort
     ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
     k = jnp.where(top_k <= 0, V, top_k)[:, None]
@@ -93,9 +101,28 @@ def sample_full(
     keep = keep.at[:, 0].set(True)  # never mask the argmax
     masked = jnp.where(keep, svals, -jnp.inf)
 
-    gumbel = _row_gumbel(seeds, steps, V)
-    pick = jnp.argmax(jnp.where(greedy[:, None], masked, masked + gumbel), axis=-1)
+    noise = jnp.take_along_axis(gumbel, sidx, axis=-1)
+    pick = jnp.argmax(jnp.where(greedy[:, None], masked, masked + noise), axis=-1)
     return jnp.take_along_axis(sidx, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+@jax.jit
+def sample_full(
+    logits: jax.Array,         # [B, V] fp32
+    temperature: jax.Array,    # [B]
+    top_k: jax.Array,          # [B] int32, 0 = off
+    top_p: jax.Array,          # [B] fp32, 1.0 = off
+    penalty_tokens: jax.Array,  # [B, L] int32 previously generated ids, -1 pad
+    freq_penalty: jax.Array,   # [B] fp32
+    pres_penalty: jax.Array,   # [B] fp32
+    seeds: jax.Array,          # [B] uint32
+    steps: jax.Array,          # [B] int32
+) -> jax.Array:
+    V = logits.shape[1]
+    counts = token_counts(penalty_tokens, V)
+    logits = apply_penalties(logits, counts, freq_penalty, pres_penalty)
+    gumbel = _row_gumbel(seeds, steps, V)
+    return sample_step(logits, temperature, top_k, top_p, gumbel)
 
 
 def row_needs_full(top_k, top_p, freq_penalty, pres_penalty) -> bool:
