@@ -31,8 +31,13 @@ def parse_args(argv=None):
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--model-name", default=None, help="served model name (defaults to preset name)")
     p.add_argument("--engine", choices=["tpu", "mocker"], default="tpu")
-    p.add_argument("--preset", default="llama-1b", help="model preset (engine=tpu)")
-    p.add_argument("--tokenizer", default="byte", help='"byte" or "hf:<path>"')
+    p.add_argument("--preset", default="llama-1b", help="model preset (engine=tpu, random weights)")
+    p.add_argument(
+        "--model-path", default=None,
+        help="local HF checkpoint dir (config.json + *.safetensors + tokenizer.json); "
+             "overrides --preset with real weights",
+    )
+    p.add_argument("--tokenizer", default="byte", help='"byte" or "hf:<path>" (defaults to hf:<model-path> when --model-path is set)')
     p.add_argument("--context-length", type=int, default=None)
     p.add_argument("--migration-limit", type=int, default=0)
     # engine shape knobs
@@ -61,6 +66,8 @@ def tokenizer_spec(arg: str) -> dict:
 
 async def build_engine(args):
     """→ (engine, model_card). Engine exposes .generate/.metrics/.pool."""
+    if args.model_path and args.tokenizer == "byte":
+        args.tokenizer = f"hf:{args.model_path}"
     tok_spec = tokenizer_spec(args.tokenizer)
     tokenizer = load_tokenizer(tok_spec)
     eos_ids = list(tokenizer.eos_token_ids)
@@ -83,7 +90,20 @@ async def build_engine(args):
         from dynamo_tpu.engine.config import EngineArgs, ModelConfig
         from dynamo_tpu.engine.engine import TpuEngine
 
-        model = ModelConfig.preset(args.preset)
+        params = None
+        sharding = None
+        if args.model_path:
+            from dynamo_tpu.engine.loader import config_from_hf, load_model
+
+            if args.tp > 1:
+                from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
+
+                sharding = ModelSharding(build_mesh(tp=args.tp), config_from_hf(args.model_path))
+            model, params = await asyncio.to_thread(
+                load_model, args.model_path, args.dtype, sharding
+            )
+        else:
+            model = ModelConfig.preset(args.preset)
         eargs = EngineArgs(
             model=model,
             block_size=args.block_size,
@@ -94,9 +114,11 @@ async def build_engine(args):
             tp=args.tp,
             decode_steps=args.decode_steps,
         )
-        engine = await TpuEngine(eargs, seed=args.seed).start()
+        engine = await TpuEngine(
+            eargs, params=params, seed=args.seed, sharding=sharding
+        ).start()
         name = args.model_name or model.name
-        context_length = args.context_length or args.max_model_len
+        context_length = args.context_length or min(args.max_model_len, model.max_position)
     card = ModelDeploymentCard(
         name=name,
         tokenizer=tok_spec,

@@ -1,0 +1,192 @@
+"""Checkpoint loader tests: HF safetensors → engine pytree, with logit
+parity against the trusted transformers CPU implementation.
+
+This is the correctness anchor for real-model serving (VERDICT r2 next
+#2): if prefill/decode logits match HF's forward on a random-init tiny
+llama, the weight mapping, RoPE convention, GQA head ordering and norm
+placement are all right.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.engine import model as M  # noqa: E402
+from dynamo_tpu.engine.loader import config_from_hf, load_model  # noqa: E402
+
+
+def make_hf_llama(tmp_path, tie: bool, num_kv_heads: int = 2):
+    cfg = transformers.LlamaConfig(
+        vocab_size=97,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=num_kv_heads,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=tie,
+        torch_dtype="float32",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    path = tmp_path / "tiny-llama"
+    model.save_pretrained(path, safe_serialization=True)
+    return model, str(path)
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_logit_parity_prefill(tmp_path, tie):
+    hf, path = make_hf_llama(tmp_path, tie)
+    cfg, params = load_model(path, dtype=jnp.float32)
+    assert cfg.tie_embeddings == tie
+    assert cfg.num_kv_heads == 2 and cfg.num_heads == 4
+
+    rng = np.random.default_rng(0)
+    T = 12
+    toks = rng.integers(1, cfg.vocab_size - 1, size=T).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks[None].astype(np.int64))).logits[0, -1].numpy()
+
+    bs = 4
+    cache = M.init_kv_cache(cfg, num_blocks=16, block_size=bs, dtype=jnp.float32)
+    table = np.zeros((4,), np.int32)
+    table[: (T + bs - 1) // bs] = np.arange(1, 1 + (T + bs - 1) // bs)
+    pad = np.zeros((16,), np.int32)
+    pad[:T] = toks
+    logits, cache = M.prefill(
+        cfg, params, cache, jnp.asarray(pad), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(T),
+    )
+    np.testing.assert_allclose(np.asarray(logits), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_logit_parity_decode_step(tmp_path):
+    hf, path = make_hf_llama(tmp_path, tie=False)
+    cfg, params = load_model(path, dtype=jnp.float32)
+
+    rng = np.random.default_rng(1)
+    T = 9
+    toks = rng.integers(1, cfg.vocab_size - 1, size=T).astype(np.int32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(toks[None].astype(np.int64))).logits[0, -1].numpy()
+
+    # Prefill T-1 tokens, then decode the final token through decode_step.
+    bs = 4
+    cache = M.init_kv_cache(cfg, num_blocks=16, block_size=bs, dtype=jnp.float32)
+    nblocks = (T + bs - 1) // bs
+    table = np.zeros((4,), np.int32)
+    table[:nblocks] = np.arange(1, 1 + nblocks)
+    pad = np.zeros((8,), np.int32)
+    pad[: T - 1] = toks[: T - 1]
+    _, cache = M.prefill(
+        cfg, params, cache, jnp.asarray(pad), jnp.asarray(table),
+        jnp.int32(0), jnp.int32(T - 1),
+    )
+    logits, cache = M.decode_step(
+        cfg, params, cache,
+        jnp.asarray([toks[-1]]), jnp.asarray([T - 1], jnp.int32),
+        jnp.asarray(table[None, :]), jnp.asarray([True]),
+    )
+    np.testing.assert_allclose(np.asarray(logits)[0], ref, rtol=2e-4, atol=2e-4)
+
+
+def test_config_from_hf_fields(tmp_path):
+    _, path = make_hf_llama(tmp_path, tie=True)
+    cfg = config_from_hf(path)
+    assert cfg.vocab_size == 97
+    assert cfg.hidden_size == 64
+    assert cfg.intermediate_size == 128
+    assert cfg.num_layers == 2
+    assert cfg.head_dim == 16
+    assert cfg.rope_theta == 10000.0
+    assert cfg.max_position == 256
+
+
+def test_sharded_index_checkpoint(tmp_path):
+    """Loader follows model.safetensors.index.json across shards."""
+    import os
+
+    from safetensors.numpy import load_file, save_file
+
+    _, path = make_hf_llama(tmp_path, tie=False)
+    tensors = load_file(os.path.join(path, "model.safetensors"))
+    names = sorted(tensors)
+    half = len(names) // 2
+    shards = {
+        "model-00001-of-00002.safetensors": {n: tensors[n] for n in names[:half]},
+        "model-00002-of-00002.safetensors": {n: tensors[n] for n in names[half:]},
+    }
+    weight_map = {}
+    for fname, part in shards.items():
+        save_file(part, os.path.join(path, fname))
+        weight_map.update({n: fname for n in part})
+    os.remove(os.path.join(path, "model.safetensors"))
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+
+    cfg, params = load_model(path, dtype=jnp.float32)
+    assert params["layers"]["wq"].shape == (2, 64, 64)
+
+
+def test_engine_greedy_generation_matches_hf(tmp_path):
+    """Full engine path (chunked prefill → fused multi-step decode →
+    sampling) on real loaded weights reproduces transformers' greedy
+    continuation token-for-token."""
+    import asyncio
+
+    from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    hf, path = make_hf_llama(tmp_path, tie=False)
+    cfg, params = load_model(path, dtype=jnp.float32)
+
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, cfg.vocab_size - 1, size=11).astype(np.int64)
+    N = 16
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor(prompt[None]), max_new_tokens=N, do_sample=False,
+            eos_token_id=None, pad_token_id=0,
+        )[0, len(prompt):].tolist()
+
+    async def go():
+        eargs = EngineArgs(
+            model=cfg, block_size=4, num_kv_blocks=64, max_num_seqs=2,
+            max_model_len=64, dtype="float32", decode_steps=4,
+        )
+        engine = await TpuEngine(eargs, params=params).start()
+        req = PreprocessedRequest(model=cfg.name, token_ids=prompt.tolist())
+        req.sampling.temperature = 0.0
+        req.stop.max_tokens = N
+        req.stop.ignore_eos = True
+        out = []
+        async for item in engine.generate(req, Context()):
+            out.extend(item.get("token_ids") or [])
+        await engine.stop()
+        return out
+
+    got = asyncio.run(go())
+    assert got == ref
+
+
+def test_missing_tensor_raises(tmp_path):
+    import os
+
+    from safetensors.numpy import load_file, save_file
+
+    _, path = make_hf_llama(tmp_path, tie=False)
+    tensors = load_file(os.path.join(path, "model.safetensors"))
+    tensors.pop("model.layers.1.mlp.up_proj.weight")
+    save_file(tensors, os.path.join(path, "model.safetensors"))
+    with pytest.raises(KeyError, match="up_proj"):
+        load_model(path, dtype=jnp.float32)
