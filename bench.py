@@ -1,16 +1,25 @@
 """Engine benchmark on the locally-attached accelerator (real TPU under
 the driver; CPU fallback for dev).
 
-Workload: continuous-batching decode throughput + single-request TTFT on
-the flagship preset, random weights (perf is weight-value-independent).
+Workload: saturating continuous-batching decode with ShareGPT-like mixed
+prompt/generation lengths (lognormal, clipped), plus single-request TTFT
+on an idle engine. Random weights (decode throughput is weight-value-
+independent; real checkpoints load via engine.loader — tested for logit
+parity in tests/test_loader.py).
 
 Prints ONE JSON line:
   {"metric": "decode_tok_s", "value": N, "unit": "tok/s", "vs_baseline": R, ...}
 
-vs_baseline compares against the reference's profiled decode throughput
-per GPU — 51.22 tok/s/GPU ITL-constrained (DS-Distill-Llama-8B, H100 TP4;
-reference: benchmarks/profiler/README.md:28, BASELINE.md) — i.e. value /
-51.22 on our single chip. Extra keys are informational.
+vs_baseline: the reference's profiled decode number is 51.22 tok/s/GPU
+*for an 8B model* (ITL-constrained, DS-Distill-Llama-8B, H100 TP4;
+reference: benchmarks/profiler/README.md:28, BASELINE.md). A raw ratio
+against a smaller model inflates, so we normalize by parameter count:
+  vs_baseline = (tok_s * params / 8.03e9) / 51.22
+i.e. "8B-equivalent tokens/sec per chip" over the reference's per-GPU
+number. Raw ratio + assumptions are in the extra keys. (llama-8b bf16
+weights are 16 GB and do not fit a single v5e chip — 8B serving needs
+tp>=2; the parity-normalized 1B/3B number is the honest single-chip
+comparison.)
 """
 
 from __future__ import annotations
@@ -18,6 +27,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 
@@ -27,19 +37,27 @@ import numpy as np
 def parse_args():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama-1b")
-    p.add_argument("--num-requests", type=int, default=128)
-    p.add_argument("--prompt-len", type=int, default=128)
-    p.add_argument("--gen-len", type=int, default=128)
-    p.add_argument("--max-num-seqs", type=int, default=64)
+    p.add_argument("--num-requests", type=int, default=192)
+    p.add_argument("--prompt-len", type=int, default=128, help="median prompt length")
+    p.add_argument("--gen-len", type=int, default=128, help="median generation length")
+    p.add_argument("--fixed-len", action="store_true", help="disable mixed lengths")
+    p.add_argument("--max-num-seqs", type=int, default=128)
     p.add_argument("--decode-steps", type=int, default=32,
                    help="fused decode substeps per host sync")
     p.add_argument("--cpu", action="store_true", help="force CPU + tiny model (dev)")
+    p.add_argument("--no-compile-cache", action="store_true")
     return p.parse_args()
 
 
 # Peak bf16 TFLOP/s for MFU estimation (v5e ≈ 197 int8 / ~98 bf16; we use
 # the bf16 figure and flag the assumption in output).
 PEAK_BF16_TFLOPS = 98.0
+REF_8B_PARAMS = 8.03e9
+REF_DECODE_TOK_S_PER_GPU = 51.22
+
+
+def pctl(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
 
 
 async def bench(args) -> dict:
@@ -50,6 +68,11 @@ async def bench(args) -> dict:
     from dynamo_tpu.llm.protocols import PreprocessedRequest
     from dynamo_tpu.runtime.engine import Context
 
+    if not args.no_compile_cache:
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
         model = ModelConfig.preset("test-tiny")
@@ -57,86 +80,116 @@ async def bench(args) -> dict:
         model = ModelConfig.preset(args.model)
     device = str(jax.devices()[0])
 
+    rng = np.random.default_rng(0)
+    n = args.num_requests
+
+    # ShareGPT-like length mix: lognormal around the medians, clipped.
+    if args.fixed_len:
+        prompt_lens = np.full(n, args.prompt_len)
+        gen_lens = np.full(n, args.gen_len)
+    else:
+        prompt_lens = np.clip(
+            (args.prompt_len * rng.lognormal(0.0, 0.6, n)).astype(int), 16, args.prompt_len * 4
+        )
+        gen_lens = np.clip(
+            (args.gen_len * rng.lognormal(0.0, 0.6, n)).astype(int), 8, args.gen_len * 4
+        )
+
     block_size = 16
     # Headroom so multi-step windows never fall back to the per-step path
     # mid-run (which would compile inside the timed section).
-    seq_len = args.prompt_len + args.gen_len + args.decode_steps
+    seq_len = int(prompt_lens.max() + gen_lens.max()) + args.decode_steps
     blocks_per_seq = (seq_len + block_size - 1) // block_size + 1
     eargs = EngineArgs(
         model=model,
         block_size=block_size,
-        num_kv_blocks=max(args.max_num_seqs * blocks_per_seq * 2, 128),
+        num_kv_blocks=max(args.max_num_seqs * blocks_per_seq, 256),
         max_num_seqs=args.max_num_seqs,
         max_model_len=(blocks_per_seq + 1) * block_size,
-        max_prefill_tokens=max(512, args.prompt_len),
+        max_prefill_tokens=max(512, int(prompt_lens.max())),
         dtype="float32" if args.cpu else "bfloat16",
         decode_steps=args.decode_steps,
     )
     engine = await TpuEngine(eargs, seed=0).start()
 
-    rng = np.random.default_rng(0)
-
     def make_req(i: int) -> PreprocessedRequest:
-        toks = rng.integers(1, model.vocab_size - 1, size=args.prompt_len).tolist()
+        toks = rng.integers(1, model.vocab_size - 1, size=int(prompt_lens[i % n])).tolist()
         req = PreprocessedRequest(model=model.name, token_ids=toks)
         req.sampling.temperature = 0.0
-        req.stop.max_tokens = args.gen_len
+        req.stop.max_tokens = int(gen_lens[i % n])
         req.stop.ignore_eos = True
         return req
 
-    async def run_one(req, first_token_t: list | None = None):
-        n = 0
+    async def run_one(req, record: dict | None = None):
+        t_submit = time.perf_counter()
+        n_tok = 0
+        t_first = t_last = None
         async for item in engine.generate(req, Context()):
-            n += len(item.get("token_ids") or [])
-            if first_token_t is not None and not first_token_t:
-                first_token_t.append(time.perf_counter())
-        return n
+            k = len(item.get("token_ids") or [])
+            if k:
+                t_last = time.perf_counter()
+                if t_first is None:
+                    t_first = t_last
+                n_tok += k
+        if record is not None and t_first is not None:
+            record["ttft"] = t_first - t_submit
+            record["dur"] = (t_last - t_first) if n_tok > 1 else 0.0
+            record["n"] = n_tok
+        return n_tok
 
-    # Warmup: compile every decode batch bucket (the measured run's batch
-    # occupancy drifts through them as requests finish) + the prefill
-    # bucket. The K=1 fallback path stays cold by design: the measured run
-    # cannot reach it (greedy sampling + decode_steps of max_model_len
-    # headroom + a 2x-provisioned block pool).
+    # Warmup: compile the steady-state bucket ladder (full batch at every
+    # table-width bucket) plus ramp-up batch buckets. The persistent
+    # compilation cache makes later runs cheap.
     t0 = time.perf_counter()
-    for n in eargs.decode_buckets:
-        warm = [make_req(i) for i in range(n)]
+    for nb in eargs.decode_buckets:
+        warm = [make_req(i) for i in range(nb)]
         for w in warm:
             w.stop.max_tokens = args.decode_steps + 2
         await asyncio.gather(*(run_one(w) for w in warm))
     warmup_s = time.perf_counter() - t0
 
     # TTFT: single request, quiet engine.
-    ft: list = []
-    t0 = time.perf_counter()
-    req = make_req(10_000)
+    idle_rec: dict = {}
+    req = make_req(0)
     req.stop.max_tokens = 4
-    await run_one(req, ft)
-    ttft_ms = (ft[0] - t0) * 1000 if ft else float("nan")
+    await run_one(req, idle_rec)
+    ttft_idle_ms = idle_rec.get("ttft", float("nan")) * 1000
 
     # Throughput: N concurrent requests through continuous batching.
-    reqs = [make_req(i) for i in range(args.num_requests)]
+    reqs = [make_req(i) for i in range(n)]
+    recs: list[dict] = [{} for _ in range(n)]
     t0 = time.perf_counter()
-    counts = await asyncio.gather(*(run_one(r) for r in reqs))
+    counts = await asyncio.gather(*(run_one(r, rec) for r, rec in zip(reqs, recs)))
     elapsed = time.perf_counter() - t0
     total = int(sum(counts))
     decode_tok_s = total / elapsed
 
     await engine.stop()
 
+    ttfts = [r["ttft"] for r in recs if "ttft" in r]
+    itls = [r["dur"] / (r["n"] - 1) for r in recs if r.get("n", 0) > 1]
     flops_per_token = 2 * model.param_count()
     mfu = decode_tok_s * flops_per_token / (PEAK_BF16_TFLOPS * 1e12)
+    norm_tok_s = decode_tok_s * model.param_count() / REF_8B_PARAMS
     return {
         "metric": "decode_tok_s",
         "value": round(decode_tok_s, 2),
         "unit": "tok/s",
-        "vs_baseline": round(decode_tok_s / 51.22, 3),
-        "ttft_ms": round(ttft_ms, 1),
+        "vs_baseline": round(norm_tok_s / REF_DECODE_TOK_S_PER_GPU, 3),
+        "vs_baseline_basis": "8B-param-normalized tok/s per chip vs 51.22 tok/s/GPU (H100 TP4, 8B)",
+        "vs_baseline_raw_ratio": round(decode_tok_s / REF_DECODE_TOK_S_PER_GPU, 2),
         "model": model.name,
         "params": model.param_count(),
         "device": device,
-        "num_requests": args.num_requests,
-        "prompt_len": args.prompt_len,
-        "gen_len": args.gen_len,
+        "num_requests": n,
+        "workload": "fixed" if args.fixed_len else "lognormal-mixed",
+        "prompt_len_median": int(np.median(prompt_lens)),
+        "gen_len_median": int(np.median(gen_lens)),
+        "total_tokens": total,
+        "ttft_idle_ms": round(ttft_idle_ms, 1),
+        "ttft_p50_ms": round(pctl(ttfts, 50) * 1000, 1),
+        "ttft_p99_ms": round(pctl(ttfts, 99) * 1000, 1),
+        "itl_mean_ms": round(float(np.mean(itls)) * 1000, 2) if itls else float("nan"),
         "mfu_est": round(mfu, 4),
         "mfu_peak_assumed_tflops": PEAK_BF16_TFLOPS,
         "warmup_s": round(warmup_s, 1),

@@ -87,12 +87,12 @@ class ModelConfig:
         return presets[name]
 
 
-def _pow2_buckets(lo: int, hi: int) -> tuple[int, ...]:
+def _pow2_buckets(lo: int, hi: int, factor: int = 2) -> tuple[int, ...]:
     out = []
     b = lo
     while b < hi:
         out.append(b)
-        b *= 2
+        b *= factor
     out.append(hi)
     return tuple(dict.fromkeys(out))
 
@@ -117,6 +117,14 @@ class EngineArgs:
     # key throughput lever when host↔device roundtrips are slow; tokens
     # stream in bursts of this size. 1 = classic per-step loop.
     decode_steps: int = 8
+    # Max prompt tokens admitted per scheduler step (prefill-vs-decode
+    # fairness knob). Each admitted prompt still prefills in
+    # max_prefill_tokens chunks; this budget only gates how many requests
+    # join between decode windows. Too small trickle-admits under bursts —
+    # every K-step window then runs at a tiny batch (measured 5x
+    # throughput loss on ramp-up); too large starves running decodes.
+    # 0 = admit until slots are full.
+    admission_budget_tokens: int = 8192
 
     def __post_init__(self):
         if self.max_model_len % self.block_size:
@@ -138,7 +146,10 @@ class EngineArgs:
 
     @property
     def decode_buckets(self) -> tuple[int, ...]:
-        return _pow2_buckets(1, self.max_num_seqs)
+        # Floor of 8: decode steps are parameter-bandwidth-bound, so
+        # padding tiny batches to 8 is near-free while halving the
+        # compiled-variant count (compiles are 20-40 s on the tunnel).
+        return _pow2_buckets(min(8, self.max_num_seqs), self.max_num_seqs)
 
     @property
     def table_buckets(self) -> tuple[int, ...]:
@@ -146,8 +157,10 @@ class EngineArgs:
         with the table width actually passed (model.py derives W from the
         shape), so short sequences must not pay for max_model_len — each
         batch uses the smallest bucket covering its longest sequence
-        (VERDICT r2 weak #3)."""
-        return _pow2_buckets(min(4, self.blocks_per_seq), self.blocks_per_seq)
+        (VERDICT r2 weak #3). 4x stride: the attention surcharge of an
+        oversized bucket is small next to param reads, and the
+        (B x W x mode) compile matrix must stay small."""
+        return _pow2_buckets(min(8, self.blocks_per_seq), self.blocks_per_seq, factor=4)
 
     def bucket_table(self, n_blocks: int) -> int:
         for b in self.table_buckets:

@@ -25,6 +25,7 @@ import asyncio
 import collections
 import random
 import threading
+import time
 from typing import Any, AsyncIterator
 
 import numpy as np
@@ -33,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
+from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine import model as M
 from dynamo_tpu.engine.config import EngineArgs
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full, sample_full, sample_simple
@@ -56,7 +58,7 @@ class _Seq:
         "request_id", "tokens", "prompt_len", "sampling", "stop", "eos_ids",
         "block_ids", "block_seq", "registered_blocks", "queue", "emitted",
         "cancelled", "preempted", "prefix_hit_blocks", "sample_seed",
-        "kv_written",
+        "kv_written", "export", "export_meta", "inject",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -83,6 +85,11 @@ class _Seq:
         # just-sampled token's KV lands on the NEXT step (it is that step's
         # input), so sealing a block lags writing it.
         self.kv_written = 0
+        # Disaggregation (engine side of llm/disagg.py):
+        ktp = req.kv_transfer_params or {}
+        self.export = bool(ktp.get("do_remote_decode"))  # prefill-only + export KV
+        self.export_meta: dict | None = None             # filled at prefill time
+        self.inject = ktp.get("inject")                  # KvPagePayload dict to pre-load
 
     @property
     def next_write_pos(self) -> int:
@@ -120,6 +127,10 @@ class TpuEngine:
         self._waiting: collections.deque[_Seq] = collections.deque()
         self._running: list[_Seq] = []
         self._stopping = False
+        # Disagg exports: handle → (KvPagePayload, deadline). Host copies,
+        # so they survive cache donation; reaped after export_ttl_s.
+        self._exports: dict[str, tuple[Any, float]] = {}
+        self.export_ttl_s = 60.0
         # Cumulative counters for metrics/bench.
         self.total_generated = 0
         self.total_prefilled = 0
@@ -266,13 +277,15 @@ class TpuEngine:
 
     def _step(self) -> None:
         self._reap_cancelled()
+        if self._exports:
+            self._reap_exports()
         # Prefill-priority admission. Prefill dispatches are async; the
         # whole admission wave shares ONE first-token sampling sync — on
         # high-latency host↔device links a per-admission sync dominates.
         # The wave is budgeted to ~one max_prefill_tokens chunk so running
         # decodes are not starved by a long burst of arrivals.
         admitted: list[tuple[_Seq, jax.Array]] = []
-        wave_budget = self.args.max_prefill_tokens
+        wave_budget = self.args.admission_budget_tokens or (1 << 62)
         while (
             self._waiting
             and len(self._running) + len(admitted) < self.args.max_num_seqs
@@ -346,6 +359,13 @@ class TpuEngine:
         seq.block_seq = TokenBlockSequence(prompt, bs)
         start = n_hit * bs
 
+        # Disagg: pre-load remotely-prefilled pages as a materialized
+        # prefix hit — the suffix (< 2 blocks) is recomputed locally, which
+        # also regenerates the first-token logits (no logit shipping).
+        if seq.inject is not None:
+            start, n_hit = self._inject_kv(seq, n_hit, max_hit)
+            seq.prefix_hit_blocks = n_hit
+
         # Table width bucketed to the sequence's actual length: prefill
         # attention cost scales with W*bs, so short prompts must not pay
         # for max_model_len (VERDICT r2 weak #3).
@@ -373,8 +393,66 @@ class TpuEngine:
         # Prompt positions are now resident in HBM; register their blocks.
         seq.kv_written = plen
         self._register_written_blocks(seq)
+
+        # Disagg: copy the full prompt blocks to host for the decode
+        # worker to fetch (reference: prefill returning kv_transfer_params,
+        # handlers.py:149-158 — here device→host DMA replaces NIXL).
+        if seq.export:
+            self._export_kv(seq, plen)
         assert logits is not None  # plen >= 1 → at least one chunk ran
         return logits
+
+    def _inject_kv(self, seq: _Seq, n_hit: int, max_hit: int) -> tuple[int, int]:
+        """Scatter fetched pages into this sequence's blocks beyond the
+        locally-hit prefix. → (new start position, new hit-block count)."""
+        payload = seq.inject
+        if isinstance(payload, dict):
+            payload = kv_transfer.KvPagePayload.from_dict(payload)
+        bs = self.args.block_size
+        n_inj = min(payload.num_tokens // bs, max_hit, payload.k.shape[1])
+        if n_inj <= n_hit:
+            return n_hit * bs, n_hit  # local cache already covers it
+        self._cache = kv_transfer.inject_pages(
+            self._cache,
+            seq.block_ids[n_hit:n_inj],
+            payload.k[:, n_hit:n_inj],
+            payload.v[:, n_hit:n_inj],
+        )
+        seq.inject = None  # free host pages promptly
+        return n_inj * bs, n_inj
+
+    def _export_kv(self, seq: _Seq, plen: int) -> None:
+        bs = self.args.block_size
+        n_exp = (plen - 1) // bs  # full blocks only; suffix recomputed remotely
+        meta = {"remote_handle": seq.request_id, "num_tokens": n_exp * bs, "num_blocks": n_exp}
+        if n_exp > 0:
+            pk, pv = kv_transfer.extract_pages(self._cache, seq.block_ids[:n_exp])
+            payload = kv_transfer.KvPagePayload(k=pk, v=pv, num_tokens=n_exp * bs)
+            with self._mutex:
+                self._exports[seq.request_id] = (payload, time.monotonic() + self.export_ttl_s)
+        seq.export_meta = meta
+
+    def prefix_hit_length(self, token_ids: list[int]) -> int:
+        """Tokens of this prompt already resident in the local prefix
+        cache (whole blocks). Used by the disagg decision: a locally-cached
+        prompt should not prefill remotely. Thread-safe."""
+        bs = self.args.block_size
+        max_hit = (len(token_ids) - 1) // bs
+        hashes = compute_block_hashes(token_ids, bs)[:max_hit]
+        return len(self.pool.match_prefix(hashes)) * bs
+
+    def take_export(self, handle: str):
+        """→ KvPagePayload | None. One-shot: the caller owns the pages."""
+        with self._mutex:
+            item = self._exports.pop(handle, None)
+        return item[0] if item else None
+
+    def _reap_exports(self) -> None:
+        now = time.monotonic()
+        with self._mutex:
+            dead = [h for h, (_, dl) in self._exports.items() if dl < now]
+            for h in dead:
+                del self._exports[h]
 
     def _register_written_blocks(self, seq: _Seq) -> None:
         """Register sealed blocks whose KV is fully written. A block sealed
@@ -594,7 +672,14 @@ class TpuEngine:
                 finish = FinishReason.LENGTH
             if finish is not None:
                 break
-        self._post(seq, LLMEngineOutput(token_ids=kept, finish_reason=finish).to_dict())
+        self._post(
+            seq,
+            LLMEngineOutput(
+                token_ids=kept,
+                finish_reason=finish,
+                kv_transfer_params=seq.export_meta if finish is not None else None,
+            ).to_dict(),
+        )
         if finish is not None:
             self._finish(seq, finish, already_posted=True)
 

@@ -1,0 +1,117 @@
+"""KV page movement: device↔host extraction/injection of paged-cache
+blocks, and the host-side wire format.
+
+This is the TPU-native v0 of the reference's NIXL KV data plane
+(reference: lib/llm/src/block_manager/storage/nixl.rs, docs/architecture/
+kvbm_architecture.md:30-44). GPUs move KV with RDMA; on TPU the
+equivalents are host DMA (device_get / device_put) for HBM↔host and the
+runtime's TCP response plane for host↔host. The same primitives back
+both disaggregated prefill→decode handoff and the G2 host offload tier.
+
+Layout: pages travel as ``[L, n, bs, KVH, hd]`` pairs (k, v) — a pure
+slice of the cache's native layout, so extract/inject are single
+gather/scatter ops XLA fuses well. ``n`` is bucketed pow2 (block id 0 is
+the garbage sink, so padding injects harmlessly).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.model import KVCache
+
+
+def _bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _extract_impl(k: jax.Array, v: jax.Array, ids: jax.Array):
+    return k[:, ids], v[:, ids]  # [L, n, bs, KVH, hd]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _inject_impl(k: jax.Array, v: jax.Array, ids: jax.Array, pk: jax.Array, pv: jax.Array):
+    return k.at[:, ids].set(pk), v.at[:, ids].set(pv)
+
+
+def extract_pages(cache: KVCache, block_ids: list[int]) -> tuple[np.ndarray, np.ndarray]:
+    """Copy the named blocks to host → (k_pages, v_pages), each
+    [L, n, bs, KVH, hd] numpy. Must run before the cache is donated to a
+    later step (i.e. on the engine thread, synchronously)."""
+    n = len(block_ids)
+    nb = _bucket(n)
+    ids = np.zeros((nb,), np.int32)
+    ids[:n] = block_ids
+    pk, pv = _extract_impl(cache.k, cache.v, jnp.asarray(ids))
+    return np.asarray(pk[:, :n]), np.asarray(pv[:, :n])
+
+
+def inject_pages(cache: KVCache, block_ids: list[int], pk: np.ndarray, pv: np.ndarray) -> KVCache:
+    """Write host pages into the named blocks (donates the cache)."""
+    n = len(block_ids)
+    assert pk.shape[1] == n and pv.shape[1] == n, "page count mismatch"
+    nb = _bucket(n)
+    ids = np.zeros((nb,), np.int32)  # pad → block 0 (garbage sink)
+    ids[:n] = block_ids
+    if nb != n:
+        pad = [(0, 0), (0, nb - n)] + [(0, 0)] * (pk.ndim - 2)
+        pk = np.pad(pk, pad)
+        pv = np.pad(pv, pad)
+    dtype = cache.k.dtype
+    k, v = _inject_impl(
+        cache.k, cache.v, jnp.asarray(ids),
+        jnp.asarray(pk, dtype), jnp.asarray(pv, dtype),
+    )
+    return KVCache(k, v)
+
+
+# ---------------------------------------------------------------------------
+# Wire format (msgpack-safe dicts with raw bytes)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KvPagePayload:
+    """Host KV pages + metadata, serializable over the response plane."""
+
+    k: np.ndarray  # [L, n, bs, KVH, hd]
+    v: np.ndarray
+    num_tokens: int  # prompt positions covered by these pages
+
+    def to_dict(self) -> dict:
+        # bf16 numpy (ml_dtypes) round-trips via uint16 view.
+        k, v = self.k, self.v
+        kind = str(k.dtype)
+        if kind == "bfloat16":
+            k, v = k.view(np.uint16), v.view(np.uint16)
+        return {
+            "k": k.tobytes(),
+            "v": v.tobytes(),
+            "shape": list(self.k.shape),
+            "dtype": kind,
+            "num_tokens": self.num_tokens,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KvPagePayload":
+        import ml_dtypes
+
+        shape = tuple(d["shape"])
+        kind = d["dtype"]
+        if kind == "bfloat16":
+            k = np.frombuffer(d["k"], np.uint16).reshape(shape).view(ml_dtypes.bfloat16)
+            v = np.frombuffer(d["v"], np.uint16).reshape(shape).view(ml_dtypes.bfloat16)
+        else:
+            k = np.frombuffer(d["k"], np.dtype(kind)).reshape(shape)
+            v = np.frombuffer(d["v"], np.dtype(kind)).reshape(shape)
+        return cls(k=k, v=v, num_tokens=int(d["num_tokens"]))

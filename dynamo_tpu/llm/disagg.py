@@ -1,0 +1,139 @@
+"""Disaggregated prefill/decode: handlers + conditional routing decision.
+
+Reference analogue: the vLLM decode-first disagg flow (reference:
+components/backends/vllm/src/dynamo/vllm/handlers.py:83-165) and the
+conditional disagg router (reference: lib/llm/src/disagg_router.rs:
+147-259). The decode worker owns the flow: when a prompt's *local*
+prefill work exceeds a threshold, it sends a max_tokens=1 copy of the
+request to a prefill worker (round-robin over the prefill component),
+pulls the exported KV pages over the response plane (the NIXL-pull
+analogue), injects them into its own cache as a materialized prefix hit,
+and decodes. On any prefill-side failure it silently falls back to local
+prefill — disagg is an optimization, never a correctness dependency.
+
+Token parity: the decode worker recomputes the last prompt block from
+injected state, so its logits/tokens are identical to an aggregated run
+(pinned by tests/test_disagg.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("disagg")
+
+
+@dataclass
+class DisaggConfig:
+    # Remote-prefill when (prompt_len - prefix_hit_len) exceeds this
+    # (reference: disagg_router.rs max_local_prefill_length).
+    max_local_prefill_length: int = 512
+    # Component serving prefill workers.
+    prefill_component: str = "prefill"
+    prefill_endpoint: str = "generate"
+    fetch_endpoint: str = "kv_fetch"
+
+
+def should_prefill_remote(
+    prefill_length: int, prefix_hit_length: int, max_local_prefill_length: int
+) -> bool:
+    """The conditional-disagg decision (reference: disagg_router.rs:
+    147-259): remote only when the work the decode worker would do
+    locally — prompt minus already-cached prefix — is above threshold."""
+    return (prefill_length - prefix_hit_length) > max_local_prefill_length
+
+
+class PrefillHandler:
+    """Prefill-worker side: pass-through to the engine plus the
+    ``kv_fetch`` endpoint serving exported pages (one-shot)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def generate(self, payload: Any, ctx: Context) -> AsyncIterator[dict]:
+        async for item in self.engine.generate(payload, ctx):
+            yield item
+
+    async def kv_fetch(self, payload: Any, ctx: Context) -> AsyncIterator[dict]:
+        handle = (payload or {}).get("handle", "")
+        export = self.engine.take_export(handle)
+        if export is None:
+            yield {"error": f"unknown or expired export handle {handle!r}"}
+        else:
+            yield export.to_dict()
+
+
+class DisaggDecodeHandler:
+    """Decode-worker side: conditional remote prefill in front of the
+    local engine. ``prefill_router``/``fetch_router`` are PushRouters on
+    the prefill component's generate/kv_fetch endpoints."""
+
+    def __init__(self, engine, prefill_router, fetch_router, cfg: DisaggConfig | None = None):
+        self.engine = engine
+        self.prefill_router = prefill_router
+        self.fetch_router = fetch_router
+        self.cfg = cfg or DisaggConfig()
+        # Observability: how many requests actually went remote.
+        self.remote_prefills = 0
+        self.local_fallbacks = 0
+
+    async def generate(self, payload: Any, ctx: Context) -> AsyncIterator[dict]:
+        req = dict(payload) if isinstance(payload, dict) else payload
+        if isinstance(req, dict) and self.prefill_router is not None:
+            tokens = req.get("token_ids") or []
+            plen = len(tokens)
+            hit_blocks = req.get("estimated_prefix_hit_num_blocks") or 0
+            # Router hint OR the local engine's own prefix cache — a prompt
+            # this worker already holds must not round-trip to prefill.
+            hit_len = max(
+                hit_blocks * self.engine.args.block_size,
+                self.engine.prefix_hit_length(tokens),
+            )
+            if should_prefill_remote(plen, hit_len, self.cfg.max_local_prefill_length):
+                inject = await self._remote_prefill(req, ctx)
+                if inject is not None:
+                    req = dict(req)
+                    req["kv_transfer_params"] = {"inject": inject}
+                    self.remote_prefills += 1
+                else:
+                    self.local_fallbacks += 1
+        async for item in self.engine.generate(req, ctx):
+            yield item
+
+    async def _remote_prefill(self, req: dict, ctx: Context) -> dict | None:
+        """Run the prompt on a prefill worker, pull its KV pages. → wire
+        KvPagePayload dict, or None to fall back to local prefill."""
+        preq = dict(req)
+        preq["stop"] = {"max_tokens": 1, "ignore_eos": True}
+        preq["kv_transfer_params"] = {"do_remote_decode": True}
+        preq.pop("estimated_prefix_hit_num_blocks", None)
+        meta = None
+        try:
+            pctx = Context(trace=ctx.trace)
+            async for raw in self.prefill_router.generate(preq, pctx):
+                if isinstance(raw, dict) and raw.get("kv_transfer_params"):
+                    meta = raw["kv_transfer_params"]
+            instance_id = pctx.metadata.get("worker_instance_id")
+        except Exception as e:  # noqa: BLE001 — disagg is best-effort
+            log.warning("remote prefill failed (%s); falling back to local", e)
+            return None
+        if not meta or not meta.get("num_blocks") or instance_id is None:
+            return None
+        try:
+            pages = None
+            async for resp in self.fetch_router.generate(
+                {"handle": meta["remote_handle"]}, Context(trace=ctx.trace),
+                instance_id=instance_id,
+            ):
+                pages = resp
+            if not pages or pages.get("error"):
+                log.warning("kv fetch failed: %s", (pages or {}).get("error", "empty"))
+                return None
+            return pages
+        except Exception as e:  # noqa: BLE001
+            log.warning("kv fetch failed (%s); falling back to local", e)
+            return None

@@ -110,9 +110,15 @@ class PreprocessedRequest:
     # holds (reference: lib/llm/src/kv_router.rs:299-369).
     estimated_prefix_hit_num_blocks: int | None = None
     annotations: dict[str, Any] = field(default_factory=dict)
+    # Disaggregation control (reference: vLLM handlers' extra_args
+    # kv_transfer_params, components/backends/vllm/src/dynamo/vllm/
+    # handlers.py:130-163): {"do_remote_decode": true} marks a prefill-only
+    # request whose KV should be exported; the in-process decode handler
+    # attaches {"inject": {...}} with fetched pages before admission.
+    kv_transfer_params: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        d = {
             "model": self.model,
             "token_ids": list(self.token_ids),
             "sampling": self.sampling.to_dict(),
@@ -121,6 +127,9 @@ class PreprocessedRequest:
             "estimated_prefix_hit_num_blocks": self.estimated_prefix_hit_num_blocks,
             "annotations": dict(self.annotations),
         }
+        if self.kv_transfer_params is not None:
+            d["kv_transfer_params"] = self.kv_transfer_params
+        return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "PreprocessedRequest":
@@ -132,6 +141,7 @@ class PreprocessedRequest:
             eos_token_ids=list(d.get("eos_token_ids") or []),
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
             annotations=dict(d.get("annotations") or {}),
+            kv_transfer_params=d.get("kv_transfer_params"),
         )
 
 

@@ -40,6 +40,15 @@ def parse_args(argv=None):
     p.add_argument("--tokenizer", default="byte", help='"byte" or "hf:<path>" (defaults to hf:<model-path> when --model-path is set)')
     p.add_argument("--context-length", type=int, default=None)
     p.add_argument("--migration-limit", type=int, default=0)
+    # disaggregated prefill/decode (reference: --is-prefill-worker,
+    # components/backends/vllm/src/dynamo/vllm/main.py:65-88)
+    p.add_argument("--is-prefill-worker", action="store_true",
+                   help="serve prefill-only + kv_fetch; no model card (run with --component prefill)")
+    p.add_argument("--remote-prefill", action="store_true",
+                   help="decode worker: offload long prefills to the prefill component")
+    p.add_argument("--prefill-component", default="prefill")
+    p.add_argument("--max-local-prefill-length", type=int, default=512,
+                   help="prompts with more uncached tokens than this prefill remotely")
     # engine shape knobs
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-kv-blocks", type=int, default=2048)
@@ -143,14 +152,43 @@ async def async_main(args) -> None:
 
     comp = rt.namespace(args.namespace).component(args.component)
 
-    async def gen_handler(payload, ctx):
-        async for item in engine.generate(payload, ctx):
-            yield item
+    if args.is_prefill_worker:
+        from dynamo_tpu.llm.disagg import PrefillHandler
 
-    await comp.endpoint(args.endpoint).serve(gen_handler)
-    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
-    await register_model(rt, args.namespace, card)
-    print(f"dynamo_tpu worker: serving {card.name} as {args.namespace}/{args.component}/{args.endpoint}", flush=True)
+        handler = PrefillHandler(engine)
+        await comp.endpoint(args.endpoint).serve(handler.generate)
+        await comp.endpoint("kv_fetch").serve(handler.kv_fetch)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        # No model card: the frontend must route only to decode workers.
+        role = "prefill worker"
+    else:
+        if args.remote_prefill:
+            from dynamo_tpu.llm.disagg import DisaggConfig, DisaggDecodeHandler
+            from dynamo_tpu.runtime.push_router import RouterMode
+
+            pcomp = rt.namespace(args.namespace).component(args.prefill_component)
+            cfg = DisaggConfig(
+                max_local_prefill_length=args.max_local_prefill_length,
+                prefill_component=args.prefill_component,
+            )
+            handler = DisaggDecodeHandler(
+                engine,
+                await pcomp.endpoint(cfg.prefill_endpoint).router(RouterMode.ROUND_ROBIN),
+                await pcomp.endpoint(cfg.fetch_endpoint).router(RouterMode.DIRECT),
+                cfg,
+            )
+        else:
+            handler = engine
+
+        async def gen_handler(payload, ctx):
+            async for item in handler.generate(payload, ctx):
+                yield item
+
+        await comp.endpoint(args.endpoint).serve(gen_handler)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        await register_model(rt, args.namespace, card)
+        role = "worker"
+    print(f"dynamo_tpu {role}: serving {card.name} as {args.namespace}/{args.component}/{args.endpoint}", flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
