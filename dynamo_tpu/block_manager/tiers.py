@@ -1,0 +1,223 @@
+"""KV cache tiers beyond HBM: G2 host RAM and G3 local disk.
+
+Reference analogue: the KVBM tier stack G1 device / G2 pinned host / G3
+disk with offload + onboard (reference: lib/llm/src/block_manager.rs:
+68-81, block_manager/offload.rs:16-46). TPU redesign: blocks are
+identified by their chained sequence hash (tokens.py semantics), pages
+move HBM↔host with the engine's DMA primitives (engine/kv_transfer.py),
+and offload is *write-through with batching* — sealed blocks are copied
+host-side once per scheduler step in one batched extract — rather than
+the reference's eviction-time write-back, because a TPU cache donation
+invalidates old device buffers and eviction happens mid-allocation where
+a synchronous extract would serialize admission.
+
+Lookup path on prefix miss in G1: G2 dict hit → pages; G2 miss → G3 file
+hit → pages (promoted back into G2). Both tiers are plain LRU over
+hash-keyed pages and thread-safe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+
+class HostBlockPool:
+    """G2: host-RAM pages keyed by sequence hash, LRU-bounded."""
+
+    def __init__(self, capacity_blocks: int, spill=None):
+        self.capacity = capacity_blocks
+        self._pages: OrderedDict[int, tuple[np.ndarray, np.ndarray]] = OrderedDict()
+        self._lock = threading.Lock()
+        self._spill = spill  # callable(hash, k, v) — e.g. DiskBlockPool.put
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pages)
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        spilled = []
+        with self._lock:
+            if seq_hash in self._pages:
+                self._pages.move_to_end(seq_hash)
+                return
+            self._pages[seq_hash] = (k, v)
+            while len(self._pages) > self.capacity:
+                h, pages = self._pages.popitem(last=False)
+                spilled.append((h, pages))
+        for h, (sk, sv) in spilled:
+            if self._spill is not None:
+                self._spill(h, sk, sv)
+
+    def get(self, seq_hash: int) -> tuple[np.ndarray, np.ndarray] | None:
+        with self._lock:
+            pages = self._pages.get(seq_hash)
+            if pages is not None:
+                self._pages.move_to_end(seq_hash)
+                self.hits += 1
+                return pages
+        self.misses += 1
+        return None
+
+    def contains(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._pages
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._pages)
+            self._pages.clear()
+            return n
+
+
+class DiskBlockPool:
+    """G3: one file per block hash under a directory, LRU by mtime order
+    (tracked in-process; files from a previous process are adopted)."""
+
+    def __init__(self, directory: str, capacity_blocks: int):
+        self.dir = directory
+        self.capacity = capacity_blocks
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._order: OrderedDict[int, None] = OrderedDict()
+        for fname in sorted(
+            os.listdir(directory),
+            key=lambda f: os.path.getmtime(os.path.join(directory, f)),
+        ):
+            if fname.endswith(".npz"):
+                try:
+                    self._order[int(fname[:-4])] = None
+                except ValueError:
+                    pass
+        self.hits = 0
+        self.misses = 0
+
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.dir, f"{seq_hash}.npz")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._order)
+
+    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+        evict: list[int] = []
+        with self._lock:
+            if seq_hash in self._order:
+                self._order.move_to_end(seq_hash)
+                return
+            self._order[seq_hash] = None
+            while len(self._order) > self.capacity:
+                evict.append(self._order.popitem(last=False)[0])
+        # bf16 numpy (ml_dtypes) isn't npz-portable → store uint16 view.
+        kind = str(k.dtype)
+        if kind == "bfloat16":
+            k, v = k.view(np.uint16), v.view(np.uint16)
+        tmp = self._path(seq_hash) + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, k=k, v=v, dtype=np.bytes_(kind))
+        os.replace(tmp, self._path(seq_hash))
+        for h in evict:
+            try:
+                os.remove(self._path(h))
+            except OSError:
+                pass
+
+    def get(self, seq_hash: int) -> tuple[np.ndarray, np.ndarray] | None:
+        path = self._path(seq_hash)
+        try:
+            with np.load(path) as z:
+                k, v, kind = z["k"], z["v"], bytes(z["dtype"]).decode()
+        except (OSError, KeyError, ValueError):
+            self.misses += 1
+            return None
+        if kind == "bfloat16":
+            import ml_dtypes
+
+            k, v = k.view(ml_dtypes.bfloat16), v.view(ml_dtypes.bfloat16)
+        with self._lock:
+            if seq_hash in self._order:
+                self._order.move_to_end(seq_hash)
+        self.hits += 1
+        return k, v
+
+    def contains(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._order
+
+    def clear(self) -> int:
+        with self._lock:
+            hashes = list(self._order)
+            self._order.clear()
+        for h in hashes:
+            try:
+                os.remove(self._path(h))
+            except OSError:
+                pass
+        return len(hashes)
+
+
+class TierStack:
+    """G2(+G3) lookup/offload facade the engine talks to.
+
+    - ``offload(pairs)``: write-through sealed blocks (bounded per call —
+      the offload queue analogue of the reference's OffloadManager
+      priority queues; overflow is dropped, it is only a cache).
+    - ``lookup_run(hashes)``: longest consecutive run of leading hashes
+      available across tiers → list of (k, v) pages, promoting G3 hits
+      into G2.
+    """
+
+    MAX_OFFLOAD_PER_STEP = 64
+
+    def __init__(self, host: HostBlockPool | None, disk: DiskBlockPool | None):
+        self.host = host
+        self.disk = disk
+        if host is not None and disk is not None:
+            host._spill = disk.put
+        self.offloaded_blocks = 0
+        self.onboarded_blocks = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.host is not None or self.disk is not None
+
+    def offload(self, pairs: list[tuple[int, np.ndarray, np.ndarray]]) -> int:
+        """pairs: (seq_hash, k_page, v_page). → number offloaded."""
+        n = 0
+        for seq_hash, k, v in pairs[: self.MAX_OFFLOAD_PER_STEP]:
+            if self.host is not None:
+                self.host.put(seq_hash, k, v)
+            elif self.disk is not None:
+                self.disk.put(seq_hash, k, v)
+            n += 1
+        self.offloaded_blocks += n
+        return n
+
+    def lookup_run(self, hashes: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
+        out: list[tuple[np.ndarray, np.ndarray]] = []
+        for h in hashes:
+            pages = self.host.get(h) if self.host is not None else None
+            if pages is None and self.disk is not None:
+                pages = self.disk.get(h)
+                if pages is not None and self.host is not None:
+                    self.host.put(h, *pages)
+            if pages is None:
+                break
+            out.append(pages)
+        self.onboarded_blocks += len(out)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "g2_blocks": len(self.host) if self.host else 0,
+            "g2_hits": self.host.hits if self.host else 0,
+            "g3_blocks": len(self.disk) if self.disk else 0,
+            "g3_hits": self.disk.hits if self.disk else 0,
+            "offloaded_blocks": self.offloaded_blocks,
+            "onboarded_blocks": self.onboarded_blocks,
+        }

@@ -125,6 +125,11 @@ class EngineArgs:
     # throughput loss on ramp-up); too large starves running decodes.
     # 0 = admit until slots are full.
     admission_budget_tokens: int = 8192
+    # KV tier stack (block_manager/tiers.py): G2 host-RAM blocks (0 = off)
+    # and optional G3 disk spill directory.
+    host_kv_blocks: int = 0
+    disk_kv_dir: str | None = None
+    disk_kv_blocks: int = 4096
 
     def __post_init__(self):
         if self.max_model_len % self.block_size:

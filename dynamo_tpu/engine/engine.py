@@ -118,6 +118,11 @@ class TpuEngine:
             enable_prefix_caching=args.prefix_caching,
         )
         self._cache: M.KVCache | None = None
+        # G2/G3 KV tiers: sealed blocks write through to host (batched per
+        # step); prefix misses in HBM onboard from the tiers instead of
+        # recomputing (block_manager/tiers.py).
+        self.tiers = self._build_tiers(args)
+        self._offload_pending: list[tuple[int, int]] = []  # (block_id, seq_hash)
 
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
@@ -134,6 +139,18 @@ class TpuEngine:
         # Cumulative counters for metrics/bench.
         self.total_generated = 0
         self.total_prefilled = 0
+
+    @staticmethod
+    def _build_tiers(args: EngineArgs):
+        from dynamo_tpu.block_manager.tiers import DiskBlockPool, HostBlockPool, TierStack
+
+        host = HostBlockPool(args.host_kv_blocks) if args.host_kv_blocks > 0 else None
+        disk = (
+            DiskBlockPool(args.disk_kv_dir, args.disk_kv_blocks)
+            if args.disk_kv_dir
+            else None
+        )
+        return TierStack(host, disk)
 
     # -- lifecycle --------------------------------------------------------
 
@@ -329,6 +346,21 @@ class TpuEngine:
                 self._emit_tokens(seq, [int(first[i])])
         if self._running:
             self._decode_iteration()
+            self._flush_offloads()
+
+    def _flush_offloads(self) -> None:
+        """Batch-extract queued sealed blocks to the host tiers: one DMA
+        per step, bounded. Runs on the engine thread before the next
+        donation can recycle the pages (blocks are referenced or at worst
+        LRU-cached until the next allocation, which happens after)."""
+        if not self._offload_pending:
+            return
+        batch = self._offload_pending[: self.tiers.MAX_OFFLOAD_PER_STEP]
+        del self._offload_pending[: len(batch)]
+        pk, pv = kv_transfer.extract_pages(self._cache, [b for b, _ in batch])
+        self.tiers.offload(
+            [(h, pk[:, i : i + 1], pv[:, i : i + 1]) for i, (_, h) in enumerate(batch)]
+        )
 
     def _reap_cancelled(self) -> None:
         for seq in [s for s in self._running if s.cancelled]:
@@ -342,6 +374,9 @@ class TpuEngine:
     def _prefill_seq(self, seq: _Seq) -> jax.Array:
         """Allocate + chunked prefill; returns last-token logits [V]
         (async, not synced). Raises on resource/validation failure."""
+        # Flush queued offloads BEFORE allocating: allocation may evict and
+        # recycle exactly the pages still waiting to be copied out.
+        self._flush_offloads()
         bs = self.args.block_size
         prompt = seq.tokens
         plen = len(prompt)
@@ -365,6 +400,22 @@ class TpuEngine:
         if seq.inject is not None:
             start, n_hit = self._inject_kv(seq, n_hit, max_hit)
             seq.prefix_hit_blocks = n_hit
+
+        # G2/G3 onboard: blocks evicted from HBM but still host-resident
+        # re-enter as a prefix hit instead of being recomputed
+        # (reference: block_manager/offload.rs onboard path).
+        if self.tiers.enabled and n_hit < max_hit:
+            run = self.tiers.lookup_run(hashes_matchable[n_hit:])
+            if run:
+                pk = np.concatenate([k for k, _ in run], axis=1)
+                pv = np.concatenate([v for _, v in run], axis=1)
+                n_onb = n_hit + len(run)
+                self._cache = kv_transfer.inject_pages(
+                    self._cache, seq.block_ids[n_hit:n_onb], pk, pv
+                )
+                n_hit = n_onb
+                start = n_hit * bs
+                seq.prefix_hit_blocks = n_hit
 
         # Table width bucketed to the sequence's actual length: prefill
         # attention cost scales with W*bs, so short prompts must not pay
@@ -467,11 +518,16 @@ class TpuEngine:
             and (seq.registered_blocks + 1) * bs <= seq.kv_written
         ):
             blk = seq.block_seq.blocks[seq.registered_blocks]
-            self.pool.register_block(
-                seq.block_ids[seq.registered_blocks],
-                blk.sequence_hash,
-                blk.parent_sequence_hash,
-            )
+            bid = seq.block_ids[seq.registered_blocks]
+            self.pool.register_block(bid, blk.sequence_hash, blk.parent_sequence_hash)
+            # Write-through offload: queue the sealed block for the end-of-
+            # step batched extract (bounded; duplicates in tiers skipped).
+            if (
+                self.tiers.enabled
+                and len(self._offload_pending) < 256
+                and not (self.tiers.host and self.tiers.host.contains(blk.sequence_hash))
+            ):
+                self._offload_pending.append((bid, blk.sequence_hash))
             seq.registered_blocks += 1
 
     # -- decode ------------------------------------------------------------
@@ -492,6 +548,10 @@ class TpuEngine:
         new prompt (reference behaviour matches vLLM recompute mode)."""
         log.warning("preempting request %s (KV pressure)", seq.request_id)
         self._running.remove(seq)
+        # Purge queued offloads of the freed blocks: they become evictable
+        # now and could be recycled before the next flush.
+        freed = set(seq.block_ids)
+        self._offload_pending = [(b, h) for b, h in self._offload_pending if b not in freed]
         self.pool.free_sequence(seq.block_ids)
         seq.block_ids = []
         seq.registered_blocks = 0

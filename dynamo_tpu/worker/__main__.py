@@ -55,6 +55,10 @@ def parse_args(argv=None):
     p.add_argument("--max-num-seqs", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--decode-steps", type=int, default=8)
+    p.add_argument("--host-kv-blocks", type=int, default=0,
+                   help="G2 host-RAM KV tier capacity in blocks (0 = off)")
+    p.add_argument("--disk-kv-dir", default=None, help="G3 disk KV tier directory")
+    p.add_argument("--disk-kv-blocks", type=int, default=4096)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
@@ -122,6 +126,9 @@ async def build_engine(args):
             dtype=args.dtype,
             tp=args.tp,
             decode_steps=args.decode_steps,
+            host_kv_blocks=args.host_kv_blocks,
+            disk_kv_dir=args.disk_kv_dir,
+            disk_kv_blocks=args.disk_kv_blocks,
         )
         engine = await TpuEngine(
             eargs, params=params, seed=args.seed, sharding=sharding
