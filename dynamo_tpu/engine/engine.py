@@ -172,7 +172,7 @@ class TpuEngine:
             # EngineArgs.tp is the CLI-level knob; explicit sharding= wins.
             from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
 
-            self._sharding = ModelSharding(build_mesh(tp=self.args.tp), self.cfg)
+            self._sharding = ModelSharding(build_mesh(tp=self.args.tp, cfg=self.cfg), self.cfg)
         if self._sharding is not None:
             self._params = self._sharding.shard_params(self._params)
             self._cache = M.KVCache(*self._sharding.shard_cache(self._cache))

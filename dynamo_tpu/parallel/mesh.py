@@ -10,8 +10,18 @@ SURVEY §2.6):
   device-local.
 - MLP: w_gate/w_up column-sharded on intermediate, w_down row-sharded →
   one all-reduce per MLP.
-- embed / lm_head / norms replicated (logits land replicated; sampling
-  is tiny). Vocab sharding is a later optimization.
+- embed / lm_head sharded on the VOCAB dim over the full tp group (the
+  logits matmul is the single largest matmul at decode; XLA all-gathers
+  the tiny [B, D] activations instead), norms replicated.
+
+**TP beyond num_kv_heads** (VERDICT r2 weak #4): the tp mesh axis is
+internally split into ``tp_kv × tp_rep``. KV projections and the KV
+cache shard over ``tp_kv`` only (and replicate over ``tp_rep``); query
+heads and MLP shard over the combined ``("tp_kv", "tp_rep")`` axes. With
+head index h = kvh·G + g (model.py's GQA reshape), row-major tuple
+sharding maps device (i, j) to kv-head group i and query-subgroup j —
+exactly the grouped layout the attention einsums expect. This expresses
+llama-70b-class tp=16 over 8 kv heads (tp_kv=8, tp_rep=2).
 
 DP: the engine batch dimension can additionally shard over a ``dp`` axis
 (used by the multichip dryrun); production DP-attention runs one worker
@@ -30,16 +40,39 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.engine.config import ModelConfig
 
 DP_AXIS = "dp"
-TP_AXIS = "tp"
+TP_KV_AXIS = "tp_kv"
+TP_REP_AXIS = "tp_rep"
+TP_AXES = (TP_KV_AXIS, TP_REP_AXIS)
 
 
-def build_mesh(tp: int = 1, dp: int = 1, devices=None) -> Mesh:
+def split_tp(tp: int, cfg: ModelConfig) -> tuple[int, int]:
+    """tp → (tp_kv, tp_rep): shard kv heads as far as they divide, then
+    replicate. Raises if the residue cannot split the query groups."""
+    tp_kv = 1
+    for cand in range(min(tp, cfg.num_kv_heads), 0, -1):
+        if tp % cand == 0 and cfg.num_kv_heads % cand == 0:
+            tp_kv = cand
+            break
+    tp_rep = tp // tp_kv
+    G = cfg.num_heads // cfg.num_kv_heads
+    if G % tp_rep:
+        raise ValueError(
+            f"tp={tp} needs query-group replication {tp_rep} but "
+            f"G={G} query heads per kv head is not divisible by it"
+        )
+    return tp_kv, tp_rep
+
+
+def build_mesh(tp: int = 1, dp: int = 1, devices=None, cfg: ModelConfig | None = None) -> Mesh:
+    """dp × tp mesh with the tp axis pre-split for kv replication. When
+    ``cfg`` is None the split is (tp, 1) — fine for tp <= num_kv_heads."""
     devices = list(devices if devices is not None else jax.devices())
     need = tp * dp
     if len(devices) < need:
         raise ValueError(f"mesh {dp}x{tp} needs {need} devices, have {len(devices)}")
-    grid = np.array(devices[:need]).reshape(dp, tp)
-    return Mesh(grid, (DP_AXIS, TP_AXIS))
+    tp_kv, tp_rep = split_tp(tp, cfg) if cfg is not None else (tp, 1)
+    grid = np.array(devices[:need]).reshape(dp, tp_kv, tp_rep)
+    return Mesh(grid, (DP_AXIS, TP_KV_AXIS, TP_REP_AXIS))
 
 
 class ModelSharding:
@@ -50,37 +83,51 @@ class ModelSharding:
     def __init__(self, mesh: Mesh, cfg: ModelConfig):
         self.mesh = mesh
         self.cfg = cfg
-        tp = mesh.shape[TP_AXIS]
+        tp_kv = mesh.shape[TP_KV_AXIS]
+        tp_rep = mesh.shape[TP_REP_AXIS]
+        tp = tp_kv * tp_rep
+        if cfg.num_kv_heads % tp_kv:
+            raise ValueError(f"num_kv_heads={cfg.num_kv_heads} not divisible by tp_kv={tp_kv}")
         if cfg.num_heads % tp:
             raise ValueError(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
-        if cfg.num_kv_heads % tp:
-            raise ValueError(f"num_kv_heads={cfg.num_kv_heads} not divisible by tp={tp}")
+        if (cfg.num_heads // cfg.num_kv_heads) % tp_rep:
+            raise ValueError(f"query groups not divisible by tp_rep={tp_rep}")
         if cfg.intermediate_size % tp:
             raise ValueError(f"intermediate_size={cfg.intermediate_size} not divisible by tp={tp}")
+        if cfg.vocab_size % tp:
+            # Vocab sharding falls back to replication on awkward sizes.
+            self._vocab_spec = None
+        else:
+            self._vocab_spec = TP_AXES
 
     def _ns(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 
     def param_shardings(self) -> dict[str, Any]:
         rep = self._ns()
-        col = self._ns(None, None, TP_AXIS)   # [L, D, out] — shard out
-        row = self._ns(None, TP_AXIS, None)   # [L, in, D] — shard in
+        col = self._ns(None, None, TP_AXES)     # [L, D, out] — shard out
+        row = self._ns(None, TP_AXES, None)     # [L, in, D] — shard in
+        kv_col = self._ns(None, None, TP_KV_AXIS)  # kv heads: shard tp_kv, replicate tp_rep
+        embed = self._ns(self._vocab_spec, None) if self._vocab_spec else rep
         shardings = {
-            "embed": rep,
+            "embed": embed,
             "final_norm": rep,
             "layers": {
-                "wq": col, "wk": col, "wv": col, "wo": row,
+                "wq": col, "wk": kv_col, "wv": kv_col, "wo": row,
                 "w_gate": col, "w_up": col, "w_down": row,
                 "attn_norm": rep, "mlp_norm": rep,
             },
         }
         if not self.cfg.tie_embeddings:
-            shardings["lm_head"] = rep
+            # [D, V] — shard vocab (the logits matmul's big dim).
+            shardings["lm_head"] = (
+                self._ns(None, self._vocab_spec) if self._vocab_spec else rep
+            )
         return shardings
 
     def cache_spec(self) -> P:
-        # [L, num_blocks, block_size, KVH, hd] — shard kv heads.
-        return P(None, None, None, TP_AXIS, None)
+        # [L, num_blocks, block_size, KVH, hd] — shard kv heads over tp_kv.
+        return P(None, None, None, TP_KV_AXIS, None)
 
     def batch_spec(self) -> P:
         return P(DP_AXIS)

@@ -1,0 +1,108 @@
+"""Leader/worker startup barrier over the KV store.
+
+Reference analogue: ``leader_worker_barrier`` (reference: lib/runtime/
+src/utils/leader_worker_barrier.rs) — a leader publishes barrier data,
+N workers check in, everyone releases together. Used for multi-host
+engine boot (all hosts must construct the same mesh before the first
+collective) and disagg fleet rollouts.
+
+Protocol (store keys under ``barrier/<name>/``):
+  leader:  put ``data`` (with its lease) → watch ``workers/`` until N
+           check-ins → put ``go``.
+  worker:  put ``workers/<id>`` (with its lease) → watch for ``go`` →
+           read ``data``.
+
+Lease-attached keys make the barrier self-cleaning: a crashed
+participant's keys vanish with its lease, and the waiters time out
+rather than hang forever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from dynamo_tpu.runtime.store import EventKind, KeyValueStore
+
+
+class BarrierTimeout(Exception):
+    pass
+
+
+def _prefix(name: str) -> str:
+    return f"barrier/{name}/"
+
+
+async def _wait_for_key(store: KeyValueStore, key: str, deadline: float) -> bytes:
+    watch = await store.watch_prefix(key)
+    try:
+        for entry in watch.snapshot:
+            if entry.key == key:
+                return entry.value
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise BarrierTimeout(f"timed out waiting for {key}")
+            try:
+                ev = await asyncio.wait_for(watch.__anext__(), remaining)
+            except (asyncio.TimeoutError, StopAsyncIteration):
+                raise BarrierTimeout(f"timed out waiting for {key}") from None
+            if ev.kind == EventKind.PUT and ev.key == key:
+                return ev.value or b""
+    finally:
+        await watch.cancel()
+
+
+async def leader_barrier(
+    store: KeyValueStore,
+    name: str,
+    num_workers: int,
+    data: bytes = b"",
+    lease_id: int | None = None,
+    timeout: float = 60.0,
+) -> None:
+    """Publish ``data``, wait for ``num_workers`` check-ins, release."""
+    deadline = time.monotonic() + timeout
+    prefix = _prefix(name)
+    await store.put(prefix + "data", data, lease_id=lease_id)
+    workers_prefix = prefix + "workers/"
+    watch = await store.watch_prefix(workers_prefix)
+    try:
+        seen = {e.key for e in watch.snapshot}
+        while len(seen) < num_workers:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise BarrierTimeout(
+                    f"barrier {name!r}: {len(seen)}/{num_workers} workers checked in"
+                )
+            try:
+                ev = await asyncio.wait_for(watch.__anext__(), remaining)
+            except (asyncio.TimeoutError, StopAsyncIteration):
+                raise BarrierTimeout(
+                    f"barrier {name!r}: {len(seen)}/{num_workers} workers checked in"
+                ) from None
+            if ev.kind == EventKind.PUT:
+                seen.add(ev.key)
+            elif ev.kind == EventKind.DELETE:
+                seen.discard(ev.key)  # a worker died pre-release
+    finally:
+        await watch.cancel()
+    await store.put(prefix + "go", b"1", lease_id=lease_id)
+
+
+async def worker_barrier(
+    store: KeyValueStore,
+    name: str,
+    worker_id: str,
+    lease_id: int | None = None,
+    timeout: float = 60.0,
+) -> bytes:
+    """Check in, wait for the leader's release. → the leader's data."""
+    deadline = time.monotonic() + timeout
+    prefix = _prefix(name)
+    await store.put(prefix + f"workers/{worker_id}", b"1", lease_id=lease_id)
+    await _wait_for_key(store, prefix + "go", deadline)
+    entry = await store.get(prefix + "data")
+    if entry is None:
+        raise BarrierTimeout(f"barrier {name!r}: released but data missing (leader died?)")
+    return entry.value

@@ -111,7 +111,8 @@ async def build_engine(args):
             if args.tp > 1:
                 from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
 
-                sharding = ModelSharding(build_mesh(tp=args.tp), config_from_hf(args.model_path))
+                hf_cfg = config_from_hf(args.model_path)
+                sharding = ModelSharding(build_mesh(tp=args.tp, cfg=hf_cfg), hf_cfg)
             model, params = await asyncio.to_thread(
                 load_model, args.model_path, args.dtype, sharding
             )

@@ -1,0 +1,56 @@
+"""leader_worker_barrier tests (reference: lib/runtime/src/utils/
+leader_worker_barrier.rs semantics: data publication + N check-ins +
+joint release + timeout on missing participants)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import BarrierTimeout, leader_barrier, worker_barrier
+from dynamo_tpu.runtime.store import connect_store
+
+
+def test_barrier_releases_all_with_data():
+    async def go():
+        store = await connect_store("memory://b1")
+
+        async def worker(i):
+            return await worker_barrier(store, "boot", f"w{i}", timeout=5)
+
+        results = await asyncio.gather(
+            leader_barrier(store, "boot", 3, data=b"mesh-config", timeout=5),
+            worker(0), worker(1), worker(2),
+        )
+        return results[1:]
+
+    assert asyncio.run(go()) == [b"mesh-config"] * 3
+
+
+def test_barrier_leader_times_out_on_missing_worker():
+    async def go():
+        store = await connect_store("memory://b2")
+        task = asyncio.create_task(worker_barrier(store, "boot", "w0", timeout=1.0))
+        with pytest.raises(BarrierTimeout):
+            await leader_barrier(store, "boot", 2, timeout=0.3)
+        with pytest.raises(BarrierTimeout):
+            await task
+        return True
+
+    assert asyncio.run(go())
+
+
+def test_barrier_worker_joining_late_still_releases():
+    async def go():
+        store = await connect_store("memory://b3")
+
+        async def late_worker():
+            await asyncio.sleep(0.1)
+            return await worker_barrier(store, "boot", "late", timeout=5)
+
+        _, data = await asyncio.gather(
+            leader_barrier(store, "boot", 1, data=b"d", timeout=5),
+            late_worker(),
+        )
+        return data
+
+    assert asyncio.run(go()) == b"d"

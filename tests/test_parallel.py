@@ -3,6 +3,7 @@ forward must match single-device logits; the sharded engine must produce
 identical greedy streams."""
 
 import asyncio
+import os
 
 import numpy as np
 import pytest
@@ -22,16 +23,51 @@ CFG = ModelConfig()  # test-tiny: 4 heads, 2 kv heads
 
 def test_build_mesh_shapes():
     mesh = build_mesh(tp=2, dp=4)
-    assert mesh.shape == {"dp": 4, "tp": 2}
+    assert mesh.shape == {"dp": 4, "tp_kv": 2, "tp_rep": 1}
     with pytest.raises(ValueError):
         build_mesh(tp=16, dp=1)
+
+
+def test_build_mesh_splits_tp_beyond_kv_heads():
+    # test-tiny: 4 heads / 2 kv heads → tp=4 must replicate kv x2.
+    mesh = build_mesh(tp=4, cfg=CFG)
+    assert mesh.shape == {"dp": 1, "tp_kv": 2, "tp_rep": 2}
 
 
 def test_sharding_divisibility_checks():
     mesh = build_mesh(tp=2)
     ModelSharding(mesh, CFG)  # ok: 4 heads / 2 kv heads / tp=2
     with pytest.raises(ValueError):
-        ModelSharding(build_mesh(tp=4), CFG)  # kv_heads=2 not divisible
+        # without cfg the tp axis is not split → kv_heads=2 not divisible
+        ModelSharding(build_mesh(tp=4), CFG)
+    with pytest.raises(ValueError):
+        # 8 devices: tp_rep=4 > G=2 query groups per kv head
+        build_mesh(tp=8, cfg=CFG)
+
+
+def test_tp_beyond_kv_heads_matches_single_device():
+    """tp=4 over 2 kv heads (kv replication x2) + vocab-sharded embed
+    must reproduce single-device logits."""
+    params = M.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+    bs = 4
+    prompt = list(range(1, 10))
+    table = np.zeros((8,), np.int32)
+    table[:3] = [1, 2, 3]
+    toks = np.zeros((12,), np.int32)
+    toks[: len(prompt)] = prompt
+
+    def run(params_in, cache_in):
+        logits_p, cache = M.prefill(
+            CFG, params_in, cache_in, jnp.asarray(toks), jnp.asarray(table),
+            jnp.int32(0), jnp.int32(len(prompt)),
+        )
+        return np.asarray(logits_p)
+
+    ref = run(params, M.init_kv_cache(CFG, 16, bs, jnp.float32))
+    mesh = build_mesh(tp=4, cfg=CFG)
+    sh = ModelSharding(mesh, CFG)
+    got = run(sh.shard_params(params), M.KVCache(*sh.shard_cache(M.init_kv_cache(CFG, 16, bs, jnp.float32))))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
 
 def test_tp_sharded_prefill_and_decode_match_single_device():
@@ -69,6 +105,56 @@ def test_tp_sharded_prefill_and_decode_match_single_device():
 
     np.testing.assert_allclose(got_p, ref_p, rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(got_d, ref_d, rtol=2e-4, atol=2e-4)
+
+
+def test_split_tp_llama70b_shape():
+    from dynamo_tpu.parallel.mesh import split_tp
+
+    cfg70 = ModelConfig.preset("llama-70b")  # 64 heads, 8 kv heads
+    assert split_tp(16, cfg70) == (8, 2)
+    assert split_tp(8, cfg70) == (8, 1)
+    assert split_tp(32, cfg70) == (8, 4)
+
+
+def test_tp16_70b_shape_runs_on_16_virtual_devices():
+    """llama-70b-shaped sharding (8 kv heads, tp=16 → kv replication x2)
+    compiles and runs a prefill on 16 virtual CPU devices (subprocess:
+    this process is pinned to 8)."""
+    import subprocess
+    import sys
+
+    script = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+import numpy as np
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+from dynamo_tpu.engine import model as M
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
+cfg = ModelConfig(name="t70", vocab_size=512, hidden_size=128, intermediate_size=256,
+                  num_layers=2, num_heads=16, num_kv_heads=8, head_dim=8)
+mesh = build_mesh(tp=16, cfg=cfg)
+assert mesh.shape == {"dp": 1, "tp_kv": 8, "tp_rep": 2}, mesh.shape
+sh = ModelSharding(mesh, cfg)
+params = sh.shard_params(M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32))
+cache = M.KVCache(*sh.shard_cache(M.init_kv_cache(cfg, 16, 4, jnp.float32)))
+toks = np.zeros((8,), np.int32); toks[:6] = [3,4,5,6,7,8]
+table = np.zeros((4,), np.int32); table[:2] = [1,2]
+logits, cache = M.prefill(cfg, params, cache, jnp.asarray(toks), jnp.asarray(table),
+                          jnp.int32(0), jnp.int32(6))
+assert np.isfinite(np.asarray(logits)).all()
+print("TP16_OK")
+"""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))), env=env,
+        timeout=240,
+    )
+    assert "TP16_OK" in out.stdout, out.stdout + out.stderr
 
 
 def test_sharded_engine_matches_unsharded_greedy():

@@ -71,9 +71,7 @@ def main():
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(1, cfg.vocab_size - 1, size=B).astype(np.int32))
     positions = jnp.full((B,), (W - 2) * bs, jnp.int32)
-    tables = jnp.asarray(
-        rng.permutation(np.arange(1, N))[: B * W].reshape(B, W).astype(np.int32)
-    )
+    tables = jnp.asarray(rng.integers(1, N, size=(B, W)).astype(np.int32))
     active = jnp.ones((B,), bool)
     temps = jnp.zeros((B,), jnp.float32)
     seeds = jnp.zeros((B,), jnp.uint32)
