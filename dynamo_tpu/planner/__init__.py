@@ -1,0 +1,34 @@
+"""SLA/load planner: predictors, perf interpolators, scaling connectors,
+and the adjustment loop (reference: components/planner/)."""
+
+from dynamo_tpu.planner.connector import (
+    LocalProcessConnector,
+    RecordingConnector,
+)
+from dynamo_tpu.planner.core import (
+    HttpMetricsSource,
+    Planner,
+    PlannerConfig,
+    PlannerObservation,
+)
+from dynamo_tpu.planner.interpolate import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+    load_profile,
+    save_profile,
+)
+from dynamo_tpu.planner.predictors import make_predictor
+
+__all__ = [
+    "Planner",
+    "PlannerConfig",
+    "PlannerObservation",
+    "HttpMetricsSource",
+    "LocalProcessConnector",
+    "RecordingConnector",
+    "DecodeInterpolator",
+    "PrefillInterpolator",
+    "load_profile",
+    "save_profile",
+    "make_predictor",
+]

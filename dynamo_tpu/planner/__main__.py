@@ -1,0 +1,89 @@
+"""Planner CLI: `python -m dynamo_tpu.planner`.
+
+Load-based autoscaling of local worker replicas against a frontend's
+/metrics (reference CLI: python -m dynamo.planner; local connector =
+the circus analogue). Worker argv after ``--`` is spawned per replica:
+
+  python -m dynamo_tpu.planner --metrics-url http://127.0.0.1:8080/metrics \
+      --min-replicas 1 --max-replicas 4 -- \
+      -m dynamo_tpu.worker --engine mocker --store-url tcp://127.0.0.1:4222
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import signal
+
+from dynamo_tpu.planner.connector import LocalProcessConnector
+from dynamo_tpu.planner.core import HttpMetricsSource, Planner, PlannerConfig
+from dynamo_tpu.planner.interpolate import load_profile
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo_tpu.planner")
+    p.add_argument("--metrics-url", required=True, help="frontend /metrics URL")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--adjustment-interval", type=float, default=30.0)
+    p.add_argument("--predictor", default="ar", choices=["constant", "moving-average", "ar"])
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--replica-tok-s", type=float, default=1000.0)
+    p.add_argument("--mean-output-tokens", type=float, default=128.0)
+    p.add_argument("--itl-sla-ms", type=float, default=None)
+    p.add_argument("--ttft-sla-ms", type=float, default=None)
+    p.add_argument("--profile", default=None, help="npz from tools/profile_sweep.py")
+    p.add_argument("worker_args", nargs=argparse.REMAINDER,
+                   help="-- followed by the worker argv (after the interpreter)")
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    worker_argv = args.worker_args
+    if worker_argv and worker_argv[0] == "--":
+        worker_argv = worker_argv[1:]
+    if not worker_argv:
+        raise SystemExit("missing worker argv after --")
+    decode_interp = prefill_interp = None
+    if args.profile:
+        decode_interp, prefill_interp = load_profile(args.profile)
+    connector = LocalProcessConnector({args.component: worker_argv})
+    planner = Planner(
+        PlannerConfig(
+            component=args.component,
+            adjustment_interval_s=args.adjustment_interval,
+            predictor=args.predictor,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            replica_tok_s=args.replica_tok_s,
+            mean_output_tokens=args.mean_output_tokens,
+            itl_sla_ms=args.itl_sla_ms,
+            ttft_sla_ms=args.ttft_sla_ms,
+        ),
+        connector,
+        HttpMetricsSource(args.metrics_url),
+        decode_interp=decode_interp,
+        prefill_interp=prefill_interp,
+    )
+    connector.set_replicas(args.component, args.min_replicas)
+    await planner.start()
+    print(f"dynamo_tpu planner: watching {args.metrics_url}, scaling {args.component}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await planner.stop()
+    connector.shutdown()
+
+
+def main(argv=None) -> int:
+    asyncio.run(async_main(parse_args(argv)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,80 @@
+"""Scaling connectors: how the planner actually changes replica counts.
+
+Reference analogue: the Kubernetes connector (patches
+DynamoGraphDeployment replicas) and the Circus local process controller
+(reference: components/planner/src/dynamo/planner/kubernetes_connector.py,
+circusd.py:32-47). Here: a local subprocess connector (spawns/terminates
+``python -m dynamo_tpu.worker`` processes) and a recording fake for
+tests/dry-runs. A K8s connector belongs with the deploy layer.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+from typing import Protocol
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("planner.connector")
+
+
+class Connector(Protocol):
+    def get_replicas(self, component: str) -> int: ...
+
+    def set_replicas(self, component: str, n: int) -> None: ...
+
+
+class RecordingConnector:
+    """Test/dry-run connector: applies nothing, records everything."""
+
+    def __init__(self, initial: dict[str, int] | None = None):
+        self.replicas: dict[str, int] = dict(initial or {})
+        self.calls: list[tuple[str, int]] = []
+
+    def get_replicas(self, component: str) -> int:
+        return self.replicas.get(component, 0)
+
+    def set_replicas(self, component: str, n: int) -> None:
+        self.calls.append((component, n))
+        self.replicas[component] = n
+
+
+class LocalProcessConnector:
+    """Scales worker replicas as local subprocesses — the dev/single-host
+    story (circus analogue). ``base_args[component]`` is the worker CLI
+    argv (without the interpreter)."""
+
+    def __init__(self, base_args: dict[str, list[str]]):
+        self.base_args = base_args
+        self._procs: dict[str, list[subprocess.Popen]] = {c: [] for c in base_args}
+
+    def get_replicas(self, component: str) -> int:
+        procs = self._procs.setdefault(component, [])
+        procs[:] = [p for p in procs if p.poll() is None]
+        return len(procs)
+
+    def set_replicas(self, component: str, n: int) -> None:
+        procs = self._procs.setdefault(component, [])
+        procs[:] = [p for p in procs if p.poll() is None]
+        while len(procs) < n:
+            argv = [sys.executable, *self.base_args[component]]
+            log.info("scaling up %s: spawning replica %d", component, len(procs) + 1)
+            procs.append(subprocess.Popen(argv))
+        while len(procs) > n:
+            proc = procs.pop()  # newest-first teardown
+            log.info("scaling down %s: terminating pid %d", component, proc.pid)
+            proc.send_signal(signal.SIGTERM)
+
+    def shutdown(self) -> None:
+        for procs in self._procs.values():
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+        for procs in self._procs.values():
+            for p in procs:
+                try:
+                    p.wait(5)
+                except subprocess.TimeoutExpired:
+                    p.kill()

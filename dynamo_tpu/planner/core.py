@@ -1,0 +1,209 @@
+"""The planner loop: observe → correct → predict → scale.
+
+Reference analogue: components/planner/src/dynamo/planner/utils/
+planner_core.py:189-341. Each ``adjustment_interval``:
+
+1. observe the frontend's metrics (request rate, TTFT, ITL) and the
+   live replica count,
+2. feed the request rate to a load predictor,
+3. compute the replica count that serves the predicted rate — from the
+   profiled per-replica capacity, SLA-corrected when interpolators are
+   available (ITL over SLA ⇒ effective capacity shrinks),
+4. clamp to [min, max] and apply through the connector.
+
+The metrics source and connector are injected, so the same core drives
+the real HTTP frontend + subprocess workers and the synthetic-load unit
+tests (reference's planner test strategy).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass, field
+
+from dynamo_tpu.planner.connector import Connector
+from dynamo_tpu.planner.interpolate import DecodeInterpolator, PrefillInterpolator
+from dynamo_tpu.planner.predictors import make_predictor
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("planner")
+
+
+@dataclass
+class PlannerObservation:
+    request_rate: float = 0.0        # requests/s over the interval
+    output_token_rate: float = 0.0   # generated tokens/s over the interval
+    ttft_ms: float | None = None     # mean over the interval
+    itl_ms: float | None = None      # mean over the interval
+
+
+@dataclass
+class PlannerConfig:
+    component: str = "backend"
+    adjustment_interval_s: float = 30.0
+    predictor: str = "ar"
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Capacity model: tokens/s one replica sustains (from profiling; the
+    # decode interpolator overrides this when present + an ITL SLA is set).
+    replica_tok_s: float = 1000.0
+    mean_output_tokens: float = 128.0  # converts request rate → token rate
+    itl_sla_ms: float | None = None
+    ttft_sla_ms: float | None = None
+    scale_down_headroom: float = 1.3   # hysteresis: scale down only under 1/headroom
+
+
+@dataclass
+class PlannerState:
+    replicas: int = 0
+    last_prediction: float = 0.0
+    adjustments: list[tuple[float, int]] = field(default_factory=list)
+
+
+class Planner:
+    def __init__(
+        self,
+        cfg: PlannerConfig,
+        connector: Connector,
+        metrics_source,  # async callable → PlannerObservation
+        decode_interp: DecodeInterpolator | None = None,
+        prefill_interp: PrefillInterpolator | None = None,
+    ):
+        self.cfg = cfg
+        self.connector = connector
+        self.metrics_source = metrics_source
+        self.decode_interp = decode_interp
+        self.prefill_interp = prefill_interp
+        self.predictor = make_predictor(cfg.predictor)
+        self.state = PlannerState()
+        self._task: asyncio.Task | None = None
+        self._stop = asyncio.Event()
+
+    # -- one adjustment ----------------------------------------------------
+
+    def replica_capacity_tok_s(self) -> float:
+        """Per-replica sustainable token rate under the SLA."""
+        if self.decode_interp is not None and self.cfg.itl_sla_ms is not None:
+            cap = self.decode_interp.best_throughput_under_itl(self.cfg.itl_sla_ms)
+            if cap > 0:
+                return cap
+        return self.cfg.replica_tok_s
+
+    def target_replicas(self, obs: PlannerObservation) -> int:
+        self.predictor.observe(obs.request_rate)
+        pred_rate = self.predictor.predict()
+        self.state.last_prediction = pred_rate
+        token_rate = pred_rate * self.cfg.mean_output_tokens
+        cap = self.replica_capacity_tok_s()
+        need = math.ceil(token_rate / cap) if cap > 0 else self.cfg.max_replicas
+
+        # SLA correction (reference: planner_core.py correction factors):
+        # observed ITL/TTFT over SLA means the capacity model is optimistic
+        # for the live workload — scale the need up proportionally.
+        if self.cfg.itl_sla_ms and obs.itl_ms and obs.itl_ms > self.cfg.itl_sla_ms:
+            need = math.ceil(need * obs.itl_ms / self.cfg.itl_sla_ms)
+        if self.cfg.ttft_sla_ms and obs.ttft_ms and obs.ttft_ms > self.cfg.ttft_sla_ms:
+            need = max(need, self.connector.get_replicas(self.cfg.component) + 1)
+
+        current = self.connector.get_replicas(self.cfg.component)
+        if need < current:
+            # Hysteresis: only scale down when the predicted demand fits
+            # comfortably in fewer replicas.
+            if token_rate * self.cfg.scale_down_headroom > (current - 1) * cap:
+                need = current
+        return max(self.cfg.min_replicas, min(self.cfg.max_replicas, need))
+
+    async def step(self) -> int:
+        obs = await self.metrics_source()
+        target = self.target_replicas(obs)
+        current = self.connector.get_replicas(self.cfg.component)
+        if target != current:
+            log.info(
+                "scaling %s: %d → %d (rate=%.2f req/s pred=%.2f itl=%s ms)",
+                self.cfg.component, current, target,
+                obs.request_rate, self.state.last_prediction, obs.itl_ms,
+            )
+            self.connector.set_replicas(self.cfg.component, target)
+            self.state.adjustments.append((asyncio.get_event_loop().time(), target))
+        self.state.replicas = target
+        return target
+
+    # -- loop --------------------------------------------------------------
+
+    async def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.step()
+            except Exception:  # noqa: BLE001 — planner must not die
+                log.exception("planner step failed")
+            try:
+                await asyncio.wait_for(self._stop.wait(), self.cfg.adjustment_interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    async def start(self) -> "Planner":
+        self._task = asyncio.get_running_loop().create_task(self.run())
+        return self
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task
+
+
+# ---------------------------------------------------------------------------
+# Metrics sources
+# ---------------------------------------------------------------------------
+
+
+class HttpMetricsSource:
+    """Scrapes the frontend's /metrics (our own Prometheus text) and
+    differences counters across calls → rates + interval means."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self._last: dict[str, float] | None = None
+        self._last_t: float | None = None
+
+    @staticmethod
+    def _parse(text: str) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            try:
+                name_labels, value = line.rsplit(" ", 1)
+            except ValueError:
+                continue
+            name = name_labels.split("{", 1)[0]
+            try:
+                out[name] = out.get(name, 0.0) + float(value)
+            except ValueError:
+                continue
+        return out
+
+    async def __call__(self) -> PlannerObservation:
+        import time
+
+        import httpx
+
+        async with httpx.AsyncClient(timeout=10) as client:
+            r = await client.get(self.url)
+        cur = self._parse(r.text)
+        now = time.monotonic()
+        obs = PlannerObservation()
+        if self._last is not None and self._last_t is not None:
+            dt = max(now - self._last_t, 1e-6)
+
+            def delta(name: str) -> float:
+                return cur.get(name, 0.0) - self._last.get(name, 0.0)
+
+            p = "dynamo_tpu_http_"
+            obs.request_rate = max(0.0, delta(p + "requests_total") / dt)
+            obs.output_token_rate = max(0.0, delta(p + "output_tokens_total") / dt)
+            dttft_n = delta(p + "time_to_first_token_seconds_count")
+            if dttft_n > 0:
+                obs.ttft_ms = delta(p + "time_to_first_token_seconds_sum") / dttft_n * 1000
+        self._last, self._last_t = cur, now
+        return obs

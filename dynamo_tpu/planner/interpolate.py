@@ -1,0 +1,79 @@
+"""Perf interpolators: profiled (batch → TTFT/ITL/throughput) samples →
+the lookup functions the SLA planner needs.
+
+Reference analogue: components/planner/src/dynamo/planner/utils/
+perf_interpolation.py:20-146 (npz from profile_sla sweeps). Here the
+profile is produced by tools/profile_sweep.py on the serving chip and
+the interpolation is plain monotone np.interp — batch is the only knob
+on a fixed mesh; mesh-shape sweeps add a file per mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DecodeInterpolator:
+    """Samples: concurrent batch size → ITL (ms) and per-chip tok/s."""
+
+    def __init__(self, batch: np.ndarray, itl_ms: np.ndarray, tok_s: np.ndarray):
+        order = np.argsort(batch)
+        self.batch = np.asarray(batch, np.float64)[order]
+        self.itl_ms = np.asarray(itl_ms, np.float64)[order]
+        self.tok_s = np.asarray(tok_s, np.float64)[order]
+
+    def itl_at(self, batch: float) -> float:
+        return float(np.interp(batch, self.batch, self.itl_ms))
+
+    def throughput_at(self, batch: float) -> float:
+        return float(np.interp(batch, self.batch, self.tok_s))
+
+    def max_batch_under_itl(self, itl_sla_ms: float) -> float:
+        """Largest batch whose interpolated ITL stays under the SLA
+        (reference: planner_core.py:253-276 inverse lookup)."""
+        grid = np.linspace(self.batch[0], self.batch[-1], 256)
+        ok = grid[np.interp(grid, self.batch, self.itl_ms) <= itl_sla_ms]
+        return float(ok[-1]) if len(ok) else 0.0
+
+    def best_throughput_under_itl(self, itl_sla_ms: float) -> float:
+        b = self.max_batch_under_itl(itl_sla_ms)
+        return self.throughput_at(b) if b > 0 else 0.0
+
+
+class PrefillInterpolator:
+    """Samples: prompt length → TTFT (ms) and prefill tok/s."""
+
+    def __init__(self, prompt_len: np.ndarray, ttft_ms: np.ndarray, tok_s: np.ndarray):
+        order = np.argsort(prompt_len)
+        self.prompt_len = np.asarray(prompt_len, np.float64)[order]
+        self.ttft_ms = np.asarray(ttft_ms, np.float64)[order]
+        self.tok_s = np.asarray(tok_s, np.float64)[order]
+
+    def ttft_at(self, prompt_len: float) -> float:
+        return float(np.interp(prompt_len, self.prompt_len, self.ttft_ms))
+
+    def throughput_at(self, prompt_len: float) -> float:
+        return float(np.interp(prompt_len, self.prompt_len, self.tok_s))
+
+
+def save_profile(path: str, *, decode: DecodeInterpolator | None = None,
+                 prefill: PrefillInterpolator | None = None, meta: dict | None = None) -> None:
+    arrays: dict = {"meta": np.bytes_(repr(meta or {}))}
+    if decode is not None:
+        arrays.update(d_batch=decode.batch, d_itl=decode.itl_ms, d_tok=decode.tok_s)
+    if prefill is not None:
+        arrays.update(p_len=prefill.prompt_len, p_ttft=prefill.ttft_ms, p_tok=prefill.tok_s)
+    np.savez(path, **arrays)
+
+
+def load_profile(path: str) -> tuple[DecodeInterpolator | None, PrefillInterpolator | None]:
+    with np.load(path) as z:
+        decode = (
+            DecodeInterpolator(z["d_batch"], z["d_itl"], z["d_tok"])
+            if "d_batch" in z else None
+        )
+        prefill = (
+            PrefillInterpolator(z["p_len"], z["p_ttft"], z["p_tok"])
+            if "p_len" in z else None
+        )
+    return decode, prefill

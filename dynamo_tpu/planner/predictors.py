@@ -1,0 +1,82 @@
+"""Load predictors for the planner.
+
+Reference analogue: components/planner/src/dynamo/planner/utils/
+load_predictor.py:62-155 (constant / ARIMA / Prophet). Here: constant,
+moving-average, and a dependency-free AR(2)-with-trend least-squares
+predictor standing in for ARIMA (the reference's Prophet path needs a
+fitted seasonal model; out of scope until there is traffic with
+seasonality to fit).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+class ConstantPredictor:
+    """Next load = last observed load."""
+
+    def __init__(self, window: int = 1):
+        self._last = 0.0
+
+    def observe(self, value: float) -> None:
+        self._last = float(value)
+
+    def predict(self) -> float:
+        return self._last
+
+
+class MovingAveragePredictor:
+    def __init__(self, window: int = 6):
+        self._values: deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._values.append(float(value))
+
+    def predict(self) -> float:
+        return float(np.mean(self._values)) if self._values else 0.0
+
+
+class ARPredictor:
+    """AR(2) + linear trend via least squares over a sliding window.
+    Falls back to moving average until enough history accumulates."""
+
+    def __init__(self, window: int = 24, order: int = 2):
+        self.order = order
+        self._values: deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._values.append(float(value))
+
+    def predict(self) -> float:
+        vals = np.asarray(self._values, dtype=np.float64)
+        n = len(vals)
+        if n < self.order + 3:
+            return float(vals.mean()) if n else 0.0
+        # Design matrix: [1, t, y_{t-1}, ..., y_{t-order}]
+        rows = []
+        targets = []
+        for t in range(self.order, n):
+            rows.append([1.0, float(t)] + [vals[t - k] for k in range(1, self.order + 1)])
+            targets.append(vals[t])
+        coef, *_ = np.linalg.lstsq(np.asarray(rows), np.asarray(targets), rcond=None)
+        nxt = [1.0, float(n)] + [vals[n - k] for k in range(1, self.order + 1)]
+        pred = float(np.dot(coef, nxt))
+        return max(0.0, pred)
+
+
+PREDICTORS = {
+    "constant": ConstantPredictor,
+    "moving-average": MovingAveragePredictor,
+    "ar": ARPredictor,
+}
+
+
+def make_predictor(kind: str, window: int = 24):
+    try:
+        cls = PREDICTORS[kind]
+    except KeyError:
+        raise ValueError(f"unknown predictor {kind!r}; have {sorted(PREDICTORS)}") from None
+    return cls(window=window) if kind != "constant" else cls()
