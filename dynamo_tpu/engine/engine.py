@@ -37,7 +37,13 @@ from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine import model as M
 from dynamo_tpu.engine.config import EngineArgs
-from dynamo_tpu.engine.sampler import needs_full, row_needs_full, sample_full, sample_simple
+from dynamo_tpu.engine.sampler import (
+    needs_full,
+    row_needs_full,
+    sample_full,
+    sample_simple,
+    token_logprobs,
+)
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
 from dynamo_tpu.runtime.engine import Context
@@ -340,10 +346,10 @@ class TpuEngine:
             B = self.args.bucket_decode(len(admitted))
             rows = [l for _, l in admitted]
             rows += [rows[0]] * (B - len(rows))
-            first = self._sample_rows(jnp.stack(rows), [s for s, _ in admitted])
+            first, first_lp = self._sample_rows(jnp.stack(rows), [s for s, _ in admitted])
             for i, (seq, _) in enumerate(admitted):
                 self._running.append(seq)
-                self._emit_tokens(seq, [int(first[i])])
+                self._emit_tokens(seq, [int(first[i])], [float(first_lp[i])])
         if self._running:
             self._decode_iteration()
             self._flush_offloads()
@@ -626,7 +632,7 @@ class TpuEngine:
             else:
                 mode = "greedy" if all(t < 1e-5 for t in temps[: len(batch)]) else "simple"
                 pen = np.full((B, 1), -1, np.int32)  # placeholder, untraced-const shape
-            toks, self._cache = M.multi_decode(
+            toks, logps, self._cache = M.multi_decode(
                 self.cfg, K, mode, self._params, self._cache,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(tables), jnp.asarray(active),
@@ -635,10 +641,15 @@ class TpuEngine:
                 jnp.asarray(freqs), jnp.asarray(press), jnp.asarray(pen),
             )
             toks_np = np.asarray(toks)  # [K, B] — the one host sync
+            logps_np = np.asarray(logps)
             for i, seq in enumerate(batch):
                 seq.kv_written = int(positions[i]) + K
                 self._register_written_blocks(seq)
-                self._emit_tokens(seq, [int(toks_np[j, i]) for j in range(K)])
+                self._emit_tokens(
+                    seq,
+                    [int(toks_np[j, i]) for j in range(K)],
+                    [float(logps_np[j, i]) for j in range(K)],
+                )
         else:
             logits, self._cache = M.decode_step(
                 self.cfg, self._params, self._cache,
@@ -649,9 +660,9 @@ class TpuEngine:
             for i, seq in enumerate(batch):
                 seq.kv_written = int(positions[i]) + 1
                 self._register_written_blocks(seq)
-            sampled = self._sample_rows(logits, batch)
+            sampled, logps = self._sample_rows(logits, batch)
             for i, seq in enumerate(batch):
-                self._emit_tokens(seq, [int(sampled[i])])
+                self._emit_tokens(seq, [int(sampled[i])], [float(logps[i])])
 
     @staticmethod
     def _needs_full_sampler(seq: _Seq) -> bool:
@@ -672,8 +683,9 @@ class TpuEngine:
             pen[i, : len(gen)] = gen
         return pen
 
-    def _sample_rows(self, logits: jax.Array, seqs: list[_Seq]) -> np.ndarray:
-        """Sample one token per row for the first len(seqs) rows."""
+    def _sample_rows(self, logits: jax.Array, seqs: list[_Seq]) -> tuple[np.ndarray, np.ndarray]:
+        """Sample one token per row for the first len(seqs) rows.
+        → (tokens [B], chosen-token logprobs [B])."""
         B = logits.shape[0]
         temps = np.ones((B,), np.float32)
         tks = np.zeros((B,), np.int32)
@@ -699,11 +711,12 @@ class TpuEngine:
             )
         else:
             out = sample_simple(logits, jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps))
-        return np.asarray(out)  # the one host sync per step
+        logps = token_logprobs(logits, out)
+        return np.asarray(out), np.asarray(logps)  # the one host sync per step
 
     # -- token emission / finish ------------------------------------------
 
-    def _emit_tokens(self, seq: _Seq, toks: list[int]) -> None:
+    def _emit_tokens(self, seq: _Seq, toks: list[int], logps: list[float] | None = None) -> None:
         """Append sampled tokens (a multi-step window or a single token),
         truncating at the first stop condition. Posts ONE output delta with
         the kept tokens — tokens past a mid-window stop are wasted device
@@ -737,6 +750,7 @@ class TpuEngine:
             LLMEngineOutput(
                 token_ids=kept,
                 finish_reason=finish,
+                log_probs=logps[: len(kept)] if logps and seq.sampling.logprobs else None,
                 kv_transfer_params=seq.export_meta if finish is not None else None,
             ).to_dict(),
         )

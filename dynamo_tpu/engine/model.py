@@ -319,8 +319,18 @@ def multi_decode_impl(
       (VERDICT r2 weak #5).
 
     Rows that hit a stop condition mid-window keep generating; the host
-    truncates after the sync (wasted work is bounded by num_steps)."""
-    from dynamo_tpu.engine.sampler import apply_penalties, sample_step, token_counts
+    truncates after the sync (wasted work is bounded by num_steps).
+
+    Returns (tokens [num_steps, B], logprobs [num_steps, B] fp32, cache):
+    logprobs are the chosen-token log-softmax values (pre-penalty, raw
+    model distribution — OpenAI reports model logprobs, not sampler-
+    modified ones)."""
+    from dynamo_tpu.engine.sampler import (
+        apply_penalties,
+        sample_step,
+        token_counts,
+        token_logprobs,
+    )
 
     B = tokens.shape[0]
     V = cfg.vocab_size
@@ -351,12 +361,13 @@ def multi_decode_impl(
             penalized = apply_penalties(logits, counts, freq_penalty, pres_penalty)
             nxt = sample_step(penalized, temperature, top_k, top_p, row_gumbel(i))
             counts = counts.at[jnp.arange(B), nxt].add(1.0)
-        return (cache, nxt, pos + 1, counts), nxt
+        logp = token_logprobs(logits, nxt)
+        return (cache, nxt, pos + 1, counts), (nxt, logp)
 
-    (cache, _, _, _), toks = lax.scan(
+    (cache, _, _, _), (toks, logps) = lax.scan(
         substep, (cache, tokens, positions, counts0), jnp.arange(num_steps, dtype=jnp.int32)
     )
-    return toks, cache  # toks: [num_steps, B]
+    return toks, logps, cache  # [num_steps, B] each
 
 
 # Jitted entry points (static model config / step count, donated cache).

@@ -125,6 +125,14 @@ def sample_full(
     return sample_step(logits, temperature, top_k, top_p, gumbel)
 
 
+@jax.jit
+def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-probability of each row's chosen token: [B, V], [B] → [B]."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    chosen = jnp.take_along_axis(logits, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return chosen - logz
+
+
 def row_needs_full(top_k, top_p, freq_penalty, pres_penalty) -> bool:
     """Does one request's sampling config require the full sampler? The
     single source of truth for the simple/full split."""

@@ -39,6 +39,7 @@ class SamplingOptions:
     seed: int | None = None
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    logprobs: bool = False  # return chosen-token logprobs per delta
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -48,6 +49,7 @@ class SamplingOptions:
             "seed": self.seed,
             "frequency_penalty": self.frequency_penalty,
             "presence_penalty": self.presence_penalty,
+            "logprobs": self.logprobs,
         }
 
     @classmethod
@@ -59,6 +61,7 @@ class SamplingOptions:
             seed=d.get("seed"),
             frequency_penalty=float(d.get("frequency_penalty", 0.0)),
             presence_penalty=float(d.get("presence_penalty", 0.0)),
+            logprobs=bool(d.get("logprobs", False)),
         )
 
 
@@ -159,6 +162,8 @@ class LLMEngineOutput:
     text: str | None = None
     finish_reason: FinishReason | None = None
     cum_log_probs: float | None = None
+    # Per-token logprobs aligned with token_ids (when requested).
+    log_probs: list[float] | None = None
     # Disaggregation: prefill workers return KV block descriptors here.
     kv_transfer_params: dict[str, Any] | None = None
     # Error detail when finish_reason == ERROR.
@@ -176,6 +181,8 @@ class LLMEngineOutput:
             d["finish_reason"] = self.finish_reason.value
         if self.cum_log_probs is not None:
             d["cum_log_probs"] = self.cum_log_probs
+        if self.log_probs is not None:
+            d["log_probs"] = list(self.log_probs)
         if self.kv_transfer_params is not None:
             d["kv_transfer_params"] = self.kv_transfer_params
         if self.error is not None:
@@ -189,6 +196,7 @@ class LLMEngineOutput:
             text=d.get("text"),
             finish_reason=FinishReason.parse(d.get("finish_reason")),
             cum_log_probs=d.get("cum_log_probs"),
+            log_probs=d.get("log_probs"),
             kv_transfer_params=d.get("kv_transfer_params"),
             error=d.get("error"),
         )
