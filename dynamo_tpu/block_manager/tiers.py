@@ -42,6 +42,12 @@ class HostBlockPool:
 
     def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
         spilled = []
+        # Own the storage: callers pass views into shared batch buffers
+        # (engine extracts up to 64 blocks per DMA and slices per block);
+        # retaining a view would pin the whole batch buffer and break the
+        # capacity accounting.
+        if k.base is not None or v.base is not None:
+            k, v = np.ascontiguousarray(k), np.ascontiguousarray(v)
         with self._lock:
             if seq_hash in self._pages:
                 self._pages.move_to_end(seq_hash)

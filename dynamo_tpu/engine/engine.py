@@ -766,6 +766,15 @@ class TpuEngine:
     ) -> None:
         if seq in self._running:
             self._running.remove(seq)
+        # Purge queued offloads of blocks about to become evictable (same
+        # as _preempt): once freed they can be recycled by any allocation
+        # before the next flush, and a late extract would snapshot the NEW
+        # occupant's KV under the OLD sequence hash — poisoning the tier.
+        if self._offload_pending:
+            freed = set(seq.block_ids)
+            self._offload_pending = [
+                (b, h) for b, h in self._offload_pending if b not in freed
+            ]
         self.pool.free_sequence(seq.block_ids)
         seq.block_ids = []
         if not already_posted:

@@ -64,6 +64,10 @@ async def leader_barrier(
     """Publish ``data``, wait for ``num_workers`` check-ins, release."""
     deadline = time.monotonic() + timeout
     prefix = _prefix(name)
+    # Clear remnants of any previous run under the same name: without a
+    # lease the old ``go``/``workers/`` keys persist, and a reused barrier
+    # would release instantly with stale data.
+    await store.delete_prefix(prefix)
     await store.put(prefix + "data", data, lease_id=lease_id)
     workers_prefix = prefix + "workers/"
     watch = await store.watch_prefix(workers_prefix)

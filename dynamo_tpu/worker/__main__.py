@@ -66,7 +66,12 @@ def parse_args(argv=None):
     p.add_argument("--mocker-ttft-ms", type=float, default=20.0)
     p.add_argument("--mocker-itl-ms", type=float, default=5.0)
     p.add_argument("--mocker-speedup", type=float, default=1.0)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.engine == "mocker" and (args.remote_prefill or args.is_prefill_worker):
+        # The disagg handlers drive the real engine's KV extract/inject
+        # surface (prefix_hit_length, kv pages); the mocker has neither.
+        p.error("--engine mocker cannot combine with --remote-prefill/--is-prefill-worker")
+    return args
 
 
 def tokenizer_spec(arg: str) -> dict:
