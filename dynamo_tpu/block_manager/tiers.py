@@ -46,8 +46,10 @@ class HostBlockPool:
         # (engine extracts up to 64 blocks per DMA and slices per block);
         # retaining a view would pin the whole batch buffer and break the
         # capacity accounting.
-        if k.base is not None or v.base is not None:
-            k, v = np.ascontiguousarray(k), np.ascontiguousarray(v)
+        if k.base is not None:
+            k = k.copy()
+        if v.base is not None:
+            v = v.copy()
         with self._lock:
             if seq_hash in self._pages:
                 self._pages.move_to_end(seq_hash)

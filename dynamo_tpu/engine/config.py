@@ -113,6 +113,10 @@ class EngineArgs:
     tp: int = 1
     enforce_eager: bool = False          # skip jit (debug)
     prefix_caching: bool = True
+    # Attention backend (ops/paged_attention.py): "auto" → Pallas kernel
+    # on TPU (single-device), XLA gather on CPU. Forced to "xla" under a
+    # tp/dp mesh (pallas_call is opaque to GSPMD partitioning).
+    attn_impl: str = "auto"
     # Fused decode substeps per host sync (model.multi_decode). >1 is the
     # key throughput lever when host↔device roundtrips are slow; tokens
     # stream in bursts of this size. 1 = classic per-step loop.

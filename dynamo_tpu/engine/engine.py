@@ -182,6 +182,14 @@ class TpuEngine:
         if self._sharding is not None:
             self._params = self._sharding.shard_params(self._params)
             self._cache = M.KVCache(*self._sharding.shard_cache(self._cache))
+        # Attention backend: Pallas kernel single-device, XLA under a mesh
+        # (pallas_call is opaque to GSPMD partitioning).
+        from dynamo_tpu.ops.paged_attention import resolve_attn_impl
+
+        self._attn_impl = (
+            "xla" if self._sharding is not None
+            else resolve_attn_impl(self.args.attn_impl)
+        )
 
     async def stop(self) -> None:
         with self._wakeup:
@@ -639,6 +647,7 @@ class TpuEngine:
                 jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
                 jnp.asarray(tks), jnp.asarray(tps),
                 jnp.asarray(freqs), jnp.asarray(press), jnp.asarray(pen),
+                attn_impl=self._attn_impl,
             )
             toks_np = np.asarray(toks)  # [K, B] — the one host sync
             logps_np = np.asarray(logps)
@@ -655,6 +664,7 @@ class TpuEngine:
                 self.cfg, self._params, self._cache,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(tables), jnp.asarray(active),
+                attn_impl=self._attn_impl,
             )
             # The step just wrote each sequence's KV at `positions[i]`.
             for i, seq in enumerate(batch):

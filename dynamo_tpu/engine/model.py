@@ -227,9 +227,23 @@ def decode_step_impl(
     positions: jax.Array,     # [B] int32 — position of that token (seq_len-1)
     block_tables: jax.Array,  # [B, W] int32
     active: jax.Array,        # [B] bool — padding rows are False
+    *,
+    attn_impl: str = "auto",  # static: "auto" | "xla" | "pallas" | "pallas_interpret"
 ) -> tuple[jax.Array, KVCache]:
     """One decode step for a batch. Writes each sequence's new KV at its
-    position, attends over its pages, returns logits [B, V] (fp32)."""
+    position, attends over its pages, returns logits [B, V] (fp32).
+
+    Attention backend (ops/paged_attention.py): the Pallas kernel walks
+    each row's true pages (work ∝ sum(lengths)); the XLA path gathers the
+    padded table width (work ∝ B*W*bs) and is the CPU/multi-device
+    fallback."""
+    from dynamo_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        paged_decode_attention_xla,
+        resolve_attn_impl,
+    )
+
+    impl = resolve_attn_impl(attn_impl)
     B = tokens.shape[0]
     W = block_tables.shape[1]
     bs = cache.k.shape[2]
@@ -238,12 +252,9 @@ def decode_step_impl(
 
     blk = jnp.where(active, block_tables[jnp.arange(B), positions // bs], 0)
     off = jnp.where(active, positions % bs, 0)
+    # token at `positions` attends [0, positions]; inactive rows attend nothing
+    lengths = jnp.where(active, positions + 1, 0)
 
-    ctx = jnp.arange(W * bs, dtype=jnp.int32)
-    # token at `positions` attends [0, positions]
-    mask = jnp.where(ctx[None, :] <= positions[:, None], 0.0, jnp.float32(-1e9))  # [B, W*bs]
-
-    scale = cfg.head_dim ** -0.5
     G = cfg.num_heads // cfg.num_kv_heads
 
     def layer(carry, xs):
@@ -255,22 +266,22 @@ def decode_step_impl(
         v = jnp.dot(h, lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-
-        layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
-        layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
-        layer_k = layer_k.at[blk, off].set(k)  # batched scatter [B, KVH, hd]
-        layer_v = layer_v.at[blk, off].set(v)
-        k_cache = lax.dynamic_update_index_in_dim(k_cache, layer_k, layer_idx, 0)
-        v_cache = lax.dynamic_update_index_in_dim(v_cache, layer_v, layer_idx, 0)
-
-        pk = layer_k[block_tables].reshape(B, W * bs, cfg.num_kv_heads, cfg.head_dim)
-        pv = layer_v[block_tables].reshape(B, W * bs, cfg.num_kv_heads, cfg.head_dim)
-
         qg = q.reshape(B, cfg.num_kv_heads, G, cfg.head_dim)
-        s = jnp.einsum("bkgh,bckh->bkgc", qg, pk).astype(jnp.float32) * scale
-        s = s + mask[:, None, None, :]
-        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-        o = jnp.einsum("bkgc,bckh->bkgh", p, pv).reshape(B, cfg.q_size)
+
+        # In-place scatter of the new token's KV (inactive rows → garbage
+        # block 0), then paged attention over [0, positions].
+        k_cache = k_cache.at[layer_idx, blk, off].set(k)
+        v_cache = v_cache.at[layer_idx, blk, off].set(v)
+        if impl == "xla":
+            o = paged_decode_attention_xla(
+                qg, k_cache, v_cache, layer_idx, block_tables, lengths
+            )
+        else:
+            o = paged_decode_attention(
+                qg, k_cache, v_cache, layer_idx, block_tables, lengths,
+                interpret=(impl == "pallas_interpret"),
+            )
+        o = o.reshape(B, cfg.q_size)
         x = x + jnp.dot(o, lp["wo"])
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -302,7 +313,9 @@ def multi_decode_impl(
     freq_penalty: jax.Array,  # [B] fp32 (mode="full")
     pres_penalty: jax.Array,  # [B] fp32 (mode="full")
     penalty_tokens: jax.Array,  # [B, L] int32 generated-so-far ids, -1 pad (mode="full")
-) -> tuple[jax.Array, KVCache]:
+    *,
+    attn_impl: str = "auto",
+) -> tuple[jax.Array, jax.Array, KVCache]:
     """``num_steps`` fused decode+sample steps: sampled tokens feed back on
     device, so the host syncs once per num_steps×B tokens instead of per
     token. THE latency lever when the host↔device link is slow (remote
@@ -348,7 +361,9 @@ def multi_decode_impl(
 
     def substep(carry, i):
         cache, tok, pos, counts = carry
-        logits, cache = decode_step_impl(cfg, params, cache, tok, pos, block_tables, active)
+        logits, cache = decode_step_impl(
+            cfg, params, cache, tok, pos, block_tables, active, attn_impl=attn_impl
+        )
         if mode == "greedy":
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         elif mode == "simple":
@@ -372,5 +387,9 @@ def multi_decode_impl(
 
 # Jitted entry points (static model config / step count, donated cache).
 prefill = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(prefill_impl)
-decode_step = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(decode_step_impl)
-multi_decode = functools.partial(jax.jit, static_argnums=(0, 1, 2), donate_argnums=(4,))(multi_decode_impl)
+decode_step = functools.partial(
+    jax.jit, static_argnums=(0,), static_argnames=("attn_impl",), donate_argnums=(2,)
+)(decode_step_impl)
+multi_decode = functools.partial(
+    jax.jit, static_argnums=(0, 1, 2), static_argnames=("attn_impl",), donate_argnums=(4,)
+)(multi_decode_impl)

@@ -1,0 +1,329 @@
+"""Paged decode attention: Pallas TPU kernel + XLA reference.
+
+The role vLLM's paged-attention CUDA kernels play for the reference
+(reference: components/backends/vllm/src/dynamo/vllm/main.py:90 delegates
+to vLLM's engine; its CUDA kernels are the analogue of this file).
+
+Why a kernel at all: the XLA formulation gathers the full (bucketed)
+block-table width `W*bs` out of the page pool per layer per step —
+~3x HBM traffic on padded context (materialize + re-read) regardless of
+each sequence's true length. The kernel instead walks each row's actual
+pages: one DMA per page (a page is contiguous ``[bs, KVH*hd]`` in the
+cache layout), online-softmax accumulation, work proportional to
+``sum(lengths)`` rather than ``B*W*bs``.
+
+Design notes (measured on v5e, see tools/profile_decode.py):
+
+- The FULL cache ``[L, N, bs, KVH, hd]`` stays in HBM (`pl.ANY`), viewed
+  as ``[L, N, bs, KVH*hd]`` (bitcast; KVH*hd is lane-aligned even for
+  hd=64). The layer index is a scalar-prefetch operand, which also
+  removes the per-layer ``dynamic_slice`` copies the gather path needs.
+- Grid ``(B, CMAX)``: chunk c of row b processes up to P pages.
+  Cross-step software pipelining: every live step issues the DMAs of the
+  *next* live step (double-buffered), so page fetch overlaps compute
+  across rows, not just within a row.
+- **Block-diagonal q**: per-head lane slices of the KV buffer relayout
+  on every access (hd=64 is sub-lane-tile) and measured ~15us/chunk.
+  Instead the caller bakes q into a block-diagonal matrix
+  ``[KVH*hd, KVH*G]`` so ONE MXU op yields all heads' scores
+  ``[P*bs, KVH*G]``; the online softmax is column-wise (axis-0 reduces),
+  and the accumulator is kept transposed ``[KVH*hd, KVH*G]`` so every
+  correction is a row-vector broadcast. Zero relayouts, zero transposes
+  in the kernel; the per-head diagonal is extracted by XLA afterwards.
+- Dead steps (chunk beyond the row's length, padding rows) skip DMA and
+  compute entirely — padding costs ~grid-iteration overhead only.
+- Per-DMA cost measured ~0.6us: pages should be >=32KB to approach
+  bandwidth, i.e. prefer ``block_size`` 64-256 on TPU (config.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def resolve_attn_impl(requested: str = "auto") -> str:
+    """'auto' → 'pallas' on TPU-like backends, else 'xla'."""
+    if requested != "auto":
+        return requested
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        backend = "cpu"
+    return "pallas" if backend in ("tpu", "axon") else "xla"
+
+
+# ---------------------------------------------------------------------------
+# XLA reference implementation (also the CPU / multi-device path)
+# ---------------------------------------------------------------------------
+
+
+def paged_decode_attention_xla(
+    q: jax.Array,            # [B, KVH, G, hd]
+    k_cache: jax.Array,      # [L, N, bs, KVH, hd]
+    v_cache: jax.Array,
+    layer_idx: jax.Array,    # scalar int32
+    block_tables: jax.Array, # [B, W] int32
+    lengths: jax.Array,      # [B] int32 — attend positions [0, length)
+) -> jax.Array:
+    """Gather-based formulation (the r3 path, hoisted here).  Returns
+    [B, KVH, G, hd] in q.dtype."""
+    B, KVH, G, hd = q.shape
+    W = block_tables.shape[1]
+    bs = k_cache.shape[2]
+    layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
+    layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
+    pk = layer_k[block_tables].reshape(B, W * bs, KVH, hd)
+    pv = layer_v[block_tables].reshape(B, W * bs, KVH, hd)
+    scale = hd ** -0.5
+    ctx = jnp.arange(W * bs, dtype=jnp.int32)
+    mask = jnp.where(ctx[None, :] < lengths[:, None], 0.0, jnp.float32(NEG_INF))
+    s = jnp.einsum("bkgh,bckh->bkgc", q, pk).astype(jnp.float32) * scale
+    s = s + mask[:, None, None, :]
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgc,bckh->bkgh", p, pv)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    # scalar prefetch
+    layer_ref,    # [1] int32
+    lengths_ref,  # [B] int32
+    tables_ref,   # [B, W] int32
+    # operands
+    qbd_ref,      # VMEM [1, KVH*hd, KVH*G] — block-diag q, scale folded in
+    k_hbm,        # ANY  [L, N, bs, KVH*hd] (bitcast view of the cache)
+    v_hbm,
+    # outputs
+    o_ref,        # VMEM [1, KVH*hd, KVH*G] — attention out, transposed
+    # scratch
+    kbuf,         # VMEM [2, P, bs, KVH*hd]
+    vbuf,
+    m_scr,        # VMEM [8, 128] f32 — row 0, first KVH*G lanes live
+    l_scr,        # VMEM [8, 128] f32
+    acc_scr,      # VMEM [KVH*hd, KVH*G] f32
+    slot_ref,     # SMEM [1] int32 — DMA double-buffer cursor
+    started_ref,  # SMEM [1] int32 — global warmup flag
+    sem,          # DMA sems [2, 2, P]
+    *,
+    pages_per_chunk: int,
+):
+    P = pages_per_chunk
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    B = pl.num_programs(0)
+    layer = layer_ref[0]
+    bs = kbuf.shape[2]
+    D = kbuf.shape[3]       # KVH*hd
+    H = qbd_ref.shape[2]    # KVH*G (total query heads)
+    CH = P * bs             # tokens per chunk
+
+    length = lengths_ref[b]
+    nchunks = lax.div(length + CH - 1, CH)
+    live = c < nchunks
+
+    @pl.when((b == 0) & (c == 0))
+    def _init_globals():
+        slot_ref[0] = 0
+        started_ref[0] = 0
+
+    def chunk_dmas(row, chunk, slot):
+        """DMA descriptors for (row, chunk) into buffer `slot`; page p is
+        guarded by the row's true page count."""
+        rem = lengths_ref[row] - chunk * CH
+        npages = jnp.minimum(lax.div(rem + bs - 1, bs), P)
+        out = []
+        for p in range(P):
+            page = tables_ref[row, chunk * P + p]
+            out.append((
+                p < npages,
+                pltpu.make_async_copy(k_hbm.at[layer, page], kbuf.at[slot, p], sem.at[slot, 0, p]),
+                pltpu.make_async_copy(v_hbm.at[layer, page], vbuf.at[slot, p], sem.at[slot, 1, p]),
+            ))
+        return out
+
+    def issue(row, chunk, slot):
+        for ok, dk, dv in chunk_dmas(row, chunk, slot):
+            @pl.when(ok)
+            def _():
+                dk.start()
+                dv.start()
+
+    @pl.when(live)
+    def _body():
+        cur = slot_ref[0]
+
+        # Global warmup: the very first live step has no predecessor.
+        @pl.when(started_ref[0] == 0)
+        def _():
+            issue(b, c, cur)
+            started_ref[0] = 1
+
+        # Software pipeline: issue the next live step's pages.
+        # Successor is (b, c+1) if this row continues, else chunk 0 of
+        # the next non-empty row (scalar search past padding rows).
+        nxt = 1 - cur
+        row_continues = c + 1 < nchunks
+
+        @pl.when(row_continues)
+        def _():
+            issue(b, c + 1, nxt)
+
+        @pl.when(~row_continues)
+        def _():
+            nxt_row = lax.while_loop(
+                lambda r: (r < B) & (lengths_ref[jnp.minimum(r, B - 1)] == 0),
+                lambda r: r + 1,
+                b + 1,
+            )
+
+            @pl.when(nxt_row < B)
+            def _():
+                issue(nxt_row, 0, nxt)
+
+        # Init row accumulators at the row's first chunk.
+        @pl.when(c == 0)
+        def _():
+            m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[...] = jnp.zeros_like(l_scr)
+            acc_scr[...] = jnp.zeros_like(acc_scr)
+
+        # Wait for this step's pages.
+        for ok, dk, dv in chunk_dmas(b, c, cur):
+            @pl.when(ok)
+            def _():
+                dk.wait()
+                dv.wait()
+        slot_ref[0] = nxt
+
+        # Context-position validity, column orientation [P*bs, 1].
+        pos = c * CH + lax.broadcasted_iota(jnp.int32, (P * bs, 1), 0)
+        valid = pos < length
+
+        k_chunk = kbuf[cur].reshape(P * bs, D)
+        v_chunk = vbuf[cur].reshape(P * bs, D)
+        # Unfetched tail pages hold garbage (possibly NaN): k is
+        # neutralized by the score mask, v must be zeroed (0*NaN=NaN).
+        v_chunk = jnp.where(valid, v_chunk, 0)
+
+        # All heads' scores in one MXU op via the block-diagonal q.
+        s = lax.dot_general(
+            k_chunk, qbd_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [P*bs, H]
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[0:1, :H]                            # [1, H]
+        l_prev = l_scr[0:1, :H]
+        m_cur = jnp.max(s, axis=0, keepdims=True)          # [1, H]
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)                     # [1, H]
+        p = jnp.exp(s - m_new)                             # [P*bs, H]
+        l_new = corr * l_prev + jnp.sum(p, axis=0, keepdims=True)
+        # Transposed accumulator [D, H]: corrections broadcast over rows.
+        pv = lax.dot_general(
+            v_chunk, p.astype(v_chunk.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # [D, H]
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[0:1, :H] = m_new
+        l_scr[0:1, :H] = l_new
+
+        # Row done → normalize and emit (still transposed; XLA takes the
+        # per-head diagonal outside).
+        @pl.when(c == nchunks - 1)
+        def _():
+            l = jnp.maximum(l_scr[0:1, :H], 1e-30)
+            o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+    # Keep padding rows' output defined (their stale block is otherwise
+    # flushed as-is; harmless numerically but keep it clean).
+    @pl.when((~live) & (c == 0))
+    def _zero():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pages_per_chunk", "interpret"),
+)
+def paged_decode_attention(
+    q: jax.Array,            # [B, KVH, G, hd]
+    k_cache: jax.Array,      # [L, N, bs, KVH, hd]
+    v_cache: jax.Array,
+    layer_idx: jax.Array,    # scalar int32
+    block_tables: jax.Array, # [B, W] int32
+    lengths: jax.Array,      # [B] int32
+    *,
+    pages_per_chunk: int = 0,  # 0 → auto (~512 tokens per chunk)
+    interpret: bool = False,
+) -> jax.Array:
+    B, KVH, G, hd = q.shape
+    L, N, bs = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
+    W = block_tables.shape[1]
+    if KVH * G > 128:
+        raise NotImplementedError(
+            f"{KVH * G} query heads > 128 lanes; shard heads (tp) first"
+        )
+    P = pages_per_chunk or max(1, 512 // bs)
+    P = min(P, W)
+    if W % P:  # pad the table so chunks tile it exactly
+        pad = P - W % P
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+        W += pad
+    chunks_max = W // P
+
+    # Block-diagonal q with the softmax scale folded in:
+    # qbd[b, j*hd+h, k*G+g] = q[b,k,g,h] * scale * (j==k).
+    eye = jnp.eye(KVH, dtype=q.dtype)
+    qbd = jnp.einsum("bkgh,jk->bjhkg", q * (hd ** -0.5), eye)
+    qbd = qbd.reshape(B, KVH * hd, KVH * G)
+
+    kernel = functools.partial(_decode_kernel, pages_per_chunk=P)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, chunks_max),
+        in_specs=[
+            pl.BlockSpec((1, KVH * hd, KVH * G), lambda b, c, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, KVH * hd, KVH * G), lambda b, c, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, bs, KVH * hd), k_cache.dtype),
+            pltpu.VMEM((2, P, bs, KVH * hd), v_cache.dtype),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((KVH * hd, KVH * G), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2, P)),
+        ],
+    )
+    o_t = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH * hd, KVH * G), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer_idx, jnp.int32).reshape(1),
+        jnp.asarray(lengths, jnp.int32),
+        jnp.asarray(block_tables, jnp.int32),
+        qbd,
+        k_cache.reshape(L, N, bs, KVH * hd),
+        v_cache.reshape(L, N, bs, KVH * hd),
+    )
+    # [B, KVH*hd, KVH*G] → per-head diagonal → [B, KVH, G, hd].
+    o5 = o_t.reshape(B, KVH, hd, KVH, G)
+    return jnp.einsum("bkhkg->bkgh", o5)

@@ -33,26 +33,6 @@ def _prefix(name: str) -> str:
     return f"barrier/{name}/"
 
 
-async def _wait_for_key(store: KeyValueStore, key: str, deadline: float) -> bytes:
-    watch = await store.watch_prefix(key)
-    try:
-        for entry in watch.snapshot:
-            if entry.key == key:
-                return entry.value
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise BarrierTimeout(f"timed out waiting for {key}")
-            try:
-                ev = await asyncio.wait_for(watch.__anext__(), remaining)
-            except (asyncio.TimeoutError, StopAsyncIteration):
-                raise BarrierTimeout(f"timed out waiting for {key}") from None
-            if ev.kind == EventKind.PUT and ev.key == key:
-                return ev.value or b""
-    finally:
-        await watch.cancel()
-
-
 async def leader_barrier(
     store: KeyValueStore,
     name: str,
@@ -101,11 +81,37 @@ async def worker_barrier(
     lease_id: int | None = None,
     timeout: float = 60.0,
 ) -> bytes:
-    """Check in, wait for the leader's release. → the leader's data."""
+    """Check in, wait for the leader's release. → the leader's data.
+
+    Ordering-safe against the leader's stale-key cleanup: the watch is
+    established BEFORE checking in, the check-in is re-put if the leader's
+    ``delete_prefix`` wipes it (worker arrived first), and only ``go``
+    PUT *events* release — a stale ``go`` in the snapshot (previous run,
+    leader not yet arrived) is ignored. One-shot per run: a worker that
+    joins after release times out (same as the reference's boot barrier).
+    """
     deadline = time.monotonic() + timeout
     prefix = _prefix(name)
-    await store.put(prefix + f"workers/{worker_id}", b"1", lease_id=lease_id)
-    await _wait_for_key(store, prefix + "go", deadline)
+    my_key = prefix + f"workers/{worker_id}"
+    go_key = prefix + "go"
+    watch = await store.watch_prefix(prefix)
+    try:
+        await store.put(my_key, b"1", lease_id=lease_id)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise BarrierTimeout(f"timed out waiting for {go_key}")
+            try:
+                ev = await asyncio.wait_for(watch.__anext__(), remaining)
+            except (asyncio.TimeoutError, StopAsyncIteration):
+                raise BarrierTimeout(f"timed out waiting for {go_key}") from None
+            if ev.kind == EventKind.PUT and ev.key == go_key:
+                break
+            if ev.kind == EventKind.DELETE and ev.key == my_key:
+                # Leader cleanup raced our early check-in; check in again.
+                await store.put(my_key, b"1", lease_id=lease_id)
+    finally:
+        await watch.cancel()
     entry = await store.get(prefix + "data")
     if entry is None:
         raise BarrierTimeout(f"barrier {name!r}: released but data missing (leader died?)")

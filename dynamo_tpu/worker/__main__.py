@@ -55,6 +55,8 @@ def parse_args(argv=None):
     p.add_argument("--max-num-seqs", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--decode-steps", type=int, default=8)
+    p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
+                   default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="G2 host-RAM KV tier capacity in blocks (0 = off)")
     p.add_argument("--disk-kv-dir", default=None, help="G3 disk KV tier directory")
@@ -132,6 +134,7 @@ async def build_engine(args):
             dtype=args.dtype,
             tp=args.tp,
             decode_steps=args.decode_steps,
+            attn_impl=args.attn_impl,
             host_kv_blocks=args.host_kv_blocks,
             disk_kv_dir=args.disk_kv_dir,
             disk_kv_blocks=args.disk_kv_blocks,

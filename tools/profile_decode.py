@@ -102,7 +102,7 @@ def main():
     )
 
     def fused_carry(c, *a):
-        toks, c2 = fused(c, *a)
+        toks, _logps, c2 = fused(c, *a)
         return toks, c2
 
     t = timed_carry(fused_carry, cache, params, tokens, positions, iters=args.iters)
