@@ -44,6 +44,8 @@ def parse_args():
     p.add_argument("--max-num-seqs", type=int, default=128)
     p.add_argument("--decode-steps", type=int, default=32,
                    help="fused decode substeps per host sync")
+    p.add_argument("--block-size", type=int, default=16,
+                   help="KV page size; bigger pages amortize per-page DMA (ops/paged_attention.py)")
     p.add_argument("--cpu", action="store_true", help="force CPU + tiny model (dev)")
     p.add_argument("--no-compile-cache", action="store_true")
     return p.parse_args()
@@ -95,10 +97,11 @@ async def bench(args) -> dict:
             (args.gen_len * rng.lognormal(0.0, 0.6, n)).astype(int), 8, args.gen_len * 4
         )
 
-    block_size = 16
+    block_size = args.block_size
     # Headroom so multi-step windows never fall back to the per-step path
-    # mid-run (which would compile inside the timed section).
-    seq_len = int(prompt_lens.max() + gen_lens.max()) + args.decode_steps
+    # mid-run (which would compile inside the timed section). 2x: the
+    # window pipeline keeps one extra window in flight.
+    seq_len = int(prompt_lens.max() + gen_lens.max()) + 2 * args.decode_steps
     blocks_per_seq = (seq_len + block_size - 1) // block_size + 1
     eargs = EngineArgs(
         model=model,

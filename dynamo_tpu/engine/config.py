@@ -129,6 +129,16 @@ class EngineArgs:
     # throughput loss on ramp-up); too large starves running decodes.
     # 0 = admit until slots are full.
     admission_budget_tokens: int = 8192
+    # Keep one decode window in flight: window w+1 is dispatched chaining
+    # from w's on-device outputs before w is fetched, hiding the
+    # host↔device sync roundtrip (~100 ms on tunneled TPUs). Stops are
+    # then discovered one window late (≤decode_steps wasted tokens per
+    # finished sequence). Full-sampler batches always run unpipelined.
+    pipeline_windows: bool = True
+    # Max sequences packed into one prefill dispatch (model.prefill_batch).
+    # Admission groups same-bucket suffixes; padding rows to pow2 keeps the
+    # compile matrix small. 1 = r3's one-at-a-time behaviour.
+    prefill_batch_max: int = 8
     # KV tier stack (block_manager/tiers.py): G2 host-RAM blocks (0 = off)
     # and optional G3 disk spill directory.
     host_kv_blocks: int = 0
@@ -184,6 +194,12 @@ class EngineArgs:
             if n <= b:
                 return b
         raise ValueError(f"prefill of {n} tokens exceeds max_prefill_tokens={self.max_prefill_tokens}")
+
+    def bucket_prefill_rows(self, n: int) -> int:
+        b = 1
+        while b < min(n, self.prefill_batch_max):
+            b *= 2
+        return b
 
     def bucket_decode(self, n: int) -> int:
         for b in self.decode_buckets:

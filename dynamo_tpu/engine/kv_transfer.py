@@ -8,7 +8,7 @@ equivalents are host DMA (device_get / device_put) for HBM↔host and the
 runtime's TCP response plane for host↔host. The same primitives back
 both disaggregated prefill→decode handoff and the G2 host offload tier.
 
-Layout: pages travel as ``[L, n, bs, KVH, hd]`` pairs (k, v) — a pure
+Layout: pages travel as ``[L, n, bs, KVH*hd]`` pairs (k, v) — a pure
 slice of the cache's native layout, so extract/inject are single
 gather/scatter ops XLA fuses well. ``n`` is bucketed pow2 (block id 0 is
 the garbage sink, so padding injects harmlessly).
@@ -36,7 +36,25 @@ def _bucket(n: int) -> int:
 
 @functools.partial(jax.jit, static_argnums=())
 def _extract_impl(k: jax.Array, v: jax.Array, ids: jax.Array):
-    return k[:, ids], v[:, ids]  # [L, n, bs, KVH, hd]
+    return k[:, ids], v[:, ids]  # [L, n, bs, KVH*hd]
+
+
+_extract_replicated_jits: dict = {}
+
+
+def _extract_replicated(k, v, ids, sharding):
+    """Extract with fully-replicated outputs: on a multi-host mesh every
+    process must be able to np.asarray the result (a KVH-sharded gather
+    would leave shards non-addressable)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = sharding.mesh
+    fn = _extract_replicated_jits.get(id(mesh))
+    if fn is None:
+        rep = NamedSharding(mesh, PartitionSpec())
+        fn = jax.jit(lambda k, v, i: (k[:, i], v[:, i]), out_shardings=(rep, rep))
+        _extract_replicated_jits[id(mesh)] = fn
+    return fn(k, v, ids)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -44,15 +62,22 @@ def _inject_impl(k: jax.Array, v: jax.Array, ids: jax.Array, pk: jax.Array, pv: 
     return k.at[:, ids].set(pk), v.at[:, ids].set(pv)
 
 
-def extract_pages(cache: KVCache, block_ids: list[int]) -> tuple[np.ndarray, np.ndarray]:
+def extract_pages(
+    cache: KVCache, block_ids: list[int], replicate=None
+) -> tuple[np.ndarray, np.ndarray]:
     """Copy the named blocks to host → (k_pages, v_pages), each
-    [L, n, bs, KVH, hd] numpy. Must run before the cache is donated to a
-    later step (i.e. on the engine thread, synchronously)."""
+    [L, n, bs, KVH*hd] numpy. Must run before the cache is donated to a
+    later step (i.e. on the engine thread, synchronously). Pass the
+    ModelSharding as ``replicate`` on a sharded cache so the gather
+    all-gathers to every host."""
     n = len(block_ids)
     nb = _bucket(n)
     ids = np.zeros((nb,), np.int32)
     ids[:n] = block_ids
-    pk, pv = _extract_impl(cache.k, cache.v, jnp.asarray(ids))
+    if replicate is not None:
+        pk, pv = _extract_replicated(cache.k, cache.v, jnp.asarray(ids), replicate)
+    else:
+        pk, pv = _extract_impl(cache.k, cache.v, jnp.asarray(ids))
     return np.asarray(pk[:, :n]), np.asarray(pv[:, :n])
 
 
@@ -84,7 +109,7 @@ def inject_pages(cache: KVCache, block_ids: list[int], pk: np.ndarray, pv: np.nd
 class KvPagePayload:
     """Host KV pages + metadata, serializable over the response plane."""
 
-    k: np.ndarray  # [L, n, bs, KVH, hd]
+    k: np.ndarray  # [L, n, bs, KVH*hd]
     v: np.ndarray
     num_tokens: int  # prompt positions covered by these pages
 

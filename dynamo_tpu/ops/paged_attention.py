@@ -14,9 +14,11 @@ cache layout), online-softmax accumulation, work proportional to
 
 Design notes (measured on v5e, see tools/profile_decode.py):
 
-- The FULL cache ``[L, N, bs, KVH, hd]`` stays in HBM (`pl.ANY`), viewed
-  as ``[L, N, bs, KVH*hd]`` (bitcast; KVH*hd is lane-aligned even for
-  hd=64). The layer index is a scalar-prefetch operand, which also
+- The FULL cache ``[L, N, bs, KVH*hd]`` stays in HBM (`pl.ANY`) in its
+  native dense layout (a 5D [.., KVH, hd] layout forced a whole-cache
+  relayout copy per pallas_call — ~9ms/layer measured on v5e, the reason
+  the cache is stored heads-merged). The layer index is a scalar-prefetch
+  operand, which also
   removes the per-layer ``dynamic_slice`` copies the gather path needs.
 - Grid ``(B, CMAX)``: chunk c of row b processes up to P pages.
   Cross-step software pipelining: every live step issues the DMAs of the
@@ -67,7 +69,7 @@ def resolve_attn_impl(requested: str = "auto") -> str:
 
 def paged_decode_attention_xla(
     q: jax.Array,            # [B, KVH, G, hd]
-    k_cache: jax.Array,      # [L, N, bs, KVH, hd]
+    k_cache: jax.Array,      # [L, N, bs, KVH*hd]
     v_cache: jax.Array,
     layer_idx: jax.Array,    # scalar int32
     block_tables: jax.Array, # [B, W] int32
@@ -260,8 +262,8 @@ def _decode_kernel(
 )
 def paged_decode_attention(
     q: jax.Array,            # [B, KVH, G, hd]
-    k_cache: jax.Array,      # [L, N, bs, KVH, hd]
-    v_cache: jax.Array,
+    k_cache: jax.Array,      # [L, N, bs, KVH*hd] — dense pages, no
+    v_cache: jax.Array,      #   per-call layout conversion
     layer_idx: jax.Array,    # scalar int32
     block_tables: jax.Array, # [B, W] int32
     lengths: jax.Array,      # [B] int32
@@ -271,6 +273,7 @@ def paged_decode_attention(
 ) -> jax.Array:
     B, KVH, G, hd = q.shape
     L, N, bs = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
+    assert k_cache.shape[3] == KVH * hd, "cache must be [L, N, bs, KVH*hd]"
     W = block_tables.shape[1]
     if KVH * G > 128:
         raise NotImplementedError(
@@ -321,8 +324,8 @@ def paged_decode_attention(
         jnp.asarray(lengths, jnp.int32),
         jnp.asarray(block_tables, jnp.int32),
         qbd,
-        k_cache.reshape(L, N, bs, KVH * hd),
-        v_cache.reshape(L, N, bs, KVH * hd),
+        k_cache,
+        v_cache,
     )
     # [B, KVH*hd, KVH*G] → per-head diagonal → [B, KVH, G, hd].
     o5 = o_t.reshape(B, KVH, hd, KVH, G)

@@ -126,13 +126,22 @@ class ModelSharding:
         return shardings
 
     def cache_spec(self) -> P:
-        # [L, num_blocks, block_size, KVH, hd] — shard kv heads over tp_kv.
-        return P(None, None, None, TP_KV_AXIS, None)
+        # [L, num_blocks, block_size, KVH*hd] — the merged head-dim splits
+        # into tp_kv contiguous [KVH/tp_kv * hd] chunks, i.e. kv heads
+        # grouped exactly as the attention einsums expect.
+        return P(None, None, None, TP_KV_AXIS)
 
     def batch_spec(self) -> P:
         return P(DP_AXIS)
 
     def shard_params(self, params: Any) -> Any:
+        if jax.process_count() > 1:
+            # Cross-process device_put of committed device arrays is not
+            # allowed; route through host. Every process holds the same
+            # full value (same init seed / same checkpoint), so each can
+            # supply its addressable shards. (Sharded-native loading is
+            # the loader's job for models that exceed host RAM.)
+            params = jax.tree.map(np.asarray, params)
         return jax.device_put(params, self.param_shardings())
 
     def shard_cache(self, cache) -> tuple[jax.Array, jax.Array]:

@@ -64,6 +64,18 @@ def parse_args(argv=None):
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
+    # multi-host: ONE logical worker spanning several processes/hosts.
+    # Launch one process per host; process 0 serves the endpoint, the
+    # rest replay its dispatch stream (engine/runner.py). All processes
+    # need identical model/shape flags; --tp counts GLOBAL devices.
+    # (reference analogue: per-node engine ranks under NCCL/MPI --
+    # components/backends/sglang/slurm_jobs/submit_job_script.py)
+    p.add_argument("--dist-num-processes", type=int, default=1)
+    p.add_argument("--dist-process-id", type=int, default=0)
+    p.add_argument("--dist-coordinator", default="127.0.0.1:29500",
+                   help="jax.distributed coordinator host:port (process 0's host)")
+    p.add_argument("--dist-step-addr", default=None,
+                   help="leader step-stream addr (default: coordinator host, port+1)")
     # mocker timing
     p.add_argument("--mocker-ttft-ms", type=float, default=20.0)
     p.add_argument("--mocker-itl-ms", type=float, default=5.0)
@@ -125,22 +137,19 @@ async def build_engine(args):
             )
         else:
             model = ModelConfig.preset(args.preset)
-        eargs = EngineArgs(
-            model=model,
-            block_size=args.block_size,
-            num_kv_blocks=args.num_kv_blocks,
-            max_num_seqs=args.max_num_seqs,
-            max_model_len=args.max_model_len,
-            dtype=args.dtype,
-            tp=args.tp,
-            decode_steps=args.decode_steps,
-            attn_impl=args.attn_impl,
-            host_kv_blocks=args.host_kv_blocks,
-            disk_kv_dir=args.disk_kv_dir,
-            disk_kv_blocks=args.disk_kv_blocks,
-        )
+        eargs = _engine_args(args, model)
+        runner = None
+        if args.dist_num_processes > 1:
+            from dynamo_tpu.engine.runner import LeaderRunner
+
+            host, port = _step_addr(args).rsplit(":", 1)
+            runner = LeaderRunner(
+                eargs, params=params, seed=args.seed, sharding=sharding,
+                listen_addr=f"0.0.0.0:{port}",
+                num_followers=args.dist_num_processes - 1,
+            )
         engine = await TpuEngine(
-            eargs, params=params, seed=args.seed, sharding=sharding
+            eargs, params=params, seed=args.seed, sharding=sharding, runner=runner
         ).start()
         name = args.model_name or model.name
         context_length = args.context_length or min(args.max_model_len, model.max_position)
@@ -219,8 +228,69 @@ async def async_main(args) -> None:
     await rt.shutdown()
 
 
+def _step_addr(args) -> str:
+    if args.dist_step_addr:
+        return args.dist_step_addr
+    host, port = args.dist_coordinator.rsplit(":", 1)
+    return f"{host}:{int(port) + 1}"
+
+
+def _engine_args(args, model):
+    from dynamo_tpu.engine.config import EngineArgs
+
+    return EngineArgs(
+        model=model,
+        block_size=args.block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        max_num_seqs=args.max_num_seqs,
+        max_model_len=args.max_model_len,
+        dtype=args.dtype,
+        tp=args.tp,
+        decode_steps=args.decode_steps,
+        attn_impl=args.attn_impl,
+        host_kv_blocks=args.host_kv_blocks,
+        disk_kv_dir=args.disk_kv_dir,
+        disk_kv_blocks=args.disk_kv_blocks,
+    )
+
+
+def run_follower(args) -> None:
+    '''Multi-host follower: no store, no endpoint; replays the leader
+    dispatch stream against this host\'s shard of the mesh.'''
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.runner import follower_loop
+
+    params = None
+    sharding = None
+    if args.model_path:
+        from dynamo_tpu.engine.loader import config_from_hf, load_model
+        from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
+
+        model = config_from_hf(args.model_path)
+        if args.tp > 1:
+            sharding = ModelSharding(build_mesh(tp=args.tp, cfg=model), model)
+        model, params = load_model(args.model_path, args.dtype, sharding)
+    else:
+        model = ModelConfig.preset(args.preset)
+    eargs = _engine_args(args, model)
+    print(f"dynamo_tpu follower {args.dist_process_id}/{args.dist_num_processes}", flush=True)
+    follower_loop(eargs, _step_addr(args), params=params, seed=args.seed, sharding=sharding)
+
+
 def main(argv=None) -> int:
-    asyncio.run(async_main(parse_args(argv)))
+    args = parse_args(argv)
+    if args.dist_num_processes > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.dist_coordinator,
+            num_processes=args.dist_num_processes,
+            process_id=args.dist_process_id,
+        )
+        if args.dist_process_id > 0:
+            run_follower(args)
+            return 0
+    asyncio.run(async_main(args))
     return 0
 
 

@@ -1,0 +1,79 @@
+"""Child process for the multi-host engine test (leader or follower).
+
+Run: python multihost_child.py <role> <pid> <nprocs> <coord> <step_addr>
+
+Each process gets 4 virtual CPU devices (XLA_FLAGS set by the parent);
+jax.distributed composes them into one 8-device global mesh. The leader
+runs a real TpuEngine over a LeaderRunner and prints the greedy token
+streams as JSON; the follower replays the dispatch stream.
+"""
+
+import asyncio
+import json
+import sys
+
+
+def engine_args():
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+
+    cfg = ModelConfig(
+        name="mh-test", vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=8, num_kv_heads=4, head_dim=16,
+    )
+    return EngineArgs(
+        model=cfg, block_size=4, num_kv_blocks=128, max_num_seqs=4,
+        max_model_len=128, dtype="float32", tp=8, decode_steps=4,
+    )
+
+
+PROMPTS = [[1, 2, 3, 4, 5], [9, 8, 7], list(range(20, 40))]
+MAX_TOKENS = [6, 3, 9]
+
+
+async def leader_main(step_addr: str, nprocs: int):
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.engine.runner import LeaderRunner
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    args = engine_args()
+    port = step_addr.rsplit(":", 1)[1]
+    runner = LeaderRunner(args, seed=3, listen_addr=f"0.0.0.0:{port}",
+                          num_followers=nprocs - 1)
+    engine = await TpuEngine(args, seed=3, runner=runner).start()
+
+    async def one(prompt, n):
+        req = PreprocessedRequest(model="mh-test", token_ids=prompt)
+        req.sampling.temperature = 0.0
+        req.stop.max_tokens = n
+        req.stop.ignore_eos = True
+        got = []
+        async for item in engine.generate(req, Context()):
+            got += item.get("token_ids") or []
+        return got
+
+    outs = await asyncio.gather(*(one(p, n) for p, n in zip(PROMPTS, MAX_TOKENS)))
+    await engine.stop()
+    runner.stop()
+    print("RESULT " + json.dumps(outs), flush=True)
+
+
+def main():
+    role, pid, nprocs, coord, step_addr = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5]
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord, num_processes=nprocs,
+                               process_id=pid)
+    if role == "leader":
+        asyncio.run(leader_main(step_addr, nprocs))
+    else:
+        from dynamo_tpu.engine.runner import follower_loop
+
+        follower_loop(engine_args(), step_addr, seed=3)
+
+
+if __name__ == "__main__":
+    main()

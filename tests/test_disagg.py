@@ -79,7 +79,7 @@ def test_extract_inject_roundtrip():
 
     ids = [3, 7, 2]
     pk, pv = kv_transfer.extract_pages(cache, ids)
-    assert pk.shape == (CFG.num_layers, 3, 4, CFG.num_kv_heads, CFG.head_dim)
+    assert pk.shape == (CFG.num_layers, 3, 4, CFG.num_kv_heads * CFG.head_dim)
     np.testing.assert_array_equal(pk, k[:, ids])
 
     # Wire round-trip then inject into different slots of a fresh cache.

@@ -488,3 +488,83 @@ def test_engine_metrics_snapshot():
             await engine.stop()
 
     asyncio.run(go())
+
+
+def test_engine_pipelined_windows_parity():
+    """The window pipeline (one in-flight window, stops discovered a
+    window late) must produce identical greedy streams to the unpipelined
+    engine, across stop positions that land mid-window, at window edges,
+    and under concurrent mixed lengths."""
+
+    async def collect(pipeline: bool):
+        engine = await TpuEngine(
+            make_args(decode_steps=4, pipeline_windows=pipeline, max_num_seqs=8,
+                      num_kv_blocks=256)
+        ).start()
+        try:
+            reqs = [
+                greedy_request([1, 2, 3], 1),       # stops inside first window
+                greedy_request([4, 5, 6, 7], 4),    # exactly one window
+                greedy_request([8, 9], 6),          # mid second window
+                greedy_request(list(range(10, 25)), 13),
+            ]
+            outs = await asyncio.gather(*(run_one(engine, r) for r in reqs))
+            return [collect_tokens(o) for o in outs]
+        finally:
+            await engine.stop()
+
+    async def go():
+        a = await collect(True)
+        b = await collect(False)
+        assert a == b
+        assert [len(x) for x in a] == [1, 4, 6, 13]
+
+    asyncio.run(go())
+
+
+def test_engine_pipelined_preemption_recovers():
+    """KV pressure with an in-flight window: the engine must drain before
+    preempting so no generated tokens are lost."""
+
+    async def go():
+        engine = await TpuEngine(
+            make_args(decode_steps=4, pipeline_windows=True, max_num_seqs=2,
+                      num_kv_blocks=24, max_model_len=64)
+        ).start()
+        try:
+            outs = await asyncio.gather(
+                run_one(engine, greedy_request([1, 2, 3, 4], 20)),
+                run_one(engine, greedy_request([5, 6, 7, 8], 20)),
+            )
+            for o in outs:
+                toks = collect_tokens(o)
+                assert len(toks) == 20, f"lost tokens: {len(toks)}"
+                assert o[-1]["finish_reason"] == "length"
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_long_prompt_chunked_with_packed_wave():
+    """A prompt whose suffix exceeds max_prefill_tokens takes the chunked
+    singles path ([V] logits) while short prompts in the same wave pack
+    ([Bp, V] rows); the mixed first-token sampling wave must handle both
+    shapes (regression: row index on a [V] ref crashed the loop)."""
+
+    async def go():
+        engine = await TpuEngine(
+            make_args(max_prefill_tokens=16, max_model_len=256, num_kv_blocks=128)
+        ).start()
+        try:
+            outs = await asyncio.gather(
+                run_one(engine, greedy_request(list(range(1, 100)), 5)),  # 99 > 16
+                run_one(engine, greedy_request([1, 2, 3], 5)),
+            )
+            for o in outs:
+                assert len(collect_tokens(o)) == 5
+                assert o[-1]["finish_reason"] == "length"
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())

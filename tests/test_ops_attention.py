@@ -36,8 +36,8 @@ def test_kernel_matches_xla(lengths):
     rng = np.random.default_rng(0)
     L, N, bs, KVH, hd = 3, 40, 16, 4, 64
     B, W, G = 5, 6, 2
-    k_cache = _mk(rng, (L, N, bs, KVH, hd))
-    v_cache = _mk(rng, (L, N, bs, KVH, hd))
+    k_cache = _mk(rng, (L, N, bs, KVH * hd))
+    v_cache = _mk(rng, (L, N, bs, KVH * hd))
     q = _mk(rng, (B, KVH, G, hd))
     tables = jnp.asarray(rng.integers(1, N, size=(B, W)), jnp.int32)
     lens = jnp.asarray(lengths, jnp.int32)
@@ -57,8 +57,8 @@ def test_kernel_single_page_chunks():
     rng = np.random.default_rng(1)
     L, N, bs, KVH, hd = 1, 16, 8, 2, 64
     B, W, G = 3, 4, 4
-    k_cache = _mk(rng, (L, N, bs, KVH, hd))
-    v_cache = _mk(rng, (L, N, bs, KVH, hd))
+    k_cache = _mk(rng, (L, N, bs, KVH * hd))
+    v_cache = _mk(rng, (L, N, bs, KVH * hd))
     q = _mk(rng, (B, KVH, G, hd))
     tables = jnp.asarray(rng.integers(1, N, size=(B, W)), jnp.int32)
     lens = jnp.asarray([32, 7, 9], jnp.int32)
