@@ -160,15 +160,20 @@ class EngineArgs:
 
     @property
     def prefill_buckets(self) -> tuple[int, ...]:
-        lo = min(self.block_size * 2, self.max_prefill_tokens)
-        return _pow2_buckets(lo, self.max_prefill_tokens)
+        # 4x stride: every (Bp x T x W) combination is a separate compile
+        # (~30s each over a remote-compile tunnel), so the lattice must
+        # stay small; padding short prefills 4x is cheap MXU time.
+        lo = min(max(self.block_size * 2, 32), self.max_prefill_tokens)
+        return _pow2_buckets(lo, self.max_prefill_tokens, factor=4)
 
     @property
     def decode_buckets(self) -> tuple[int, ...]:
-        # Floor of 8: decode steps are parameter-bandwidth-bound, so
-        # padding tiny batches to 8 is near-free while halving the
-        # compiled-variant count (compiles are 20-40 s on the tunnel).
-        return _pow2_buckets(min(8, self.max_num_seqs), self.max_num_seqs)
+        # Floor of 8, 4x stride: decode steps are parameter-bandwidth-
+        # bound and padded rows cost ~nothing in the Pallas attention
+        # path, so coarse batch buckets trade a little sampler work for
+        # a much smaller compile matrix (multi_decode variants are the
+        # most expensive compiles, 20-40s each on the tunnel).
+        return _pow2_buckets(min(8, self.max_num_seqs), self.max_num_seqs, factor=4)
 
     @property
     def table_buckets(self) -> tuple[int, ...]:
@@ -176,10 +181,13 @@ class EngineArgs:
         with the table width actually passed (model.py derives W from the
         shape), so short sequences must not pay for max_model_len — each
         batch uses the smallest bucket covering its longest sequence
-        (VERDICT r2 weak #3). 4x stride: the attention surcharge of an
-        oversized bucket is small next to param reads, and the
-        (B x W x mode) compile matrix must stay small."""
-        return _pow2_buckets(min(8, self.blocks_per_seq), self.blocks_per_seq, factor=4)
+        (VERDICT r2 weak #3). Two buckets only: the Pallas decode kernel
+        does work proportional to TRUE lengths (padded table width costs
+        ~one skipped grid step per dead chunk), so a wide table is nearly
+        free on TPU; the small bucket keeps short-prompt prefill (XLA
+        gather path) and CPU tests cheap."""
+        small = min(8, self.blocks_per_seq)
+        return tuple(dict.fromkeys((small, self.blocks_per_seq)))
 
     def bucket_table(self, n_blocks: int) -> int:
         for b in self.table_buckets:
@@ -196,10 +204,8 @@ class EngineArgs:
         raise ValueError(f"prefill of {n} tokens exceeds max_prefill_tokens={self.max_prefill_tokens}")
 
     def bucket_prefill_rows(self, n: int) -> int:
-        b = 1
-        while b < min(n, self.prefill_batch_max):
-            b *= 2
-        return b
+        # Two sizes (1 or max): each row-count is its own compile.
+        return 1 if n <= 1 else self.prefill_batch_max
 
     def bucket_decode(self, n: int) -> int:
         for b in self.decode_buckets:

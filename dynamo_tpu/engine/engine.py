@@ -145,6 +145,9 @@ class TpuEngine:
         self._running: list[_Seq] = []
         self._stopping = False
         self._inflight: _Window | None = None
+        # (tokens, future, loop) embedding jobs; served between scheduler
+        # steps on the engine thread (device dispatch affinity).
+        self._embed_jobs: collections.deque = collections.deque()
         # Disagg exports: handle → (KvPagePayload, deadline). Host copies,
         # so they survive cache donation; reaped after export_ttl_s.
         self._exports: dict[str, tuple[Any, float]] = {}
@@ -267,6 +270,7 @@ class TpuEngine:
                         and not self._submissions
                         and not self._waiting
                         and not self._running
+                        and not self._embed_jobs
                     ):
                         self._wakeup.wait()
                     if self._stopping:
@@ -292,9 +296,19 @@ class TpuEngine:
             for seq in leftovers:
                 self._post(seq, LLMEngineOutput(finish_reason=reason, error=err).to_dict())
                 self._post_done(seq)
+            # Pending embed futures must resolve too, or their awaiters
+            # hang forever.
+            while self._embed_jobs:
+                _toks, fut, floop = self._embed_jobs.popleft()
+                exc = RuntimeError(err or "engine stopped")
+                floop.call_soon_threadsafe(
+                    lambda f=fut, e=exc: f.set_exception(e) if not f.cancelled() else None
+                )
 
     def _step(self) -> None:
         self._reap_cancelled()
+        while self._embed_jobs:
+            self._serve_embed(*self._embed_jobs.popleft())
         if self._exports:
             self._reap_exports()
         # Prefill-priority admission, two phases: (1) allocate KV for the
@@ -368,6 +382,48 @@ class TpuEngine:
         if self._running:
             self._decode_iteration()
             self._flush_offloads()
+
+    # -- embeddings (reference: http/service/openai.rs:302) ----------------
+
+    async def embed(self, token_ids: list[int]) -> list[float]:
+        """Mean-pooled final hidden state; serialized through the
+        scheduler thread (device dispatch affinity)."""
+        if not token_ids:
+            raise RequestValidationError("empty input")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        with self._wakeup:
+            if self._stopping:
+                raise RuntimeError("engine is stopping")
+            self._embed_jobs.append((list(token_ids), fut, loop))
+            self._wakeup.notify()
+        return await fut
+
+    def _serve_embed(self, token_ids: list[int], fut, loop) -> None:
+        try:
+            if len(token_ids) > self.args.max_prefill_tokens:
+                raise RequestValidationError(
+                    f"input of {len(token_ids)} tokens exceeds the embedding "
+                    f"limit of {self.args.max_prefill_tokens}"
+                )
+            t_pad = self.args.bucket_prefill(len(token_ids))
+            toks = np.zeros((t_pad,), np.int32)
+            toks[: len(token_ids)] = token_ids
+            ref = self._runner.embed(toks, len(token_ids))
+            vec = [float(x) for x in np.asarray(ref.arrs[0])]
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(vec) if not fut.cancelled() else None
+            )
+        except Exception as e:  # noqa: BLE001 — surface to the caller
+            err = e
+            loop.call_soon_threadsafe(
+                lambda: fut.set_exception(err) if not fut.cancelled() else None
+            )
+
+    def clear_kv_blocks(self) -> int:
+        """Admin: drop all idle cached blocks (reference:
+        http/service/clear_kv_blocks.rs). → number of blocks dropped."""
+        return self.pool.clear()
 
     def _flush_offloads(self) -> None:
         """Batch-extract queued sealed blocks to the host tiers: one DMA
@@ -771,7 +827,7 @@ class TpuEngine:
 
         wchain = None
         if chain:
-            wchain = (prev.ref, [d for d, _ in chain], [s for _, s in chain])
+            wchain = ([d for d, _ in chain], [s for _, s in chain])
         ref = self._runner.multi_decode(
             K, mode, tokens, wchain, positions, tables, active,
             temps, seeds, steps0, tks, tps, freqs, press, pen,

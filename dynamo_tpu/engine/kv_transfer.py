@@ -140,3 +140,49 @@ class KvPagePayload:
             k = np.frombuffer(d["k"], np.dtype(kind)).reshape(shape)
             v = np.frombuffer(d["v"], np.dtype(kind)).reshape(shape)
         return cls(k=k, v=v, num_tokens=int(d["num_tokens"]))
+
+    # -- chunked streaming --------------------------------------------------
+    #
+    # A 70B-geometry 2k-token export is ~640 MB — far beyond the framing
+    # cap (runtime/framing.py MAX_FRAME) and big enough to stall an event
+    # loop if serialized at once. Streams of <=max_bytes frames keep the
+    # response plane responsive (reference analogue: NIXL moves KV in
+    # block-granular RDMA ops, not one giant message).
+
+    DEFAULT_FRAME_BYTES = 16 << 20
+
+    def to_frames(self, max_bytes: int = DEFAULT_FRAME_BYTES):
+        """Yield wire frames: one header, then <=max_bytes data chunks."""
+        k, v = self.k, self.v
+        kind = str(k.dtype)
+        if kind == "bfloat16":
+            k, v = k.view(np.uint16), v.view(np.uint16)
+        kb, vb = k.tobytes(), v.tobytes()
+        yield {
+            "kind": "kv_header",
+            "shape": list(self.k.shape),
+            "dtype": kind,
+            "num_tokens": self.num_tokens,
+            "k_bytes": len(kb),
+            "v_bytes": len(vb),
+        }
+        for name, buf in (("k", kb), ("v", vb)):
+            for off in range(0, len(buf), max_bytes):
+                yield {"kind": name, "data": buf[off : off + max_bytes]}
+
+    @classmethod
+    def from_frames(cls, frames: list[dict]) -> "KvPagePayload":
+        header = frames[0]
+        if header.get("kind") != "kv_header":
+            raise ValueError("first frame is not a kv_header")
+        kb = b"".join(f["data"] for f in frames[1:] if f["kind"] == "k")
+        vb = b"".join(f["data"] for f in frames[1:] if f["kind"] == "v")
+        if len(kb) != header["k_bytes"] or len(vb) != header["v_bytes"]:
+            raise ValueError(
+                f"truncated kv stream: k {len(kb)}/{header['k_bytes']} "
+                f"v {len(vb)}/{header['v_bytes']}"
+            )
+        return cls.from_dict({
+            "k": kb, "v": vb, "shape": header["shape"],
+            "dtype": header["dtype"], "num_tokens": header["num_tokens"],
+        })

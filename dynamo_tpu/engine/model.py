@@ -352,6 +352,10 @@ def multi_decode_impl(
     freq_penalty: jax.Array,  # [B] fp32 (mode="full")
     pres_penalty: jax.Array,  # [B] fp32 (mode="full")
     penalty_tokens: jax.Array,  # [B, L] int32 generated-so-far ids, -1 pad (mode="full")
+    chain_mask: jax.Array | None = None,  # [B] bool — row chains from last_toks
+    chain_src: jax.Array | None = None,   # [B] int32 — row in last_toks
+    last_toks: jax.Array | None = None,   # [Bmax] int32 — previous window's
+                                          # final sampled tokens (device)
     *,
     attn_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, KVCache]:
@@ -386,6 +390,12 @@ def multi_decode_impl(
 
     B = tokens.shape[0]
     V = cfg.vocab_size
+    if chain_mask is not None:
+        # Window pipeline: chained rows take their input token from the
+        # previous window's on-device output — composed INSIDE the jit so
+        # the variant count stays fixed (an eager scatter with
+        # data-dependent index counts compiled per distinct count).
+        tokens = jnp.where(chain_mask, last_toks[chain_src], tokens)
     counts0 = (
         token_counts(penalty_tokens, V) if mode == "full"
         else jnp.zeros((B, 1), jnp.float32)  # unused placeholder carry
@@ -424,6 +434,48 @@ def multi_decode_impl(
     return toks, logps, cache  # [num_steps, B] each
 
 
+def embed_impl(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,    # [T_pad] int32
+    true_len: jax.Array,  # scalar int32
+) -> jax.Array:
+    """Mean-pooled final-norm hidden state over the true tokens → [D]
+    fp32. Cache-free causal forward (serves /v1/embeddings; reference:
+    lib/llm/src/http/service/openai.rs:302)."""
+    T = tokens.shape[0]
+    x = params["embed"][tokens]  # [T, D]
+    pos = jnp.arange(T, dtype=jnp.int32)
+    neg = jnp.float32(-1e9)
+    causal = (pos[None, :] <= pos[:, None])
+    valid = pos[None, :] < true_len
+    mask = jnp.where(causal & valid, 0.0, neg)  # [T, T]
+    scale = cfg.head_dim ** -0.5
+    G = cfg.num_heads // cfg.num_kv_heads
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = jnp.dot(h, lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
+        k = jnp.dot(h, lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        v = jnp.dot(h, lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        qg = q.reshape(T, cfg.num_kv_heads, G, cfg.head_dim)
+        s = jnp.einsum("tkgh,skh->tkgs", qg, k).astype(jnp.float32) * scale
+        s = s + mask[:, None, None, :]
+        p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("tkgs,skh->tkgh", p, v).reshape(T, cfg.q_size)
+        x = x + jnp.dot(o, lp["wo"])
+        h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    w = (pos < true_len).astype(jnp.float32)[:, None]
+    return jnp.sum(x * w, axis=0) / jnp.maximum(true_len.astype(jnp.float32), 1.0)
+
+
 # Jitted entry points (static model config / step count, donated cache).
 prefill = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(prefill_impl)
 prefill_batch = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(prefill_batch_impl)
@@ -433,3 +485,4 @@ decode_step = functools.partial(
 multi_decode = functools.partial(
     jax.jit, static_argnums=(0, 1, 2), static_argnames=("attn_impl",), donate_argnums=(4,)
 )(multi_decode_impl)
+embed = functools.partial(jax.jit, static_argnums=(0,))(embed_impl)

@@ -50,6 +50,16 @@ log = get_logger("runner")
 _RETAIN = 128  # refs kept for chaining/sampling (identical on all hosts)
 
 
+@jax.jit
+def _update_last(last_toks, window_toks):
+    """Fold a window's final sampled tokens into the persistent buffer
+    (one tiny compiled variant per batch bucket)."""
+    import jax.numpy as _jnp
+
+    B = window_toks.shape[1]
+    return last_toks.at[:B].set(window_toks[-1])
+
+
 class StepRef:
     """Opaque handle to a dispatch's device-side results."""
 
@@ -84,6 +94,9 @@ class LocalRunner:
         self.attn_impl = "xla"
         self._rid = 0
         self._refs: OrderedDict[int, StepRef] = OrderedDict()
+        # Previous decode window's final sampled tokens [max_num_seqs],
+        # kept on device for window chaining (no host sync).
+        self._last_toks: jax.Array | None = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -149,24 +162,31 @@ class LocalRunner:
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
                      *, rid=None) -> StepRef:
-        """chain: None | (prev window StepRef-or-rid, dst rows, src rows) —
-        rows of this window whose input token is the previous window's last
-        on-device output (no host sync)."""
-        tok_in = jnp.asarray(tokens)
+        """chain: None | (dst rows, src rows) — rows of this window whose
+        input token is the previous window's last on-device output
+        (self._last_toks; no host sync). Shapes stay fixed per batch
+        bucket: chaining is expressed as a [B] mask + src map inside the
+        jit, and last_toks is a persistent [max_num_seqs] buffer."""
+        B = len(tokens)
+        if self._last_toks is None:
+            self._last_toks = jnp.zeros((self.args.max_num_seqs,), jnp.int32)
+        mask = np.zeros((B,), bool)
+        srcmap = np.zeros((B,), np.int32)
         if chain is not None:
-            prev, dst, src = chain
-            if not isinstance(prev, StepRef):
-                prev = self.ref_by_id(prev)
-            tok_in = tok_in.at[jnp.asarray(dst)].set(prev.arrs[0][-1][jnp.asarray(src)])
+            dst, src = chain
+            mask[np.asarray(dst, np.int64)] = True
+            srcmap[np.asarray(dst, np.int64)] = src
         toks_d, logps_d, self.cache = M.multi_decode(
             self.cfg, K, mode, self.params, self.cache,
-            tok_in, jnp.asarray(positions),
+            jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(active),
             jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
             jnp.asarray(tks), jnp.asarray(tps),
             jnp.asarray(freqs), jnp.asarray(press), jnp.asarray(pen),
+            jnp.asarray(mask), jnp.asarray(srcmap), self._last_toks,
             attn_impl=self.attn_impl,
         )
+        self._last_toks = _update_last(self._last_toks, toks_d)
         return self._new_ref((toks_d, logps_d), rid)
 
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
@@ -201,6 +221,10 @@ class LocalRunner:
         else:
             out = sample_simple(logits, jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps))
         return out, token_logprobs(logits, out)
+
+    def embed(self, toks, tlen, *, rid=None) -> StepRef:
+        emb = M.embed(self.cfg, self.params, jnp.asarray(toks), jnp.int32(tlen))
+        return self._new_ref((emb,), rid)
 
     def extract_pages(self, block_ids: list[int]):
         pk, pv = kv_transfer.extract_pages(self.cache, block_ids, replicate=self.sharding)
@@ -306,9 +330,8 @@ class LeaderRunner(LocalRunner):
         rid = self._rid
         wire_chain = None
         if chain is not None:
-            prev, dst, src = chain
-            wire_chain = [prev.rid if isinstance(prev, StepRef) else prev,
-                          list(map(int, dst)), list(map(int, src))]
+            dst, src = chain
+            wire_chain = [list(map(int, dst)), list(map(int, src))]
         self._cast({"op": "multi_decode", "rid": rid, "K": int(K), "mode": mode,
                     "tokens": _pack_np(tokens), "chain": wire_chain,
                     "positions": _pack_np(positions), "tables": _pack_np(tables),
@@ -343,6 +366,12 @@ class LeaderRunner(LocalRunner):
                     "full": bool(full)})
         return super().sample_rows(srcs, temps, tks, tps, pen, freqs, press,
                                    seeds, steps, full)
+
+    def embed(self, toks, tlen, *, rid=None) -> StepRef:
+        rid = self._rid
+        self._cast({"op": "embed", "rid": rid, "toks": _pack_np(np.asarray(toks, np.int32)),
+                    "tlen": int(tlen)})
+        return super().embed(toks, tlen, rid=rid)
 
     def extract_pages(self, block_ids: list[int]):
         self._cast({"op": "extract_pages", "ids": list(map(int, block_ids))})
@@ -402,7 +431,7 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
         elif op == "multi_decode":
             chain = desc["chain"]
             if chain is not None:
-                chain = (chain[0], chain[1], chain[2])
+                chain = (chain[0], chain[1])
             runner.multi_decode(
                 desc["K"], desc["mode"], _unpack_np(desc["tokens"]), chain,
                 _unpack_np(desc["positions"]), _unpack_np(desc["tables"]),
@@ -424,6 +453,8 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
                 _unpack_np(desc["seeds"]), _unpack_np(desc["steps"]),
                 desc["full"])
+        elif op == "embed":
+            runner.embed(_unpack_np(desc["toks"]), desc["tlen"], rid=desc["rid"])
         elif op == "extract_pages":
             runner.extract_pages(desc["ids"])
         elif op == "inject_pages":

@@ -117,6 +117,25 @@ class KvStats:
 
 
 @dataclass
+class KVHitRateEvent:
+    """One routing decision's prefix-hit outcome (reference:
+    lib/llm/src/kv_router/scheduler.rs:107-214 emits these on NATS;
+    here they flow to an injectable sink — metrics and the recorder)."""
+
+    worker_id: int
+    isl_blocks: int       # request length in blocks
+    overlap_blocks: int   # prefix blocks already on the chosen worker
+
+    @property
+    def hit_rate(self) -> float:
+        return self.overlap_blocks / self.isl_blocks if self.isl_blocks else 0.0
+
+    def to_dict(self) -> dict:
+        return {"worker_id": self.worker_id, "isl_blocks": self.isl_blocks,
+                "overlap_blocks": self.overlap_blocks}
+
+
+@dataclass
 class ForwardPassMetrics:
     """Per-worker load snapshot served on the ``load_metrics`` endpoint
     (reference: kv_router/publisher.rs:481-523)."""

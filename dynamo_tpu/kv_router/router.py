@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.protocols import KVHitRateEvent
 from dynamo_tpu.kv_router.indexer import RadixIndex
 from dynamo_tpu.kv_router.publisher import KvEventSubscription
 from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig
@@ -47,8 +48,12 @@ class KvRouterConfig:
 class KvPushRouter:
     """AsyncEngine shape over a DIRECT PushRouter."""
 
-    def __init__(self, push_router: PushRouter, config: KvRouterConfig | None = None):
+    def __init__(self, push_router: PushRouter, config: KvRouterConfig | None = None,
+                 event_sink=None):
         self.config = config or KvRouterConfig()
+        # callable(KVHitRateEvent) — routing-quality observability
+        # (reference: scheduler.rs KVHitRateEvent → components/metrics).
+        self.event_sink = event_sink
         self.push = push_router
         self.discovery = push_router.discovery
         self.messaging = push_router.messaging
@@ -177,6 +182,15 @@ class KvPushRouter:
             except NoInstancesError:
                 break
             wid = placement.worker
+            if self.event_sink is not None:
+                try:
+                    self.event_sink(KVHitRateEvent(
+                        worker_id=wid,
+                        isl_blocks=placement.total_blocks,
+                        overlap_blocks=placement.overlap_blocks,
+                    ))
+                except Exception:  # noqa: BLE001 — observability never breaks routing
+                    log.exception("hit-rate event sink failed")
             if isinstance(request, dict):
                 request = dict(request)
                 request["estimated_prefix_hit_num_blocks"] = placement.overlap_blocks

@@ -138,6 +138,7 @@ class Backend(Operator):
                 token_ids=list(out.token_ids[:n_new]),
                 text="".join(text_parts) if text_parts else None,
                 finish_reason=finish,
+                log_probs=list(out.log_probs[:n_new]) if out.log_probs else None,
                 cum_log_probs=out.cum_log_probs,
                 kv_transfer_params=out.kv_transfer_params,
             )

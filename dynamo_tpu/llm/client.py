@@ -1,0 +1,150 @@
+"""Typed OpenAI-compatible HTTP client with retry.
+
+Reference analogue: lib/llm/src/http/client.rs:679 — the typed client the
+reference's tests and benches drive the frontend with. Retries are for
+transient transport errors and 429/5xx, with exponential backoff; 4xx
+client errors surface immediately.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+import httpx
+
+_RETRYABLE = {429, 500, 502, 503, 504}
+
+
+class OpenAIClientError(Exception):
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+@dataclass
+class OpenAIClient:
+    base_url: str
+    timeout: float = 60.0
+    max_retries: int = 3
+    backoff_s: float = 0.25
+    default_model: str | None = None
+    _client: httpx.AsyncClient | None = field(default=None, repr=False)
+
+    async def __aenter__(self) -> "OpenAIClient":
+        self._client = httpx.AsyncClient(base_url=self.base_url, timeout=self.timeout)
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._client is not None:
+            await self._client.aclose()
+            self._client = None
+
+    def _http(self) -> httpx.AsyncClient:
+        if self._client is None:
+            raise RuntimeError("use 'async with OpenAIClient(...)'")
+        return self._client
+
+    async def _post_json(self, path: str, body: dict) -> dict:
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                r = await self._http().post(path, json=body)
+            except (httpx.TransportError, OSError) as e:
+                last = e
+            else:
+                if r.status_code < 400:
+                    return r.json()
+                payload = _safe_json(r)
+                if r.status_code not in _RETRYABLE:
+                    raise OpenAIClientError(r.status_code, payload)
+                last = OpenAIClientError(r.status_code, payload)
+            if attempt < self.max_retries:
+                await asyncio.sleep(self.backoff_s * (2 ** attempt))
+        assert last is not None
+        raise last
+
+    # -- typed surfaces ----------------------------------------------------
+
+    async def chat(self, messages: list[dict], model: str | None = None, **kw) -> dict:
+        body = {"model": model or self.default_model, "messages": messages, **kw}
+        return await self._post_json("/v1/chat/completions", body)
+
+    async def completion(self, prompt, model: str | None = None, **kw) -> dict:
+        body = {"model": model or self.default_model, "prompt": prompt, **kw}
+        return await self._post_json("/v1/completions", body)
+
+    async def embeddings(self, input: Any, model: str | None = None) -> dict:
+        body = {"model": model or self.default_model, "input": input}
+        return await self._post_json("/v1/embeddings", body)
+
+    async def clear_kv_blocks(self) -> dict:
+        return await self._post_json("/clear_kv_blocks", {})
+
+    async def models(self) -> list[str]:
+        r = await self._http().get("/v1/models")
+        if r.status_code >= 400:
+            raise OpenAIClientError(r.status_code, _safe_json(r))
+        return [m["id"] for m in r.json().get("data", [])]
+
+    async def health(self) -> dict:
+        r = await self._http().get("/health")
+        return r.json()
+
+    async def chat_stream(
+        self, messages: list[dict], model: str | None = None, **kw
+    ) -> AsyncIterator[dict]:
+        """Stream chat chunks (retry applies to connection setup only —
+        a broken mid-flight stream is surfaced, not replayed)."""
+        body = {"model": model or self.default_model, "messages": messages,
+                "stream": True, **kw}
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                async with self._http().stream(
+                    "POST", "/v1/chat/completions", json=body
+                ) as r:
+                    if r.status_code >= 400:
+                        payload = _safe_json_bytes(await r.aread())
+                        raise OpenAIClientError(r.status_code, payload)
+
+                    buf = b""
+                    async for raw in r.aiter_bytes():
+                        buf += raw
+                        while b"\n\n" in buf:
+                            event, buf = buf.split(b"\n\n", 1)
+                            for line in event.split(b"\n"):
+                                if not line.startswith(b"data:"):
+                                    continue
+                                data = line.split(b":", 1)[1].strip().decode()
+                                if data == "[DONE]":
+                                    return
+                                yield json.loads(data)
+                    return
+            except OpenAIClientError as e:
+                if e.status not in _RETRYABLE:
+                    raise
+                last = e
+            except (httpx.TransportError, OSError) as e:
+                last = e
+            if attempt < self.max_retries:
+                await asyncio.sleep(self.backoff_s * (2 ** attempt))
+        assert last is not None
+        raise last
+
+
+def _safe_json(r: httpx.Response) -> Any:
+    try:
+        return r.json()
+    except Exception:  # noqa: BLE001
+        return r.text
+
+
+def _safe_json_bytes(b: bytes) -> Any:
+    try:
+        return json.loads(b)
+    except Exception:  # noqa: BLE001
+        return b[:500].decode(errors="replace")

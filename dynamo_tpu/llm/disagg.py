@@ -18,6 +18,7 @@ injected state, so its logits/tokens are identical to an aggregated run
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
@@ -36,6 +37,14 @@ class DisaggConfig:
     prefill_component: str = "prefill"
     prefill_endpoint: str = "generate"
     fetch_endpoint: str = "kv_fetch"
+    # Competing-consumer prefill queue (runtime/queue.py; reference:
+    # the NATS JetStream prefill queue, transports/nats.rs:345-473).
+    queue_name: str = "prefill"
+    # How long the decode worker waits for a queued prefill before
+    # falling back to local prefill.
+    queue_timeout_s: float = 60.0
+    # KV page stream chunking (kv_transfer.KvPagePayload.to_frames).
+    frame_bytes: int = 16 << 20
 
 
 def should_prefill_remote(
@@ -49,10 +58,12 @@ def should_prefill_remote(
 
 class PrefillHandler:
     """Prefill-worker side: pass-through to the engine plus the
-    ``kv_fetch`` endpoint serving exported pages (one-shot)."""
+    ``kv_fetch`` endpoint streaming exported pages in bounded frames
+    (one-shot per handle)."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, frame_bytes: int = 16 << 20):
         self.engine = engine
+        self.frame_bytes = frame_bytes
 
     async def generate(self, payload: Any, ctx: Context) -> AsyncIterator[dict]:
         async for item in self.engine.generate(payload, ctx):
@@ -63,20 +74,110 @@ class PrefillHandler:
         export = self.engine.take_export(handle)
         if export is None:
             yield {"error": f"unknown or expired export handle {handle!r}"}
-        else:
-            yield export.to_dict()
+            return
+        for frame in export.to_frames(self.frame_bytes):
+            yield frame
+
+
+class PrefillPuller:
+    """Competing-consumer prefill loop (reference: the NATS work-queue
+    feeding prefill workers, transports/nats.rs:345-473 + docs/
+    architecture/disagg_serving.md:62).
+
+    Pops queued prefill jobs, runs them on the local engine, and posts
+    the export handle to the job's store reply key; the decode worker
+    watches that key and then pulls the pages directly. A crashed puller
+    simply never replies — the decode side times out into local prefill.
+    """
+
+    def __init__(self, engine, queue, store, instance_id: int):
+        self.engine = engine
+        self.queue = queue
+        self.store = store
+        self.instance_id = instance_id
+        self.jobs_done = 0
+        self._task = None
+
+    def start(self) -> "PrefillPuller":
+        import asyncio
+
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except BaseException:  # noqa: BLE001 — cancellation path
+                pass
+
+    async def _loop(self) -> None:
+        import time
+
+        while True:
+            job = await self.queue.dequeue()
+            if job is None:
+                continue
+            # The decode side has already fallen back past its deadline:
+            # don't waste a prefill on it (its reply key is gone too).
+            expires = job.get("expires_at")
+            if expires is not None and time.time() > expires:
+                log.info("dropping expired prefill job")
+                continue
+            try:
+                await self._run_job(job)
+                self.jobs_done += 1
+            except Exception:  # noqa: BLE001 — keep consuming; an empty
+                # reply unblocks the decode worker immediately instead of
+                # making it wait out its full queue timeout.
+                log.exception("queued prefill job failed")
+                with contextlib.suppress(Exception):
+                    await self._reply(job["reply_key"], {"instance_id": self.instance_id})
+
+    async def _run_job(self, job: dict) -> None:
+        req, reply_key = job["req"], job["reply_key"]
+        meta = None
+        async for item in self.engine.generate(req, Context()):
+            if isinstance(item, dict) and item.get("kv_transfer_params"):
+                meta = item["kv_transfer_params"]
+        reply = {"instance_id": self.instance_id}
+        if meta and meta.get("num_blocks"):
+            reply["handle"] = meta["remote_handle"]
+            reply["num_blocks"] = meta["num_blocks"]
+        await self._reply(reply_key, reply)
+
+    async def _reply(self, reply_key: str, reply: dict) -> None:
+        import msgpack
+
+        # Lease-attached (instance_id == the worker's lease): an orphaned
+        # reply key (decode timed out and stopped watching) dies with this
+        # process instead of accumulating in the store.
+        await self.store.put(
+            reply_key, msgpack.packb(reply, use_bin_type=True),
+            lease_id=self.instance_id,
+        )
 
 
 class DisaggDecodeHandler:
     """Decode-worker side: conditional remote prefill in front of the
     local engine. ``prefill_router``/``fetch_router`` are PushRouters on
-    the prefill component's generate/kv_fetch endpoints."""
+    the prefill component's generate/kv_fetch endpoints.
 
-    def __init__(self, engine, prefill_router, fetch_router, cfg: DisaggConfig | None = None):
+    With ``queue``+``store`` set, prefill dispatch goes through the
+    competing-consumer work queue instead of round-robin push: free
+    prefill workers pull jobs at their own pace (reference:
+    docs/architecture/disagg_serving.md:62), and the decode worker
+    rendezvouses on a store reply key."""
+
+    def __init__(self, engine, prefill_router, fetch_router,
+                 cfg: DisaggConfig | None = None, queue=None, store=None):
         self.engine = engine
         self.prefill_router = prefill_router
         self.fetch_router = fetch_router
         self.cfg = cfg or DisaggConfig()
+        self.queue = queue
+        self.store = store
         # Observability: how many requests actually went remote.
         self.remote_prefills = 0
         self.local_fallbacks = 0
@@ -111,6 +212,35 @@ class DisaggDecodeHandler:
         preq["stop"] = {"max_tokens": 1, "ignore_eos": True}
         preq["kv_transfer_params"] = {"do_remote_decode": True}
         preq.pop("estimated_prefix_hit_num_blocks", None)
+        if self.queue is not None and self.store is not None:
+            handle_info = await self._dispatch_via_queue(preq)
+        else:
+            handle_info = await self._dispatch_via_push(preq, ctx)
+        if handle_info is None:
+            return None
+        handle, instance_id = handle_info
+        try:
+            frames: list[dict] = []
+            async for resp in self.fetch_router.generate(
+                {"handle": handle}, Context(trace=ctx.trace),
+                instance_id=instance_id,
+            ):
+                frames.append(resp)
+            if not frames or frames[0].get("error"):
+                log.warning("kv fetch failed: %s",
+                            (frames[0] if frames else {}).get("error", "empty"))
+                return None
+            if frames[0].get("kind") == "kv_header":
+                from dynamo_tpu.engine.kv_transfer import KvPagePayload
+
+                return KvPagePayload.from_frames(frames).to_dict()
+            return frames[-1]  # legacy single-frame payload
+        except Exception as e:  # noqa: BLE001
+            log.warning("kv fetch failed (%s); falling back to local", e)
+            return None
+
+    async def _dispatch_via_push(self, preq: dict, ctx: Context):
+        """Round-robin push to a prefill worker. → (handle, instance_id)."""
         meta = None
         try:
             pctx = Context(trace=ctx.trace)
@@ -123,17 +253,49 @@ class DisaggDecodeHandler:
             return None
         if not meta or not meta.get("num_blocks") or instance_id is None:
             return None
+        return meta["remote_handle"], instance_id
+
+    async def _dispatch_via_queue(self, preq: dict):
+        """Enqueue the job, rendezvous on the reply key.
+        → (handle, instance_id) | None."""
+        import asyncio
+        import os
+        import time
+
+        import msgpack
+
+        reply_key = f"disagg/reply/{os.urandom(8).hex()}"
         try:
-            pages = None
-            async for resp in self.fetch_router.generate(
-                {"handle": meta["remote_handle"]}, Context(trace=ctx.trace),
-                instance_id=instance_id,
-            ):
-                pages = resp
-            if not pages or pages.get("error"):
-                log.warning("kv fetch failed: %s", (pages or {}).get("error", "empty"))
-                return None
-            return pages
+            await self.queue.enqueue({
+                "req": preq, "reply_key": reply_key,
+                "expires_at": time.time() + self.cfg.queue_timeout_s,
+            })
+            deadline = time.monotonic() + self.cfg.queue_timeout_s
+            watch = await self.store.watch_prefix(reply_key)
+            try:
+                value = None
+                for e in watch.snapshot:
+                    if e.key == reply_key:
+                        value = e.value
+                while value is None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        log.warning("queued prefill timed out; falling back to local")
+                        return None
+                    try:
+                        ev = await asyncio.wait_for(watch.__anext__(), remaining)
+                    except (asyncio.TimeoutError, StopAsyncIteration):
+                        log.warning("queued prefill timed out; falling back to local")
+                        return None
+                    if ev.key == reply_key and ev.value is not None:
+                        value = ev.value
+            finally:
+                await watch.cancel()
+                await self.store.delete(reply_key)
+            reply = msgpack.unpackb(value, raw=False)
+            if not reply.get("handle"):
+                return None  # prefill ran but exported nothing (tiny prompt)
+            return reply["handle"], reply["instance_id"]
         except Exception as e:  # noqa: BLE001
-            log.warning("kv fetch failed (%s); falling back to local", e)
+            log.warning("queued prefill failed (%s); falling back to local", e)
             return None

@@ -41,6 +41,11 @@ class ModelManager:
     def list_names(self) -> list[str]:
         return sorted(p.card.name for p in self._pipelines.values())
 
+    def items(self) -> list[tuple[str, "ModelPipeline"]]:
+        return sorted(
+            ((p.card.name, p) for p in self._pipelines.values()), key=lambda x: x[0]
+        )
+
     async def add(self, namespace: str, card: ModelDeploymentCard) -> None:
         key = (namespace, card.slug)
         if key in self._pipelines:

@@ -55,6 +55,9 @@ class HttpService:
         self.m_inflight = scope.gauge("http_inflight", "In-flight requests")
         self.m_duration = scope.histogram("http_request_duration_seconds", "Request duration")
         self.m_ttft = scope.histogram("http_time_to_first_token_seconds", "Time to first token")
+        # Per-request mean inter-token latency — the planner's ITL input
+        # (reference observes ITL from frontend metrics, planner_core.py:189-320).
+        self.m_itl = scope.histogram("http_inter_token_latency_seconds", "Mean inter-token latency per request")
         self.m_output_tokens = scope.counter("http_output_tokens_total", "Output tokens")
         self._metrics_registry = metrics
 
@@ -66,6 +69,8 @@ class HttpService:
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/live", self.handle_live)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_post("/v1/embeddings", self.handle_embeddings)
+        app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
         return app
 
     async def start(self) -> "HttpService":
@@ -96,6 +101,82 @@ class HttpService:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=self._metrics_registry.render(), content_type="text/plain")
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings (reference: http/service/openai.rs:302).
+        Accepts string / list-of-strings / token-id inputs; vectors are the
+        model's mean-pooled final hidden states."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response(
+                OpenAIError("request body must be JSON").body(), status=400
+            )
+        model = body.get("model") or ""
+        pipe = self.manager.get(model)
+        if pipe is None:
+            return web.json_response(
+                OpenAIError(f"model {model!r} not found", status=404,
+                            err_type="not_found_error").body(),
+                status=404,
+            )
+        raw = body.get("input")
+        if isinstance(raw, str):
+            inputs: list = [raw]
+        elif isinstance(raw, list) and raw and all(isinstance(t, int) for t in raw):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw:
+            inputs = raw
+        else:
+            return web.json_response(
+                OpenAIError("'input' must be a string, list of strings, or token ids").body(),
+                status=400,
+            )
+        tok = pipe.preprocessor.tokenizer
+        data = []
+        total_tokens = 0
+        try:
+            for i, item in enumerate(inputs):
+                ids = tok.encode(item) if isinstance(item, str) else [int(t) for t in item]
+                total_tokens += len(ids)
+                vec = await pipe.embed(ids)
+                data.append({"object": "embedding", "index": i, "embedding": vec})
+        except NoInstancesError:
+            # No worker serves the embed endpoint (e.g. mocker fleets).
+            return web.json_response(
+                OpenAIError("embeddings unavailable for this model", status=501,
+                            err_type="not_implemented_error").body(),
+                status=501,
+            )
+        except Exception as e:  # noqa: BLE001 — worker- or engine-reported
+            # failure: validation errors are the client's (empty/over-limit
+            # input → 400); anything else is a 500.
+            msg = str(e)
+            if "exceeds" in msg or "empty input" in msg:
+                return web.json_response(OpenAIError(msg).body(), status=400)
+            log.warning("embeddings failed: %s", e)
+            return web.json_response(
+                OpenAIError("embedding failed", status=500,
+                            err_type="internal_error").body(),
+                status=500,
+            )
+        return web.json_response({
+            "object": "list",
+            "model": model,
+            "data": data,
+            "usage": {"prompt_tokens": total_tokens, "total_tokens": total_tokens},
+        })
+
+    async def handle_clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin: clear idle KV blocks on all workers of every model
+        (reference: http/service/clear_kv_blocks.rs)."""
+        out: dict[str, dict] = {}
+        for name, pipe in self.manager.items():
+            try:
+                out[name] = await pipe.clear_kv_blocks()
+            except Exception as e:  # noqa: BLE001
+                out[name] = {"error": str(e)}
+        return web.json_response({"status": "ok", "cleared": out})
 
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response(model_list(self.manager.list_names()))
@@ -171,13 +252,16 @@ class HttpService:
         first = True
         last_gen = None
         failed = False
+        t_first_tok = t_last_tok = None
         try:
             while head is not None:
                 gen, chunk = head
                 last_gen = gen
                 if chunk is not None:
+                    t_last_tok = time.perf_counter()
                     if first:
                         first = False
+                        t_first_tok = t_last_tok
                         self.m_ttft.observe(time.perf_counter() - t0, model=model)
                     try:
                         await resp.write(sse_event(json.dumps(chunk)))
@@ -207,6 +291,11 @@ class HttpService:
                 await resp.write_eof()
         if last_gen is not None:
             self.m_output_tokens.inc(last_gen.completion_tokens, model=model)
+            if last_gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
+                self.m_itl.observe(
+                    (t_last_tok - t_first_tok) / (last_gen.completion_tokens - 1),
+                    model=model,
+                )
         if not ctx.cancelled and not failed:
             self.m_requests.inc(model=model, endpoint=endpoint, status="200")
             with contextlib.suppress(ConnectionResetError, ConnectionError):
@@ -219,12 +308,19 @@ class HttpService:
     ) -> web.Response:
         gen = None
         first = True
+        t_first_tok = t_last_tok = None
         async for g, _chunk in pipe.run(req, ctx):
             gen = g
+            t_last_tok = time.perf_counter()
             if first:
                 first = False
+                t_first_tok = t_last_tok
                 self.m_ttft.observe(time.perf_counter() - t0, model=model)
         assert gen is not None
         self.m_output_tokens.inc(gen.completion_tokens, model=model)
+        if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
+            self.m_itl.observe(
+                (t_last_tok - t_first_tok) / (gen.completion_tokens - 1), model=model
+            )
         self.m_requests.inc(model=model, endpoint=endpoint, status="200")
         return web.json_response(gen.final_response())

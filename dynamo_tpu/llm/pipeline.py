@@ -18,6 +18,7 @@ from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
 from dynamo_tpu.llm.backend import Backend
 from dynamo_tpu.llm.migration import Migration
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
 from dynamo_tpu.llm.protocols import (
     ChatCompletionRequest,
@@ -27,6 +28,8 @@ from dynamo_tpu.llm.protocols import (
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+log = get_logger("pipeline")
 
 
 @dataclass
@@ -64,6 +67,8 @@ class ModelPipeline:
         self.kv_router: KvPushRouter | None = None
         self.backend: Backend | None = None
         self.discovery = None
+        self._embed_router = None
+        self._admin_router = None
 
     async def start(self) -> "ModelPipeline":
         ep = (
@@ -75,7 +80,9 @@ class ModelPipeline:
             push = await ep.router(RouterMode.DIRECT)
             kv_cfg = self.settings.kv or KvRouterConfig()
             kv_cfg.block_size = self.card.kv_cache_block_size
-            self.kv_router = await KvPushRouter(push, kv_cfg).start()
+            self.kv_router = await KvPushRouter(
+                push, kv_cfg, event_sink=self._make_hit_rate_sink()
+            ).start()
             engine = self.kv_router
         else:
             push = await ep.router(self.settings.mode)
@@ -84,6 +91,67 @@ class ModelPipeline:
         migration = Migration(engine, migration_limit=self.card.migration_limit)
         self.backend = Backend(migration, self.preprocessor.tokenizer)
         return self
+
+    def _make_hit_rate_sink(self):
+        """Routing-quality series on the frontend's own registry
+        (reference: components/metrics/src/main.rs:20-35 aggregates these;
+        deploy/metrics/dashboard.json charts them)."""
+        metrics = getattr(self.runtime, "metrics", None)
+        if metrics is None:
+            return None
+        scope = metrics.child("router")
+        decisions = scope.counter("router_decisions_total", "KV routing decisions")
+        isl = scope.counter("router_isl_blocks_total", "Prompt blocks routed")
+        overlap = scope.counter("router_overlap_blocks_total", "Prefix blocks already on the chosen worker")
+        hist = scope.histogram("router_hit_rate", "Per-request prefix hit rate")
+
+        def sink(ev) -> None:
+            model = self.card.name
+            decisions.inc(model=model, worker=f"{ev.worker_id:x}")
+            isl.inc(ev.isl_blocks, model=model)
+            overlap.inc(ev.overlap_blocks, model=model)
+            hist.observe(ev.hit_rate, model=model)
+
+        return sink
+
+    async def _aux_router(self, endpoint: str, mode: RouterMode):
+        ep = (
+            self.runtime.namespace(self.namespace)
+            .component(self.card.component)
+            .endpoint(endpoint)
+        )
+        return await ep.router(mode)
+
+    async def embed(self, token_ids: list[int]) -> list[float]:
+        """Route one embedding request to a worker's ``embed`` endpoint
+        (reference: /v1/embeddings, http/service/openai.rs:302)."""
+        if self._embed_router is None:
+            self._embed_router = await self._aux_router("embed", RouterMode.ROUND_ROBIN)
+        out = None
+        async for item in self._embed_router.generate(
+            {"token_ids": [int(t) for t in token_ids]}, Context()
+        ):
+            out = item
+        if not out or "embedding" not in out:
+            raise RuntimeError((out or {}).get("error", "embedding failed"))
+        return out["embedding"]
+
+    async def clear_kv_blocks(self) -> dict[str, int]:
+        """Admin: clear idle KV on every worker (reference:
+        http/service/clear_kv_blocks.rs). → {instance_hex: blocks}."""
+        if self._admin_router is None:
+            self._admin_router = await self._aux_router("clear_kv", RouterMode.DIRECT)
+        results: dict[str, int] = {}
+        for inst in list(self._admin_router.discovery.available()):
+            try:
+                async for item in self._admin_router.generate(
+                    {}, Context(), instance_id=inst.instance_id
+                ):
+                    results[f"{inst.instance_id:x}"] = int(item.get("cleared", 0))
+            except Exception as e:  # noqa: BLE001 — report partial results
+                results[f"{inst.instance_id:x}"] = -1
+                log.warning("clear_kv on %x failed: %s", inst.instance_id, e)
+        return results
 
     async def close(self) -> None:
         if self.kv_router is not None:
@@ -104,14 +172,19 @@ class ModelPipeline:
             pre = self.preprocessor.preprocess_chat(req)
         else:
             pre = self.preprocessor.preprocess_completion(req)
-        gen = DeltaGenerator(self.card.name, kind=kind, prompt_tokens=len(pre.token_ids))
+        gen = DeltaGenerator(
+            self.card.name, kind=kind, prompt_tokens=len(pre.token_ids),
+            want_logprobs=pre.sampling.logprobs,
+            token_text_fn=lambda tid: self.preprocessor.tokenizer.decode([tid]),
+        )
         assert self.backend is not None, "pipeline not started"
         async for raw in self.backend.generate(pre.to_dict(), context):
             out = LLMEngineOutput.from_dict(raw)
             if out.finish_reason == FinishReason.ERROR:
                 raise RuntimeError(out.error or "engine error")
             finish = out.finish_reason.value if out.finish_reason else None
-            chunks = gen.on_delta(out.text, len(out.token_ids), finish)
+            chunks = gen.on_delta(out.text, len(out.token_ids), finish,
+                                  token_ids=out.token_ids, logprobs=out.log_probs)
             if not chunks:
                 yield gen, None
             for c in chunks:

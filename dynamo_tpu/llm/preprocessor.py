@@ -101,6 +101,7 @@ class OpenAIPreprocessor:
             seed=req.seed,
             frequency_penalty=getattr(req, "frequency_penalty", None) or 0.0,
             presence_penalty=getattr(req, "presence_penalty", None) or 0.0,
+            logprobs=bool(getattr(req, "logprobs", False)),
         )
         # Budget: explicit max_tokens, else whatever fits in context.
         budget = self.card.context_length - len(token_ids)
@@ -152,6 +153,8 @@ class DeltaGenerator:
         kind: str = "chat",
         request_id: str | None = None,
         prompt_tokens: int = 0,
+        want_logprobs: bool = False,
+        token_text_fn=None,  # tid -> str, for logprob token labels
     ):
         assert kind in ("chat", "completion")
         self.kind = kind
@@ -163,22 +166,61 @@ class DeltaGenerator:
         self.text_parts: list[str] = []
         self.finish_reason: str | None = None
         self._first = True
+        self.want_logprobs = want_logprobs
+        self._token_text = token_text_fn or (lambda tid: "")
+        # Accumulated (token_id, logprob) for the final response.
+        self.lp_tokens: list[int] = []
+        self.lp_values: list[float] = []
+
+    def _lp_delta(self, token_ids, logprobs) -> dict | None:
+        """OpenAI logprobs payload for this delta (chosen token only; we
+        do not rank alternatives — top_logprobs stays empty)."""
+        if not (self.want_logprobs and token_ids and logprobs):
+            return None
+        n = min(len(token_ids), len(logprobs))
+        self.lp_tokens += list(token_ids[:n])
+        self.lp_values += [float(x) for x in logprobs[:n]]
+        if self.kind == "chat":
+            content = [
+                {"token": self._token_text(t), "logprob": float(lp),
+                 "bytes": list(self._token_text(t).encode()), "top_logprobs": []}
+                for t, lp in zip(token_ids[:n], logprobs[:n])
+            ]
+            return {"content": content}
+        toks = [self._token_text(t) for t in token_ids[:n]]
+        return {"tokens": toks, "token_logprobs": [float(x) for x in logprobs[:n]],
+                "top_logprobs": None, "text_offset": []}
+
+    def final_logprobs(self) -> dict | None:
+        if not self.want_logprobs or not self.lp_tokens:
+            return None
+        if self.kind == "chat":
+            return {"content": [
+                {"token": self._token_text(t), "logprob": lp,
+                 "bytes": list(self._token_text(t).encode()), "top_logprobs": []}
+                for t, lp in zip(self.lp_tokens, self.lp_values)
+            ]}
+        return {"tokens": [self._token_text(t) for t in self.lp_tokens],
+                "token_logprobs": self.lp_values, "top_logprobs": None,
+                "text_offset": []}
 
     def usage(self) -> dict[str, int]:
         return usage_dict(self.prompt_tokens, self.completion_tokens)
 
-    def on_delta(self, text: str | None, n_tokens: int, finish_reason: str | None) -> list[dict]:
+    def on_delta(self, text: str | None, n_tokens: int, finish_reason: str | None,
+                 token_ids=None, logprobs=None) -> list[dict]:
         """→ list of SSE chunk payload dicts for this engine delta."""
         self.completion_tokens += n_tokens
         chunks: list[dict] = []
         if text:
             self.text_parts.append(text)
+        lp = self._lp_delta(token_ids, logprobs)
         if self.kind == "chat":
             if self._first:
                 self._first = False
                 chunks.append(chat_chunk(self.id, self.model, self.created, role="assistant", content=""))
             if text:
-                chunks.append(chat_chunk(self.id, self.model, self.created, content=text))
+                chunks.append(chat_chunk(self.id, self.model, self.created, content=text, logprobs=lp))
             if finish_reason:
                 self.finish_reason = finish_reason
                 chunks.append(
@@ -189,7 +231,7 @@ class DeltaGenerator:
                 )
         else:
             if text:
-                chunks.append(completion_chunk(self.id, self.model, self.created, text=text))
+                chunks.append(completion_chunk(self.id, self.model, self.created, text=text, logprobs=lp))
             if finish_reason:
                 self.finish_reason = finish_reason
                 chunks.append(
@@ -206,6 +248,9 @@ class DeltaGenerator:
 
         text = "".join(self.text_parts)
         finish = self.finish_reason or "stop"
+        lp = self.final_logprobs()
         if self.kind == "chat":
-            return chat_completion(self.id, self.model, self.created, text, finish, self.usage())
-        return completion_response(self.id, self.model, self.created, text, finish, self.usage())
+            return chat_completion(self.id, self.model, self.created, text, finish,
+                                   self.usage(), logprobs=lp)
+        return completion_response(self.id, self.model, self.created, text, finish,
+                                   self.usage(), logprobs=lp)

@@ -281,6 +281,7 @@ class ChatCompletionRequest:
     model: str
     messages: list[ChatMessage]
     stream: bool = False
+    logprobs: bool = False            # chosen-token logprobs per delta
     max_tokens: int | None = None
     temperature: float | None = None
     top_p: float | None = None
@@ -316,6 +317,7 @@ class ChatCompletionRequest:
             model=model,
             messages=[ChatMessage.parse(m) for m in msgs],
             stream=bool(d.get("stream", False)),
+            logprobs=bool(d.get("logprobs", False)),
             max_tokens=max_tokens,
             temperature=_opt_float(d, "temperature", 0.0, 2.0),
             top_p=_opt_float(d, "top_p", 0.0, 1.0),
@@ -338,6 +340,7 @@ class CompletionRequest:
     model: str
     prompt: str | list[int]
     stream: bool = False
+    logprobs: int | None = None       # OpenAI completions: top-N (we serve N=0/1: chosen token)
     max_tokens: int | None = None
     temperature: float | None = None
     top_p: float | None = None
@@ -370,6 +373,7 @@ class CompletionRequest:
             model=model,
             prompt=prompt,
             stream=bool(d.get("stream", False)),
+            logprobs=d.get("logprobs"),
             max_tokens=max_tokens,
             temperature=_opt_float(d, "temperature", 0.0, 2.0),
             top_p=_opt_float(d, "top_p", 0.0, 1.0),
@@ -405,6 +409,7 @@ def chat_chunk(
     role: str | None = None,
     finish_reason: str | None = None,
     usage: dict[str, int] | None = None,
+    logprobs: dict | None = None,
 ) -> dict[str, Any]:
     """One `chat.completion.chunk` SSE payload."""
     delta: dict[str, Any] = {}
@@ -412,12 +417,15 @@ def chat_chunk(
         delta["role"] = role
     if content is not None:
         delta["content"] = content
+    choice: dict[str, Any] = {"index": 0, "delta": delta, "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     body: dict[str, Any] = {
         "id": request_id,
         "object": "chat.completion.chunk",
         "created": created,
         "model": model,
-        "choices": [{"index": 0, "delta": delta, "finish_reason": finish_reason}],
+        "choices": [choice],
     }
     if usage is not None:
         body["usage"] = usage
@@ -431,19 +439,21 @@ def chat_completion(
     content: str,
     finish_reason: str,
     usage: dict[str, int],
+    logprobs: dict | None = None,
 ) -> dict[str, Any]:
+    choice: dict[str, Any] = {
+        "index": 0,
+        "message": {"role": "assistant", "content": content},
+        "finish_reason": finish_reason,
+    }
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
     return {
         "id": request_id,
         "object": "chat.completion",
         "created": created,
         "model": model,
-        "choices": [
-            {
-                "index": 0,
-                "message": {"role": "assistant", "content": content},
-                "finish_reason": finish_reason,
-            }
-        ],
+        "choices": [choice],
         "usage": usage,
     }
 
@@ -456,13 +466,15 @@ def completion_chunk(
     text: str = "",
     finish_reason: str | None = None,
     usage: dict[str, int] | None = None,
+    logprobs: dict | None = None,
 ) -> dict[str, Any]:
     body: dict[str, Any] = {
         "id": request_id,
         "object": "text_completion",
         "created": created,
         "model": model,
-        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason, "logprobs": None}],
+        "choices": [{"index": 0, "text": text, "finish_reason": finish_reason,
+                     "logprobs": logprobs}],
     }
     if usage is not None:
         body["usage"] = usage
@@ -476,8 +488,12 @@ def completion_response(
     text: str,
     finish_reason: str,
     usage: dict[str, int],
+    logprobs: dict | None = None,
 ) -> dict[str, Any]:
-    body = completion_chunk(request_id, model, created, text=text, finish_reason=finish_reason)
+    body = completion_chunk(
+        request_id, model, created, text=text, finish_reason=finish_reason,
+        logprobs=logprobs,
+    )
     body["usage"] = usage
     return body
 

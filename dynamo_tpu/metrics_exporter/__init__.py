@@ -1,0 +1,3 @@
+from dynamo_tpu.metrics_exporter.__main__ import MetricsExporter
+
+__all__ = ["MetricsExporter"]

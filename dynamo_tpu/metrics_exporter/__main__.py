@@ -1,0 +1,156 @@
+"""Metrics exporter: `python -m dynamo_tpu.metrics_exporter`.
+
+Fleet-level observability component (reference: components/metrics/src/
+main.rs:20-35 — a Prometheus exporter that scrapes every worker's
+load_metrics and aggregates KV-hit-rate events). Here it polls each
+discovered worker's ``load_metrics`` endpoint over the runtime's request
+plane and serves per-worker + aggregate gauges on its own /metrics port;
+router-side hit-rate series live on the frontend's /metrics
+(llm/pipeline.py), and deploy/metrics/dashboard.json charts both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import signal
+
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.kv_router.publisher import LOAD_METRICS_ENDPOINT
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.push_router import RouterMode
+
+log = get_logger("metrics_exporter")
+
+
+class MetricsExporter:
+    """Polls worker load metrics into a registry; caller serves it."""
+
+    def __init__(self, runtime, namespace: str, component: str,
+                 registry: MetricsRegistry | None = None,
+                 interval_s: float = 5.0):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.registry = registry or runtime.metrics
+        self.interval_s = interval_s
+        self.g_active = self.registry.gauge("fleet_worker_active_slots", "Active request slots")
+        self.g_total = self.registry.gauge("fleet_worker_total_slots", "Total request slots")
+        self.g_waiting = self.registry.gauge("fleet_worker_waiting", "Queued requests")
+        self.g_kv_active = self.registry.gauge("fleet_worker_kv_active_blocks", "Active KV blocks")
+        self.g_kv_total = self.registry.gauge("fleet_worker_kv_total_blocks", "Total KV blocks")
+        self.g_usage = self.registry.gauge("fleet_worker_kv_usage", "KV cache usage fraction")
+        self.g_hit = self.registry.gauge("fleet_worker_prefix_hit_rate", "Worker-reported prefix hit rate")
+        self.g_workers = self.registry.gauge("fleet_workers_live", "Discovered workers")
+        self._router = None
+        self._task: asyncio.Task | None = None
+        self.polls = 0
+
+    async def start(self) -> "MetricsExporter":
+        ep = (
+            self.runtime.namespace(self.namespace)
+            .component(self.component)
+            .endpoint(LOAD_METRICS_ENDPOINT)
+        )
+        self._router = await ep.router(RouterMode.DIRECT)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+
+    async def poll_once(self) -> int:
+        """Scrape every live worker once. → number scraped."""
+        instances = list(self._router.discovery.available())
+        self.g_workers.set(len(instances), component=self.component)
+        n = 0
+        for inst in instances:
+            wid = f"{inst.instance_id:x}"
+            try:
+                snap = None
+                async for item in self._router.generate(
+                    {}, Context(), instance_id=inst.instance_id
+                ):
+                    snap = item
+                if snap is None:
+                    continue
+                m = ForwardPassMetrics.from_dict(snap)
+            except Exception as e:  # noqa: BLE001 — a dead worker must not kill the loop
+                log.warning("scrape of worker %s failed: %s", wid, e)
+                continue
+            lbl = {"component": self.component, "worker": wid}
+            self.g_active.set(m.worker.request_active_slots, **lbl)
+            self.g_total.set(m.worker.request_total_slots, **lbl)
+            self.g_waiting.set(m.worker.num_requests_waiting, **lbl)
+            self.g_kv_active.set(m.kv.kv_active_blocks, **lbl)
+            self.g_kv_total.set(m.kv.kv_total_blocks, **lbl)
+            self.g_usage.set(m.kv.gpu_cache_usage_perc, **lbl)
+            self.g_hit.set(m.kv.gpu_prefix_cache_hit_rate, **lbl)
+            n += 1
+        self.polls += 1
+        return n
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:  # noqa: BLE001
+                log.exception("fleet poll failed")
+            await asyncio.sleep(self.interval_s)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="dynamo_tpu.metrics_exporter")
+    p.add_argument("--store-url", default=None)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--interval", type=float, default=5.0)
+    return p.parse_args(argv)
+
+
+async def async_main(args) -> None:
+    from aiohttp import web
+
+    rt = await DistributedRuntime.create(store_url=args.store_url)
+    exporter = await MetricsExporter(
+        rt, args.namespace, args.component, interval_s=args.interval
+    ).start()
+
+    async def handle_metrics(request):
+        return web.Response(text=rt.metrics.render(), content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", handle_metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, args.host, args.port)
+    await site.start()
+    print(f"dynamo_tpu metrics exporter: http://{args.host}:{args.port}/metrics", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError):
+            loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await exporter.stop()
+    await runner.cleanup()
+    await rt.shutdown()
+
+
+def main(argv=None) -> int:
+    asyncio.run(async_main(parse_args(argv)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

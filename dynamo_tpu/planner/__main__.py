@@ -34,21 +34,39 @@ def parse_args(argv=None):
     p.add_argument("--itl-sla-ms", type=float, default=None)
     p.add_argument("--ttft-sla-ms", type=float, default=None)
     p.add_argument("--profile", default=None, help="npz from tools/profile_sweep.py")
+    # Disaggregated deployments scale prefill separately (reference:
+    # planner_core.py:241-276).
+    p.add_argument("--prefill-component", default=None)
+    p.add_argument("--mean-input-tokens", type=float, default=512.0)
+    p.add_argument("--prefill-tok-s", type=float, default=8000.0)
+    p.add_argument("--connector", choices=["local", "kubernetes"], default="local")
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-deployment", action="append", default=[],
+                   help="component=deployment mapping, repeatable "
+                        "(default: component name = deployment name)")
     p.add_argument("worker_args", nargs=argparse.REMAINDER,
-                   help="-- followed by the worker argv (after the interpreter)")
+                   help="-- followed by the worker argv (after the interpreter; local connector)")
     return p.parse_args(argv)
 
 
 async def async_main(args) -> None:
-    worker_argv = args.worker_args
-    if worker_argv and worker_argv[0] == "--":
-        worker_argv = worker_argv[1:]
-    if not worker_argv:
-        raise SystemExit("missing worker argv after --")
     decode_interp = prefill_interp = None
     if args.profile:
         decode_interp, prefill_interp = load_profile(args.profile)
-    connector = LocalProcessConnector({args.component: worker_argv})
+    if args.connector == "kubernetes":
+        from dynamo_tpu.planner.connector import KubernetesConnector
+
+        mapping = dict(kv.split("=", 1) for kv in args.k8s_deployment)
+        connector = KubernetesConnector(
+            namespace=args.k8s_namespace, deployment_of=mapping
+        )
+    else:
+        worker_argv = args.worker_args
+        if worker_argv and worker_argv[0] == "--":
+            worker_argv = worker_argv[1:]
+        if not worker_argv:
+            raise SystemExit("missing worker argv after --")
+        connector = LocalProcessConnector({args.component: worker_argv})
     planner = Planner(
         PlannerConfig(
             component=args.component,
@@ -60,13 +78,17 @@ async def async_main(args) -> None:
             mean_output_tokens=args.mean_output_tokens,
             itl_sla_ms=args.itl_sla_ms,
             ttft_sla_ms=args.ttft_sla_ms,
+            prefill_component=args.prefill_component,
+            mean_input_tokens=args.mean_input_tokens,
+            prefill_tok_s=args.prefill_tok_s,
         ),
         connector,
         HttpMetricsSource(args.metrics_url),
         decode_interp=decode_interp,
         prefill_interp=prefill_interp,
     )
-    connector.set_replicas(args.component, args.min_replicas)
+    if args.connector == "local":
+        connector.set_replicas(args.component, args.min_replicas)
     await planner.start()
     print(f"dynamo_tpu planner: watching {args.metrics_url}, scaling {args.component}", flush=True)
 
@@ -77,7 +99,8 @@ async def async_main(args) -> None:
             loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await planner.stop()
-    connector.shutdown()
+    if hasattr(connector, "shutdown"):
+        connector.shutdown()
 
 
 def main(argv=None) -> int:

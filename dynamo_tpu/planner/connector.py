@@ -10,6 +10,8 @@ tests/dry-runs. A K8s connector belongs with the deploy layer.
 
 from __future__ import annotations
 
+import json
+import os
 import signal
 import subprocess
 import sys
@@ -78,3 +80,71 @@ class LocalProcessConnector:
                     p.wait(5)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+class KubernetesConnector:
+    """Patches Deployment replica counts through the Kubernetes API
+    (reference: components/planner/src/dynamo/planner/kubernetes_connector.py
+    + kube.py — there it patches the DynamoGraphDeployment CRD and the
+    operator reconciles; here the deploy skeleton ships plain Deployments
+    (deploy/k8s/), so the planner scales them directly).
+
+    Talks to the API server over HTTPS with the in-cluster service
+    account (no kubernetes client dependency — two REST calls). The
+    ``deployment_of`` map routes planner components to Deployment names,
+    e.g. {"backend": "dynamo-tpu-worker", "prefill": "dynamo-tpu-prefill"}.
+    """
+
+    def __init__(self, namespace: str = "default",
+                 deployment_of: dict[str, str] | None = None,
+                 api_base: str | None = None, token: str | None = None,
+                 verify: bool | str = True):
+        self.namespace = namespace
+        self.deployment_of = deployment_of or {}
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            api_base = f"https://{host}:{port}"
+        self.api_base = api_base
+        sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+        if token is None and os.path.exists(f"{sa}/token"):
+            with open(f"{sa}/token") as f:
+                token = f.read().strip()
+        self.token = token
+        if verify is True and os.path.exists(f"{sa}/ca.crt"):
+            verify = f"{sa}/ca.crt"
+        self.verify = verify
+
+    def _url(self, component: str, scale: bool) -> str:
+        name = self.deployment_of.get(component, component)
+        suffix = "/scale" if scale else ""
+        return (f"{self.api_base}/apis/apps/v1/namespaces/{self.namespace}"
+                f"/deployments/{name}{suffix}")
+
+    def _headers(self, patch: bool = False) -> dict:
+        h = {"Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        if patch:
+            h["Content-Type"] = "application/merge-patch+json"
+        return h
+
+    def get_replicas(self, component: str) -> int:
+        import httpx
+
+        r = httpx.get(self._url(component, scale=True),
+                      headers=self._headers(), verify=self.verify, timeout=10)
+        r.raise_for_status()
+        return int(r.json().get("spec", {}).get("replicas", 0))
+
+    def set_replicas(self, component: str, n: int) -> None:
+        import httpx
+
+        r = httpx.patch(
+            self._url(component, scale=True),
+            headers=self._headers(patch=True),
+            content=json.dumps({"spec": {"replicas": int(n)}}),
+            verify=self.verify, timeout=10,
+        )
+        r.raise_for_status()
+        log.info("k8s: scaled %s to %d", component, n)

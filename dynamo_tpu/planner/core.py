@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import math
+import time
 from dataclasses import dataclass, field
 
 from dynamo_tpu.planner.connector import Connector
@@ -41,6 +42,12 @@ class PlannerObservation:
 @dataclass
 class PlannerConfig:
     component: str = "backend"
+    # Disaggregated deployments scale prefill separately (reference:
+    # planner_core.py:241-276 computes prefill and decode replica counts
+    # from distinct interpolators). None = aggregated, single component.
+    prefill_component: str | None = None
+    mean_input_tokens: float = 512.0   # converts request rate → prefill token rate
+    prefill_tok_s: float = 8000.0      # per-replica prefill throughput fallback
     adjustment_interval_s: float = 30.0
     predictor: str = "ar"
     min_replicas: int = 1
@@ -103,7 +110,10 @@ class Planner:
         # for the live workload — scale the need up proportionally.
         if self.cfg.itl_sla_ms and obs.itl_ms and obs.itl_ms > self.cfg.itl_sla_ms:
             need = math.ceil(need * obs.itl_ms / self.cfg.itl_sla_ms)
-        if self.cfg.ttft_sla_ms and obs.ttft_ms and obs.ttft_ms > self.cfg.ttft_sla_ms:
+        if (
+            self.cfg.ttft_sla_ms and obs.ttft_ms and obs.ttft_ms > self.cfg.ttft_sla_ms
+            and not self.cfg.prefill_component  # disagg: TTFT scales prefill instead
+        ):
             need = max(need, self.connector.get_replicas(self.cfg.component) + 1)
 
         current = self.connector.get_replicas(self.cfg.component)
@@ -114,18 +124,51 @@ class Planner:
                 need = current
         return max(self.cfg.min_replicas, min(self.cfg.max_replicas, need))
 
-    async def step(self) -> int:
-        obs = await self.metrics_source()
-        target = self.target_replicas(obs)
-        current = self.connector.get_replicas(self.cfg.component)
+    def target_prefill_replicas(self, obs: PlannerObservation) -> int:
+        """Prefill fleet sizing from the PREDICTED input-token rate and
+        the profiled prefill throughput, TTFT-corrected (reference:
+        planner_core.py:241-251). Uses the prediction made by
+        target_replicas this step (call order matters)."""
+        input_rate = self.state.last_prediction * self.cfg.mean_input_tokens
+        cap = self.cfg.prefill_tok_s
+        if self.prefill_interp is not None:
+            t = self.prefill_interp.throughput_at(self.cfg.mean_input_tokens)
+            if t > 0:
+                cap = t
+        need = math.ceil(input_rate / cap) if cap > 0 else self.cfg.max_replicas
+        # TTFT over SLA: prefill capacity is the TTFT lever in a disagg
+        # deployment — scale prefill, not decode, on TTFT breach.
+        if self.cfg.ttft_sla_ms and obs.ttft_ms and obs.ttft_ms > self.cfg.ttft_sla_ms:
+            need = math.ceil(need * obs.ttft_ms / self.cfg.ttft_sla_ms)
+        current = self.connector.get_replicas(self.cfg.prefill_component)
+        if need < current and input_rate * self.cfg.scale_down_headroom > (current - 1) * cap:
+            need = current
+        return max(self.cfg.min_replicas, min(self.cfg.max_replicas, need))
+
+    def _apply(self, component: str, target: int, obs: PlannerObservation) -> None:
+        current = self.connector.get_replicas(component)
         if target != current:
             log.info(
-                "scaling %s: %d → %d (rate=%.2f req/s pred=%.2f itl=%s ms)",
-                self.cfg.component, current, target,
-                obs.request_rate, self.state.last_prediction, obs.itl_ms,
+                "scaling %s: %d → %d (rate=%.2f req/s pred=%.2f ttft=%s itl=%s ms)",
+                component, current, target,
+                obs.request_rate, self.state.last_prediction, obs.ttft_ms, obs.itl_ms,
             )
-            self.connector.set_replicas(self.cfg.component, target)
-            self.state.adjustments.append((asyncio.get_event_loop().time(), target))
+            self.connector.set_replicas(component, target)
+            self.state.adjustments.append((time.monotonic(), target))
+
+    def _step_sync(self, obs: PlannerObservation) -> int:
+        """Target computation + connector calls. Runs in a worker thread:
+        connectors may block on I/O (the Kubernetes one does HTTPS
+        round-trips), which must not stall the planner's event loop."""
+        target = self.target_replicas(obs)
+        self._apply(self.cfg.component, target, obs)
+        if self.cfg.prefill_component:
+            self._apply(self.cfg.prefill_component, self.target_prefill_replicas(obs), obs)
+        return target
+
+    async def step(self) -> int:
+        obs = await self.metrics_source()
+        target = await asyncio.to_thread(self._step_sync, obs)
         self.state.replicas = target
         return target
 
@@ -205,5 +248,8 @@ class HttpMetricsSource:
             dttft_n = delta(p + "time_to_first_token_seconds_count")
             if dttft_n > 0:
                 obs.ttft_ms = delta(p + "time_to_first_token_seconds_sum") / dttft_n * 1000
+            ditl_n = delta(p + "inter_token_latency_seconds_count")
+            if ditl_n > 0:
+                obs.itl_ms = delta(p + "inter_token_latency_seconds_sum") / ditl_n * 1000
         self._last, self._last_t = cur, now
         return obs

@@ -49,6 +49,9 @@ def parse_args(argv=None):
     p.add_argument("--prefill-component", default="prefill")
     p.add_argument("--max-local-prefill-length", type=int, default=512,
                    help="prompts with more uncached tokens than this prefill remotely")
+    p.add_argument("--prefill-dispatch", choices=["queue", "push"], default="queue",
+                   help="queue = competing-consumer work queue (reference behaviour); "
+                        "push = round-robin RPC to a prefill worker")
     # engine shape knobs
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-kv-blocks", type=int, default=2048)
@@ -178,18 +181,30 @@ async def async_main(args) -> None:
     comp = rt.namespace(args.namespace).component(args.component)
 
     if args.is_prefill_worker:
-        from dynamo_tpu.llm.disagg import PrefillHandler
+        from dynamo_tpu.llm.disagg import DisaggConfig, PrefillHandler, PrefillPuller
+        from dynamo_tpu.runtime.queue import WorkQueue
 
-        handler = PrefillHandler(engine)
-        await comp.endpoint(args.endpoint).serve(handler.generate)
+        dcfg = DisaggConfig()
+        handler = PrefillHandler(engine, frame_bytes=dcfg.frame_bytes)
+        gen_handle = await comp.endpoint(args.endpoint).serve(handler.generate)
         await comp.endpoint("kv_fetch").serve(handler.kv_fetch)
         await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        # Pull queued prefill jobs too (competing consumer across the
+        # prefill fleet) — push and queue dispatch both work.
+        PrefillPuller(
+            engine,
+            WorkQueue(rt.store, dcfg.queue_name),
+            rt.store,
+            gen_handle.instance.instance_id,
+        ).start()
         # No model card: the frontend must route only to decode workers.
         role = "prefill worker"
     else:
         if args.remote_prefill:
             from dynamo_tpu.llm.disagg import DisaggConfig, DisaggDecodeHandler
             from dynamo_tpu.runtime.push_router import RouterMode
+
+            from dynamo_tpu.runtime.queue import WorkQueue
 
             pcomp = rt.namespace(args.namespace).component(args.prefill_component)
             cfg = DisaggConfig(
@@ -201,6 +216,11 @@ async def async_main(args) -> None:
                 await pcomp.endpoint(cfg.prefill_endpoint).router(RouterMode.ROUND_ROBIN),
                 await pcomp.endpoint(cfg.fetch_endpoint).router(RouterMode.DIRECT),
                 cfg,
+                queue=(
+                    None if args.prefill_dispatch == "push"
+                    else WorkQueue(rt.store, cfg.queue_name)
+                ),
+                store=rt.store,
             )
         else:
             handler = engine
@@ -211,6 +231,20 @@ async def async_main(args) -> None:
 
         await comp.endpoint(args.endpoint).serve(gen_handler)
         await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        if hasattr(engine, "embed"):
+            async def embed_handler(payload, ctx):
+                try:
+                    vec = await engine.embed((payload or {}).get("token_ids") or [])
+                    yield {"embedding": vec}
+                except Exception as e:  # noqa: BLE001 — per-request failure
+                    yield {"error": str(e)}
+
+            await comp.endpoint("embed").serve(embed_handler)
+        if hasattr(engine, "clear_kv_blocks"):
+            async def clear_handler(payload, ctx):
+                yield {"cleared": engine.clear_kv_blocks()}
+
+            await comp.endpoint("clear_kv").serve(clear_handler)
         await register_model(rt, args.namespace, card)
         role = "worker"
     print(f"dynamo_tpu {role}: serving {card.name} as {args.namespace}/{args.component}/{args.endpoint}", flush=True)

@@ -318,3 +318,132 @@ def test_work_queue_competing_consumers():
         return True
 
     assert asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Chunked KV streaming + queue-fed dispatch (VERDICT r3 next #3)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_payload_frame_roundtrip_large():
+    """>256MiB-equivalent geometry (framing.py caps frames at 256MiB, so
+    the old single-frame path would hard-fail): chunked frames must
+    round-trip exactly and each stay under the chunk limit."""
+    rng = np.random.default_rng(9)
+    # 512 MiB per array (1 GiB total): 8 layers x 64 blocks x 128 tokens
+    # x 2048 lane-dim, f32
+    shape = (8, 64, 128, 2048)
+    k = rng.standard_normal(shape).astype(np.float32)
+    v = rng.standard_normal(shape).astype(np.float32)
+    payload = kv_transfer.KvPagePayload(k=k, v=v, num_tokens=64 * 128)
+    assert k.nbytes + v.nbytes > (256 << 20)
+
+    frames = list(payload.to_frames(max_bytes=64 << 20))
+    assert frames[0]["kind"] == "kv_header"
+    data_frames = frames[1:]
+    assert all(len(f["data"]) <= (64 << 20) for f in data_frames)
+    assert len(data_frames) == 16  # 8 k-chunks + 8 v-chunks
+
+    back = kv_transfer.KvPagePayload.from_frames(frames)
+    np.testing.assert_array_equal(back.k, k)
+    np.testing.assert_array_equal(back.v, v)
+    assert back.num_tokens == payload.num_tokens
+
+
+def test_kv_payload_frame_truncation_detected():
+    rng = np.random.default_rng(10)
+    payload = kv_transfer.KvPagePayload(
+        k=rng.standard_normal((2, 3, 4, 8)).astype(np.float32),
+        v=rng.standard_normal((2, 3, 4, 8)).astype(np.float32),
+        num_tokens=12,
+    )
+    frames = list(payload.to_frames(max_bytes=64))
+    with pytest.raises(ValueError, match="truncated"):
+        kv_transfer.KvPagePayload.from_frames(frames[:-1])
+
+
+def test_disagg_queue_dispatch_matches_aggregated():
+    """Queue-fed disagg: decode enqueues, a PrefillPuller consumes, pages
+    stream back in multiple small frames — token parity with aggregated."""
+
+    async def go():
+        from dynamo_tpu.llm.disagg import PrefillPuller
+        from dynamo_tpu.runtime.queue import WorkQueue
+
+        url = "memory://disagg3"
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(1, CFG.vocab_size - 1, size=30).tolist()
+        N = 10
+
+        agg = await TpuEngine(make_args(), seed=0).start()
+        ref, _ = await collect(agg, greedy_request(prompt, N))
+        await agg.stop()
+
+        prt = await DistributedRuntime.create(store_url=url)
+        pengine = await TpuEngine(make_args(), seed=0).start()
+        ph = PrefillHandler(pengine, frame_bytes=256)  # force many frames
+        pcomp = prt.namespace("dg").component("prefill")
+        gen_handle = await pcomp.endpoint("generate").serve(ph.generate)
+        await pcomp.endpoint("kv_fetch").serve(ph.kv_fetch)
+        puller = PrefillPuller(
+            pengine, WorkQueue(prt.store, "prefill"), prt.store,
+            gen_handle.instance.instance_id,
+        ).start()
+
+        drt = await DistributedRuntime.create(store_url=url)
+        dengine = await TpuEngine(make_args(), seed=0).start()
+        pclient = drt.namespace("dg").component("prefill")
+        handler = DisaggDecodeHandler(
+            dengine,
+            await pclient.endpoint("generate").router(RouterMode.ROUND_ROBIN),
+            await pclient.endpoint("kv_fetch").router(RouterMode.DIRECT),
+            DisaggConfig(max_local_prefill_length=8, queue_timeout_s=30),
+            queue=WorkQueue(drt.store, "prefill"),
+            store=drt.store,
+        )
+        got, _ = await collect(handler, greedy_request(prompt, N).to_dict())
+        assert handler.remote_prefills == 1
+        assert puller.jobs_done == 1
+
+        await puller.stop()
+        await pengine.stop()
+        await dengine.stop()
+        await drt.shutdown()
+        await prt.shutdown()
+        return got, ref
+
+    got, ref = asyncio.run(go())
+    assert got == ref
+
+
+def test_disagg_queue_timeout_falls_back_local():
+    """No puller consuming the queue → decode times out and prefills
+    locally (disagg is never a correctness dependency)."""
+
+    async def go():
+        from dynamo_tpu.runtime.queue import WorkQueue
+
+        url = "memory://disagg4"
+        rng = np.random.default_rng(12)
+        prompt = rng.integers(1, CFG.vocab_size - 1, size=26).tolist()
+
+        drt = await DistributedRuntime.create(store_url=url)
+        dengine = await TpuEngine(make_args(), seed=0).start()
+        pcomp = drt.namespace("dg").component("prefill")
+        handler = DisaggDecodeHandler(
+            dengine,
+            await pcomp.endpoint("generate").router(RouterMode.ROUND_ROBIN),
+            await pcomp.endpoint("kv_fetch").router(RouterMode.DIRECT),
+            DisaggConfig(max_local_prefill_length=8, queue_timeout_s=0.5),
+            queue=WorkQueue(drt.store, "prefill"),
+            store=drt.store,
+        )
+        got, final = await collect(handler, greedy_request(prompt, 5).to_dict())
+        fallbacks = handler.local_fallbacks
+        await dengine.stop()
+        await drt.shutdown()
+        return got, final, fallbacks
+
+    got, final, fallbacks = asyncio.run(go())
+    assert len(got) == 5 and final.get("finish_reason") == "length"
+    assert fallbacks == 1

@@ -1,0 +1,65 @@
+"""Fleet metrics exporter + router hit-rate series.
+
+Reference analogue: components/metrics/src/main.rs (worker load scrape)
+and kv_router/scheduler.rs KVHitRateEvent emission.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    KVHitRateEvent,
+    WorkerStats,
+)
+from dynamo_tpu.kv_router.publisher import LOAD_METRICS_ENDPOINT
+from dynamo_tpu.metrics_exporter import MetricsExporter
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+def test_hit_rate_event_math():
+    ev = KVHitRateEvent(worker_id=7, isl_blocks=8, overlap_blocks=6)
+    assert ev.hit_rate == 0.75
+    assert KVHitRateEvent(1, 0, 0).hit_rate == 0.0
+    assert ev.to_dict()["overlap_blocks"] == 6
+
+
+def test_exporter_scrapes_workers():
+    async def go():
+        url = "memory://exporter1"
+        wrt = await DistributedRuntime.create(store_url=url)
+        comp = wrt.namespace("dyn").component("backend")
+
+        def snap():
+            return ForwardPassMetrics(
+                worker=WorkerStats(request_active_slots=3, request_total_slots=8,
+                                   num_requests_waiting=2),
+                kv=KvStats(kv_active_blocks=40, kv_total_blocks=100,
+                           gpu_cache_usage_perc=0.4, gpu_prefix_cache_hit_rate=0.25),
+            )
+
+        async def load_metrics(payload, ctx):
+            yield snap().to_dict()
+
+        await comp.endpoint(LOAD_METRICS_ENDPOINT).serve(load_metrics)
+
+        ert = await DistributedRuntime.create(store_url=url)
+        exporter = MetricsExporter(ert, "dyn", "backend", interval_s=999)
+        ep = ert.namespace("dyn").component("backend").endpoint(LOAD_METRICS_ENDPOINT)
+        from dynamo_tpu.runtime.push_router import RouterMode
+
+        exporter._router = await ep.router(RouterMode.DIRECT)
+        await exporter._router.discovery.wait_for_instances(1, timeout=10)
+        n = await exporter.poll_once()
+        text = ert.metrics.render()
+        await ert.shutdown()
+        await wrt.shutdown()
+        return n, text
+
+    n, text = asyncio.run(go())
+    assert n == 1
+    assert "dynamo_tpu_fleet_worker_kv_usage" in text
+    assert 'dynamo_tpu_fleet_workers_live' in text
+    assert "0.4" in text

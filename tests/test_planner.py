@@ -199,3 +199,122 @@ def test_http_metrics_source_parses_and_rates():
     # Label-split series sum into one value per name.
     text2 = text1 + 'dynamo_tpu_http_requests_total{model="n",status="200"} 5\n'
     assert src._parse(text2)["dynamo_tpu_http_requests_total"] == 15
+
+
+def test_disagg_planner_itl_scales_decode_prefill_holds():
+    """ITL-SLA breach must scale the decode component while prefill holds
+    (reference: planner_core.py:241-276 computes them separately)."""
+
+    async def go():
+        conn = RecordingConnector({"backend": 2, "prefill": 2})
+        obs = iter([
+            PlannerObservation(request_rate=10.0, itl_ms=10.0, ttft_ms=100.0),
+            PlannerObservation(request_rate=10.0, itl_ms=40.0, ttft_ms=100.0),  # ITL breach
+        ])
+
+        async def source():
+            return next(obs)
+
+        cfg = PlannerConfig(
+            component="backend", prefill_component="prefill",
+            predictor="constant", min_replicas=1, max_replicas=8,
+            replica_tok_s=1000.0, mean_output_tokens=100.0,
+            mean_input_tokens=200.0, prefill_tok_s=1000.0,
+            itl_sla_ms=20.0, ttft_sla_ms=500.0, scale_down_headroom=1.0,
+        )
+        planner = Planner(cfg, conn, source)
+        await planner.step()   # healthy: 1000 tok/s → 1; prefill 2000/1000 → 2
+        first = (conn.get_replicas("backend"), conn.get_replicas("prefill"))
+        await planner.step()   # ITL 40 > 20 → decode need x2; prefill unchanged
+        second = (conn.get_replicas("backend"), conn.get_replicas("prefill"))
+        return first, second
+
+    first, second = asyncio.run(go())
+    assert first == (1, 2)
+    assert second[0] == 2, f"decode should scale on ITL breach, got {second}"
+    assert second[1] == 2, f"prefill must hold on ITL breach, got {second}"
+
+
+def test_disagg_planner_ttft_scales_prefill_decode_holds():
+    async def go():
+        conn = RecordingConnector({"backend": 1, "prefill": 1})
+        obs = iter([
+            PlannerObservation(request_rate=5.0, itl_ms=10.0, ttft_ms=100.0),
+            PlannerObservation(request_rate=5.0, itl_ms=10.0, ttft_ms=1500.0),  # TTFT breach
+        ])
+
+        async def source():
+            return next(obs)
+
+        cfg = PlannerConfig(
+            component="backend", prefill_component="prefill",
+            predictor="constant", min_replicas=1, max_replicas=8,
+            replica_tok_s=1000.0, mean_output_tokens=100.0,
+            mean_input_tokens=200.0, prefill_tok_s=1000.0,
+            itl_sla_ms=50.0, ttft_sla_ms=500.0, scale_down_headroom=1.0,
+        )
+        planner = Planner(cfg, conn, source)
+        await planner.step()
+        first = (conn.get_replicas("backend"), conn.get_replicas("prefill"))
+        await planner.step()   # TTFT 1500 > 500 → prefill x3; decode holds
+        second = (conn.get_replicas("backend"), conn.get_replicas("prefill"))
+        return first, second
+
+    first, second = asyncio.run(go())
+    assert first == (1, 1)
+    assert second[0] == 1, f"decode must hold on TTFT breach, got {second}"
+    assert second[1] == 3, f"prefill should scale on TTFT breach, got {second}"
+
+
+def test_http_metrics_source_parses_itl():
+    import time as _time
+
+    src = HttpMetricsSource("http://unused")
+    base = (
+        'dynamo_tpu_http_requests_total{model="m"} 10\n'
+        'dynamo_tpu_http_inter_token_latency_seconds_sum{model="m"} 0.5\n'
+        'dynamo_tpu_http_inter_token_latency_seconds_count{model="m"} 10\n'
+    )
+    later = (
+        'dynamo_tpu_http_requests_total{model="m"} 20\n'
+        'dynamo_tpu_http_inter_token_latency_seconds_sum{model="m"} 1.1\n'
+        'dynamo_tpu_http_inter_token_latency_seconds_count{model="m"} 30\n'
+    )
+    src._last, src._last_t = src._parse(base), _time.monotonic() - 1.0
+    cur = src._parse(later)
+    # Reuse the internal delta logic by calling __call__'s math inline:
+    ditl_n = cur["dynamo_tpu_http_inter_token_latency_seconds_count"] - 10
+    ditl_s = cur["dynamo_tpu_http_inter_token_latency_seconds_sum"] - 0.5
+    assert abs(ditl_s / ditl_n * 1000 - 30.0) < 1e-6  # 0.6s over 20 obs = 30ms
+
+
+def test_kubernetes_connector_scale_calls(monkeypatch):
+    """KubernetesConnector issues GET/PATCH on the scale subresource
+    (reference: planner/kubernetes_connector.py + kube.py)."""
+    import httpx
+
+    from dynamo_tpu.planner.connector import KubernetesConnector
+
+    calls = []
+
+    def fake_get(url, headers=None, verify=None, timeout=None):
+        calls.append(("GET", url))
+        return httpx.Response(200, json={"spec": {"replicas": 3}},
+                              request=httpx.Request("GET", url))
+
+    def fake_patch(url, headers=None, content=None, verify=None, timeout=None):
+        calls.append(("PATCH", url, content))
+        return httpx.Response(200, json={},
+                              request=httpx.Request("PATCH", url))
+
+    monkeypatch.setattr(httpx, "get", fake_get)
+    monkeypatch.setattr(httpx, "patch", fake_patch)
+    conn = KubernetesConnector(
+        namespace="serving", deployment_of={"backend": "dynamo-tpu-worker"},
+        api_base="https://api", token="tok", verify=False,
+    )
+    assert conn.get_replicas("backend") == 3
+    conn.set_replicas("backend", 5)
+    assert calls[0][1].endswith("/namespaces/serving/deployments/dynamo-tpu-worker/scale")
+    method, url, content = calls[1]
+    assert method == "PATCH" and '"replicas": 5' in content

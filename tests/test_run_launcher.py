@@ -1,0 +1,64 @@
+"""dynamo_tpu.run launcher (reference: launch/dynamo-run, opt.rs:7-33).
+
+Drives the one-process chain in batch and http modes on CPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import httpx
+
+
+def test_batch_mode(tmp_path, capsys):
+    from dynamo_tpu.run.__main__ import async_main, parse_args
+
+    inp = tmp_path / "prompts.jsonl"
+    inp.write_text('{"prompt": "hello"}\nplain text line\n')
+    args = parse_args([
+        "--in", f"batch:{inp}", "--engine", "tpu", "--preset", "test-tiny",
+        "--block-size", "4", "--num-kv-blocks", "64", "--max-model-len", "128",
+        "--max-tokens", "5", "--decode-steps", "2", "--dtype", "float32",
+    ])
+    asyncio.run(async_main(args))
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(out_lines) == 2
+    results = [json.loads(l) for l in out_lines]
+    assert results[0]["prompt"] == "hello"
+    assert all(r["completion_tokens"] == 5 for r in results)
+
+
+def test_http_mode_serves_openai():
+    from dynamo_tpu.run.__main__ import build_pipeline, parse_args, LocalManager
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    async def go():
+        args = parse_args([
+            "--in", "http", "--engine", "tpu", "--preset", "test-tiny",
+            "--block-size", "4", "--num-kv-blocks", "64", "--max-model-len", "128",
+            "--decode-steps", "2", "--dtype", "float32", "--port", "0",
+        ])
+        pipe = await build_pipeline(args)
+        http = await HttpService(
+            LocalManager(pipe), MetricsRegistry(), host="127.0.0.1", port=0
+        ).start()
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                base = f"http://127.0.0.1:{http.port}"
+                r = await client.get(f"{base}/v1/models")
+                assert r.json()["data"][0]["id"] == "test-tiny"
+                r = await client.post(f"{base}/v1/chat/completions", json={
+                    "model": "test-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                })
+                body = r.json()
+                assert r.status_code == 200, body
+                assert body["usage"]["completion_tokens"] == 4
+        finally:
+            await http.close()
+            await pipe.engine.stop()
+
+    asyncio.run(go())
