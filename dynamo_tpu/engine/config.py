@@ -29,6 +29,13 @@ class ModelConfig:
     max_position: int = 8192
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
+    # Mixture-of-experts (0 = dense FFN). Experts shard over the ``ep``
+    # mesh axis (parallel/mesh.py) — the reference reaches wide-EP only
+    # through engine flags (trtllm_utils.py:140-143, sglang wide-EP docs);
+    # here it is a first-class model family.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    moe_intermediate_size: int | None = None  # per-expert FFN width (default: intermediate_size)
 
     @property
     def q_size(self) -> int:
@@ -40,10 +47,29 @@ class ModelConfig:
 
     def param_count(self) -> int:
         d, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        if self.num_experts:
+            ie = self.moe_intermediate_size or i
+            ffn = self.num_experts * 3 * d * ie + d * self.num_experts  # experts + router
+        else:
+            ffn = 3 * d * i
         per_layer = (
             d * self.q_size + 2 * d * self.kv_size + self.q_size * d  # attn
-            + 3 * d * i                                               # mlp
+            + ffn
             + 2 * d                                                   # norms
+        )
+        head = 0 if self.tie_embeddings else d * v
+        return v * d + self.num_layers * per_layer + d + head
+
+    def active_param_count(self) -> int:
+        """Params touched per token (MoE: only top-k experts active)."""
+        if not self.num_experts:
+            return self.param_count()
+        d, v = self.hidden_size, self.vocab_size
+        ie = self.moe_intermediate_size or self.intermediate_size
+        per_layer = (
+            d * self.q_size + 2 * d * self.kv_size + self.q_size * d
+            + self.num_experts_per_token * 3 * d * ie + d * self.num_experts
+            + 2 * d
         )
         head = 0 if self.tie_embeddings else d * v
         return v * d + self.num_layers * per_layer + d + head
@@ -73,6 +99,21 @@ class ModelConfig:
                 intermediate_size=14336, num_layers=32, num_heads=32,
                 num_kv_heads=8, head_dim=128, rope_theta=500000.0,
                 max_position=131072, tie_embeddings=False,
+            ),
+            # Mixtral-style MoE (test/dev scale; EP over the ep mesh axis)
+            "moe-tiny": ModelConfig(
+                name="moe-tiny", vocab_size=512, hidden_size=128,
+                intermediate_size=256, num_layers=2, num_heads=4,
+                num_kv_heads=2, head_dim=32, num_experts=4,
+                num_experts_per_token=2,
+            ),
+            # DeepSeek-V3-ish wide-EP geometry (BASELINE config #5 shape:
+            # many small experts, top-8; real weights need a loader ext.)
+            "moe-wide": ModelConfig(
+                name="moe-wide", vocab_size=32000, hidden_size=2048,
+                intermediate_size=8192, num_layers=12, num_heads=16,
+                num_kv_heads=4, head_dim=128, num_experts=64,
+                num_experts_per_token=8, moe_intermediate_size=1024,
             ),
             # Llama-3-70B-class (BASELINE.md north-star target, multi-host)
             "llama-70b": ModelConfig(
@@ -113,6 +154,10 @@ class EngineArgs:
     tp: int = 1
     enforce_eager: bool = False          # skip jit (debug)
     prefix_caching: bool = True
+    # Weight format: "none" = dtype weights; "int8" = weight-only int8
+    # with per-output-channel scales (engine/quant.py) — halves weight
+    # bandwidth (the decode bottleneck) and fits llama-8b on one v5e.
+    quant: str = "none"
     # Attention backend (ops/paged_attention.py): "auto" → Pallas kernel
     # on TPU (single-device), XLA gather on CPU. Forced to "xla" under a
     # tp/dp mesh (pallas_call is opaque to GSPMD partitioning).

@@ -155,6 +155,7 @@ class TpuEngine:
         # Cumulative counters for metrics/bench.
         self.total_generated = 0
         self.total_prefilled = 0
+        self.total_decode_steps = 0  # device substeps incl. padded/zombie work
 
     @staticmethod
     def _build_tiers(args: EngineArgs):
@@ -835,6 +836,7 @@ class TpuEngine:
         return _Window(batch, pos0, K, ref)
 
     def _drain_window(self, w: "_Window") -> None:
+        self.total_decode_steps += w.K
         toks_np = np.asarray(w.ref.arrs[0])  # [K, B] — the one host sync
         logps_np = np.asarray(w.ref.arrs[1])
         for i, seq in enumerate(w.rows):
@@ -867,6 +869,7 @@ class TpuEngine:
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
         ref = self._runner.decode_step(tokens, positions, tables, active)
+        self.total_decode_steps += 1
         # The step just wrote each sequence's KV at `positions[i]`.
         for i, seq in enumerate(batch):
             seq.kv_written = int(positions[i]) + 1

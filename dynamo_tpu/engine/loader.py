@@ -110,6 +110,7 @@ def load_params(
     cfg: ModelConfig,
     dtype: Any = None,
     sharding=None,  # dynamo_tpu.parallel.ModelSharding | None
+    quant: str = "none",
 ):
     """safetensors → the engine params pytree, on device.
 
@@ -174,22 +175,33 @@ def load_params(
         if tuple(leaf.shape) != shape:
             raise ValueError(f"{key}: checkpoint shape {tuple(leaf.shape)} != expected {shape}")
 
+    if quant == "int8":
+        # Quantize HOST-side, pre-placement: an 8B bf16 staging copy on
+        # device is exactly the OOM int8 exists to avoid.
+        from dynamo_tpu.engine.quant import quantize_params_np
+
+        params = quantize_params_np(params)
+
     def place(leaf: np.ndarray, shard) -> jax.Array:
-        host = leaf.astype(dtype) if leaf.dtype != dtype else leaf
+        # int8 weights keep their dtype; everything else converts to the
+        # serving dtype (scales included: bf16 scales are plenty).
+        host = leaf if leaf.dtype == np.int8 else (
+            leaf.astype(dtype) if leaf.dtype != dtype else leaf
+        )
         if shard is not None:
             return jax.device_put(host, shard)
         return jnp.asarray(host)
 
     if sharding is not None:
-        shardings = sharding.param_shardings()
+        shardings = sharding.param_shardings(params)
         return jax.tree.map(place, params, shardings)
     return jax.tree.map(lambda x: place(x, None), params)
 
 
-def load_model(model_path: str, dtype: Any = None, sharding=None):
+def load_model(model_path: str, dtype: Any = None, sharding=None, quant: str = "none"):
     """→ (ModelConfig, params) from a local HF checkpoint directory."""
     cfg = config_from_hf(model_path)
-    params = load_params(model_path, cfg, dtype=dtype, sharding=sharding)
+    params = load_params(model_path, cfg, dtype=dtype, sharding=sharding, quant=quant)
     n = cfg.param_count()
     log.info("loaded %s: %.2fB params from %s", cfg.name, n / 1e9, model_path)
     return cfg, params

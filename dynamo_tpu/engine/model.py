@@ -63,19 +63,28 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     def norm_init(k, fan_in, shape):
         return (jax.random.normal(k, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
 
+    layers: dict[str, Any] = {
+        "wq": norm_init(keys[1], d, (L, d, cfg.q_size)),
+        "wk": norm_init(keys[2], d, (L, d, cfg.kv_size)),
+        "wv": norm_init(keys[3], d, (L, d, cfg.kv_size)),
+        "wo": norm_init(keys[4], cfg.q_size, (L, cfg.q_size, d)),
+        "attn_norm": jnp.ones((L, d), dtype),
+        "mlp_norm": jnp.ones((L, d), dtype),
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        ie = cfg.moe_intermediate_size or i
+        layers["w_router"] = norm_init(jax.random.fold_in(key, 7), d, (L, d, E))
+        layers["moe_gate"] = norm_init(keys[5], d, (L, E, d, ie))
+        layers["moe_up"] = norm_init(keys[6], d, (L, E, d, ie))
+        layers["moe_down"] = norm_init(keys[7], ie, (L, E, ie, d))
+    else:
+        layers["w_gate"] = norm_init(keys[5], d, (L, d, i))
+        layers["w_up"] = norm_init(keys[6], d, (L, d, i))
+        layers["w_down"] = norm_init(keys[7], i, (L, i, d))
     params: Params = {
         "embed": norm_init(keys[0], d, (cfg.vocab_size, d)),
-        "layers": {
-            "wq": norm_init(keys[1], d, (L, d, cfg.q_size)),
-            "wk": norm_init(keys[2], d, (L, d, cfg.kv_size)),
-            "wv": norm_init(keys[3], d, (L, d, cfg.kv_size)),
-            "wo": norm_init(keys[4], cfg.q_size, (L, cfg.q_size, d)),
-            "w_gate": norm_init(keys[5], d, (L, d, i)),
-            "w_up": norm_init(keys[6], d, (L, d, i)),
-            "w_down": norm_init(keys[7], i, (L, i, d)),
-            "attn_norm": jnp.ones((L, d), dtype),
-            "mlp_norm": jnp.ones((L, d), dtype),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((d,), dtype),
     }
     if not cfg.tie_embeddings:
@@ -105,15 +114,76 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def _mlp(x, w_gate, w_up, w_down):
-    g = jnp.dot(x, w_gate)
-    u = jnp.dot(x, w_up)
-    return jnp.dot(jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u, w_down)
+def _dot_q(x: jax.Array, lp: dict, name: str) -> jax.Array:
+    """x @ lp[name], dequantizing int8 weights on the fly. The scale is
+    applied POST-matmul on the (small) output — XLA fuses the int8→bf16
+    convert into the matmul operand read, so weight traffic stays 1
+    byte/param (engine/quant.py; measured 2.4x on v5e)."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        y = jnp.dot(x, w.astype(x.dtype))
+        return y * lp[name + "_scale"].astype(x.dtype)
+    return jnp.dot(x, w)
+
+
+def _embed_rows(params: Params, tokens: jax.Array, dtype) -> jax.Array:
+    e = params["embed"][tokens]
+    if e.dtype == jnp.int8:
+        scale = params["embed_scale"][tokens].astype(dtype)
+        return e.astype(dtype) * scale[..., None]
+    return e
+
+
+def _mlp(x, lp):
+    g = _dot_q(x, lp, "w_gate")
+    u = _dot_q(x, lp, "w_up")
+    return _dot_q(jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u, lp, "w_down")
+
+
+def _moe(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
+    """Top-k routed mixture of experts over the FFN. x: [..., D].
+
+    Expert-parallel formulation: every expert's FFN is computed for every
+    token as sharded einsums over the expert axis — with experts sharded
+    over the ``ep`` mesh axis each device computes only ITS experts for
+    all tokens and the weighted combine is a psum XLA inserts (SPMD
+    wide-EP; reference reaches this only through engine flags,
+    trtllm_utils.py:140-143). Dense-over-local-experts trades FLOPs for
+    perfectly regular MXU work — the standard XLA MoE shape (token-
+    dropping/segment-matmul sparsity is a later Pallas upgrade)."""
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xt = x.reshape(-1, D)
+    T = xt.shape[0]
+    logits = jnp.dot(xt, lp["w_router"]).astype(jnp.float32)     # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = lax.top_k(probs, cfg.num_experts_per_token)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)          # mixtral renorm
+    weights = jnp.zeros_like(probs)
+    weights = weights.at[jnp.arange(T)[:, None], topi].set(topv)  # [T, E] sparse
+    g = jnp.einsum("td,edi->tei", xt, lp["moe_gate"])
+    u = jnp.einsum("td,edi->tei", xt, lp["moe_up"])
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(xt.dtype) * u   # [T, E, ie]
+    y = jnp.einsum("tei,te,eid->td", h, weights.astype(xt.dtype), lp["moe_down"])
+    return y.reshape(orig_shape)
+
+
+def _ffn(x: jax.Array, lp: dict, cfg: ModelConfig) -> jax.Array:
+    return _moe(x, lp, cfg) if cfg.num_experts else _mlp(x, lp)
 
 
 def _logits(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     x = _rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if cfg.tie_embeddings:
+        emb = params["embed"]
+        if emb.dtype == jnp.int8:
+            y = jnp.dot(x, emb.astype(x.dtype).T).astype(jnp.float32)
+            return y * params["embed_scale"][None, :] if y.ndim == 2 else y * params["embed_scale"]
+        return jnp.dot(x, emb.T).astype(jnp.float32)
+    head = params["lm_head"]
+    if head.dtype == jnp.int8:
+        y = jnp.dot(x, head.astype(x.dtype)).astype(jnp.float32)
+        return y * params["lm_head_scale"][None, :] if y.ndim == 2 else y * params["lm_head_scale"]
     return jnp.dot(x, head).astype(jnp.float32)
 
 
@@ -151,7 +221,8 @@ def prefill_batch_impl(
     sfx = jnp.arange(T, dtype=jnp.int32)
     suffix_positions = start_pos[:, None] + sfx[None, :]          # [Bp, T]
 
-    x = params["embed"][tokens]  # [Bp, T, D]
+    compute_dtype = params["layers"]["attn_norm"].dtype
+    x = _embed_rows(params, tokens, compute_dtype)  # [Bp, T, D]
 
     # Masks (fp32 additive), fixed for all layers.
     neg = jnp.float32(-1e9)
@@ -183,9 +254,9 @@ def prefill_batch_impl(
         x, k_cache, v_cache = carry
         lp, layer_idx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(h, lp["wq"]).reshape(Bp, T, cfg.num_heads, hd)
-        k = jnp.dot(h, lp["wk"]).reshape(Bp, T, KVH, hd)
-        v = jnp.dot(h, lp["wv"]).reshape(Bp, T, KVH, hd)
+        q = _dot_q(h, lp, "wq").reshape(Bp, T, cfg.num_heads, hd)
+        k = _dot_q(h, lp, "wk").reshape(Bp, T, KVH, hd)
+        v = _dot_q(h, lp, "wv").reshape(Bp, T, KVH, hd)
         q = _rope(q, suffix_positions, cfg.rope_theta)
         k = _rope(k, suffix_positions, cfg.rope_theta)
 
@@ -218,10 +289,10 @@ def prefill_batch_impl(
             + jnp.einsum("btkgs,bskh->btkgh", p_s, v)
         )
         o = o.reshape(Bp, T, cfg.q_size)
-        x = x + jnp.dot(o, lp["wo"])
+        x = x + _dot_q(o, lp, "wo")
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(h, lp, cfg)
         return (x, k_cache, v_cache), None
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -287,7 +358,8 @@ def decode_step_impl(
     W = block_tables.shape[1]
     bs = cache.k.shape[2]
 
-    x = params["embed"][tokens]  # [B, D]
+    compute_dtype = params["layers"]["attn_norm"].dtype
+    x = _embed_rows(params, tokens, compute_dtype)  # [B, D]
 
     blk = jnp.where(active, block_tables[jnp.arange(B), positions // bs], 0)
     off = jnp.where(active, positions % bs, 0)
@@ -300,9 +372,9 @@ def decode_step_impl(
         x, k_cache, v_cache = carry
         lp, layer_idx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(h, lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
-        k = jnp.dot(h, lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-        v = jnp.dot(h, lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        q = _dot_q(h, lp, "wq").reshape(B, cfg.num_heads, cfg.head_dim)
+        k = _dot_q(h, lp, "wk").reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        v = _dot_q(h, lp, "wv").reshape(B, cfg.num_kv_heads, cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         qg = q.reshape(B, cfg.num_kv_heads, G, cfg.head_dim)
@@ -321,10 +393,10 @@ def decode_step_impl(
                 interpret=(impl == "pallas_interpret"),
             )
         o = o.reshape(B, cfg.q_size)
-        x = x + jnp.dot(o, lp["wo"])
+        x = x + _dot_q(o, lp, "wo")
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(h, lp, cfg)
         return (x, k_cache, v_cache), None
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
@@ -444,7 +516,8 @@ def embed_impl(
     fp32. Cache-free causal forward (serves /v1/embeddings; reference:
     lib/llm/src/http/service/openai.rs:302)."""
     T = tokens.shape[0]
-    x = params["embed"][tokens]  # [T, D]
+    compute_dtype = params["layers"]["attn_norm"].dtype
+    x = _embed_rows(params, tokens, compute_dtype)  # [T, D]
     pos = jnp.arange(T, dtype=jnp.int32)
     neg = jnp.float32(-1e9)
     causal = (pos[None, :] <= pos[:, None])
@@ -455,9 +528,9 @@ def embed_impl(
 
     def layer(x, lp):
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = jnp.dot(h, lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
-        k = jnp.dot(h, lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-        v = jnp.dot(h, lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        q = _dot_q(h, lp, "wq").reshape(T, cfg.num_heads, cfg.head_dim)
+        k = _dot_q(h, lp, "wk").reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        v = _dot_q(h, lp, "wv").reshape(T, cfg.num_kv_heads, cfg.head_dim)
         q = _rope(q, pos, cfg.rope_theta)
         k = _rope(k, pos, cfg.rope_theta)
         qg = q.reshape(T, cfg.num_kv_heads, G, cfg.head_dim)
@@ -465,9 +538,9 @@ def embed_impl(
         s = s + mask[:, None, None, :]
         p = jax.nn.softmax(s, axis=-1).astype(x.dtype)
         o = jnp.einsum("tkgs,skh->tkgh", p, v).reshape(T, cfg.q_size)
-        x = x + jnp.dot(o, lp["wo"])
+        x = x + _dot_q(o, lp, "wo")
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(h, lp, cfg)
         return x, None
 
     x, _ = lax.scan(layer, x, params["layers"])

@@ -101,6 +101,27 @@ class LocalRunner:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
+        if self.args.quant == "int8" and self.params is None:
+            from dynamo_tpu.engine.quant import random_int8_params
+
+            # Host-side layerwise generation: int8 from birth, so 8B-class
+            # geometries never materialize a bf16 copy.
+            self.params = random_int8_params(self.cfg, self._seed, self.args.dtype)
+        elif self.args.quant == "int8" and not any(
+            leaf.dtype == jnp.int8 for leaf in jax.tree.leaves(self.params)
+        ):
+            if isinstance(jax.tree.leaves(self.params)[0], np.ndarray):
+                from dynamo_tpu.engine.quant import quantize_params_np
+
+                self.params = quantize_params_np(self.params)
+            else:
+                # Device-resident float params: the loader should have
+                # quantized host-side (load_model(quant="int8")); pulling
+                # them back would defeat the memory savings.
+                raise ValueError(
+                    "quant='int8' with unquantized device params — pass "
+                    "quant='int8' to load_model/load_params instead"
+                )
         if self.params is None:
             key = jax.random.PRNGKey(self._seed)
             self.params = M.init_params(self.cfg, key, jnp.dtype(self.args.dtype))
@@ -115,6 +136,8 @@ class LocalRunner:
         if self.sharding is not None:
             self.params = self.sharding.shard_params(self.params)
             self.cache = M.KVCache(*self.sharding.shard_cache(self.cache))
+        elif isinstance(jax.tree.leaves(self.params)[0], np.ndarray):
+            self.params = jax.tree.map(jnp.asarray, self.params)
         from dynamo_tpu.ops.paged_attention import resolve_attn_impl
 
         # Pallas only single-device (pallas_call is opaque to GSPMD).

@@ -103,6 +103,7 @@ class OpenAIClient:
                 "stream": True, **kw}
         last: Exception | None = None
         for attempt in range(self.max_retries + 1):
+            yielded = False
             try:
                 async with self._http().stream(
                     "POST", "/v1/chat/completions", json=body
@@ -122,13 +123,16 @@ class OpenAIClient:
                                 data = line.split(b":", 1)[1].strip().decode()
                                 if data == "[DONE]":
                                     return
+                                yielded = True
                                 yield json.loads(data)
                     return
             except OpenAIClientError as e:
-                if e.status not in _RETRYABLE:
-                    raise
+                if yielded or e.status not in _RETRYABLE:
+                    raise  # never replay a stream the caller already consumed
                 last = e
             except (httpx.TransportError, OSError) as e:
+                if yielded:
+                    raise
                 last = e
             if attempt < self.max_retries:
                 await asyncio.sleep(self.backoff_s * (2 ** attempt))

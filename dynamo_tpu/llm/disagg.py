@@ -264,9 +264,15 @@ class DisaggDecodeHandler:
 
         import msgpack
 
+        # Fail fast when no prefill worker is even discovered — an empty
+        # fleet must cost ~0, not queue_timeout_s, per request (push mode
+        # gets this via NoInstancesError).
+        if not list(self.prefill_router.discovery.available()):
+            return None
         reply_key = f"disagg/reply/{os.urandom(8).hex()}"
+        job_key = None
         try:
-            await self.queue.enqueue({
+            job_key = await self.queue.enqueue({
                 "req": preq, "reply_key": reply_key,
                 "expires_at": time.time() + self.cfg.queue_timeout_s,
             })
@@ -281,11 +287,13 @@ class DisaggDecodeHandler:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         log.warning("queued prefill timed out; falling back to local")
+                        await self.store.delete(job_key)  # unclaimed job: reclaim
                         return None
                     try:
                         ev = await asyncio.wait_for(watch.__anext__(), remaining)
                     except (asyncio.TimeoutError, StopAsyncIteration):
                         log.warning("queued prefill timed out; falling back to local")
+                        await self.store.delete(job_key)
                         return None
                     if ev.key == reply_key and ev.value is not None:
                         value = ev.value

@@ -49,6 +49,7 @@ class MetricsExporter:
         self._router = None
         self._task: asyncio.Task | None = None
         self.polls = 0
+        self._seen: set[str] = set()  # worker ids with live series
 
     async def start(self) -> "MetricsExporter":
         ep = (
@@ -70,6 +71,13 @@ class MetricsExporter:
         """Scrape every live worker once. → number scraped."""
         instances = list(self._router.discovery.available())
         self.g_workers.set(len(instances), component=self.component)
+        live_ids = {f"{i.instance_id:x}" for i in instances}
+        for gone in self._seen - live_ids:
+            lbl = {"component": self.component, "worker": gone}
+            for g in (self.g_active, self.g_total, self.g_waiting, self.g_kv_active,
+                      self.g_kv_total, self.g_usage, self.g_hit):
+                g.remove(**lbl)
+        self._seen = live_ids
         n = 0
         for inst in instances:
             wid = f"{inst.instance_id:x}"

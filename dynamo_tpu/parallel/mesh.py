@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.engine.config import ModelConfig
 
 DP_AXIS = "dp"
+EP_AXIS = "ep"
 TP_KV_AXIS = "tp_kv"
 TP_REP_AXIS = "tp_rep"
 TP_AXES = (TP_KV_AXIS, TP_REP_AXIS)
@@ -63,16 +64,19 @@ def split_tp(tp: int, cfg: ModelConfig) -> tuple[int, int]:
     return tp_kv, tp_rep
 
 
-def build_mesh(tp: int = 1, dp: int = 1, devices=None, cfg: ModelConfig | None = None) -> Mesh:
-    """dp × tp mesh with the tp axis pre-split for kv replication. When
-    ``cfg`` is None the split is (tp, 1) — fine for tp <= num_kv_heads."""
+def build_mesh(tp: int = 1, dp: int = 1, ep: int = 1, devices=None,
+               cfg: ModelConfig | None = None) -> Mesh:
+    """dp × ep × tp mesh with the tp axis pre-split for kv replication.
+    When ``cfg`` is None the split is (tp, 1) — fine for tp <=
+    num_kv_heads. The ep axis shards MoE experts (wide-EP); dense models
+    leave it at 1."""
     devices = list(devices if devices is not None else jax.devices())
-    need = tp * dp
+    need = tp * dp * ep
     if len(devices) < need:
-        raise ValueError(f"mesh {dp}x{tp} needs {need} devices, have {len(devices)}")
+        raise ValueError(f"mesh {dp}x{ep}x{tp} needs {need} devices, have {len(devices)}")
     tp_kv, tp_rep = split_tp(tp, cfg) if cfg is not None else (tp, 1)
-    grid = np.array(devices[:need]).reshape(dp, tp_kv, tp_rep)
-    return Mesh(grid, (DP_AXIS, TP_KV_AXIS, TP_REP_AXIS))
+    grid = np.array(devices[:need]).reshape(dp, ep, tp_kv, tp_rep)
+    return Mesh(grid, (DP_AXIS, EP_AXIS, TP_KV_AXIS, TP_REP_AXIS))
 
 
 class ModelSharding:
@@ -86,6 +90,9 @@ class ModelSharding:
         tp_kv = mesh.shape[TP_KV_AXIS]
         tp_rep = mesh.shape[TP_REP_AXIS]
         tp = tp_kv * tp_rep
+        ep = mesh.shape.get(EP_AXIS, 1)
+        if cfg.num_experts and cfg.num_experts % ep:
+            raise ValueError(f"num_experts={cfg.num_experts} not divisible by ep={ep}")
         if cfg.num_kv_heads % tp_kv:
             raise ValueError(f"num_kv_heads={cfg.num_kv_heads} not divisible by tp_kv={tp_kv}")
         if cfg.num_heads % tp:
@@ -103,26 +110,55 @@ class ModelSharding:
     def _ns(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
 
-    def param_shardings(self) -> dict[str, Any]:
+    def param_shardings(self, params: Any | None = None) -> dict[str, Any]:
+        """Pass the params pytree to include shardings for the optional
+        int8 ``*_scale`` leaves (scales follow their weight's OUTPUT-dim
+        sharding; row-sharded weights have replicated output dims)."""
         rep = self._ns()
         col = self._ns(None, None, TP_AXES)     # [L, D, out] — shard out
         row = self._ns(None, TP_AXES, None)     # [L, in, D] — shard in
         kv_col = self._ns(None, None, TP_KV_AXIS)  # kv heads: shard tp_kv, replicate tp_rep
         embed = self._ns(self._vocab_spec, None) if self._vocab_spec else rep
+        layer_shardings: dict[str, Any] = {
+            "wq": col, "wk": kv_col, "wv": kv_col, "wo": row,
+            "attn_norm": rep, "mlp_norm": rep,
+        }
+        if self.cfg.num_experts:
+            # Experts over ep, expert-FFN width over tp (wide-EP x TP):
+            # the MoE einsums contract e locally and psum the combine.
+            layer_shardings.update({
+                "w_router": rep,
+                "moe_gate": self._ns(None, EP_AXIS, None, TP_AXES),
+                "moe_up": self._ns(None, EP_AXIS, None, TP_AXES),
+                "moe_down": self._ns(None, EP_AXIS, TP_AXES, None),
+            })
+        else:
+            layer_shardings.update({"w_gate": col, "w_up": col, "w_down": row})
         shardings = {
             "embed": embed,
             "final_norm": rep,
-            "layers": {
-                "wq": col, "wk": kv_col, "wv": kv_col, "wo": row,
-                "w_gate": col, "w_up": col, "w_down": row,
-                "attn_norm": rep, "mlp_norm": rep,
-            },
+            "layers": layer_shardings,
         }
         if not self.cfg.tie_embeddings:
             # [D, V] — shard vocab (the logits matmul's big dim).
             shardings["lm_head"] = (
                 self._ns(None, self._vocab_spec) if self._vocab_spec else rep
             )
+        if params is not None:
+            scale_of = {
+                "wq": self._ns(None, TP_AXES), "wk": self._ns(None, TP_KV_AXIS),
+                "wv": self._ns(None, TP_KV_AXIS), "wo": rep,
+                "w_gate": self._ns(None, TP_AXES), "w_up": self._ns(None, TP_AXES),
+                "w_down": rep,
+            }
+            for name, spec in scale_of.items():
+                if name + "_scale" in params.get("layers", {}):
+                    shardings["layers"][name + "_scale"] = spec
+            vocab1d = self._ns(self._vocab_spec) if self._vocab_spec else rep
+            if "embed_scale" in params:
+                shardings["embed_scale"] = vocab1d
+            if "lm_head_scale" in params:
+                shardings["lm_head_scale"] = vocab1d
         return shardings
 
     def cache_spec(self) -> P:
@@ -142,7 +178,7 @@ class ModelSharding:
             # supply its addressable shards. (Sharded-native loading is
             # the loader's job for models that exceed host RAM.)
             params = jax.tree.map(np.asarray, params)
-        return jax.device_put(params, self.param_shardings())
+        return jax.device_put(params, self.param_shardings(params))
 
     def shard_cache(self, cache) -> tuple[jax.Array, jax.Array]:
         ns = self._ns(*self.cache_spec())

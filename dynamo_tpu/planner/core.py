@@ -84,6 +84,8 @@ class Planner:
         self.prefill_interp = prefill_interp
         self.predictor = make_predictor(cfg.predictor)
         self.state = PlannerState()
+        self._last_current = 0
+        self._last_prefill_current = 0
         self._task: asyncio.Task | None = None
         self._stop = asyncio.Event()
 
@@ -122,6 +124,7 @@ class Planner:
             # comfortably in fewer replicas.
             if token_rate * self.cfg.scale_down_headroom > (current - 1) * cap:
                 need = current
+        self._last_current = current  # reused by _step_sync's _apply
         return max(self.cfg.min_replicas, min(self.cfg.max_replicas, need))
 
     def target_prefill_replicas(self, obs: PlannerObservation) -> int:
@@ -143,10 +146,13 @@ class Planner:
         current = self.connector.get_replicas(self.cfg.prefill_component)
         if need < current and input_rate * self.cfg.scale_down_headroom > (current - 1) * cap:
             need = current
+        self._last_prefill_current = current
         return max(self.cfg.min_replicas, min(self.cfg.max_replicas, need))
 
-    def _apply(self, component: str, target: int, obs: PlannerObservation) -> None:
-        current = self.connector.get_replicas(component)
+    def _apply(self, component: str, target: int, obs: PlannerObservation,
+               current: int | None = None) -> None:
+        if current is None:
+            current = self.connector.get_replicas(component)
         if target != current:
             log.info(
                 "scaling %s: %d → %d (rate=%.2f req/s pred=%.2f ttft=%s itl=%s ms)",
@@ -161,9 +167,11 @@ class Planner:
         connectors may block on I/O (the Kubernetes one does HTTPS
         round-trips), which must not stall the planner's event loop."""
         target = self.target_replicas(obs)
-        self._apply(self.cfg.component, target, obs)
+        self._apply(self.cfg.component, target, obs, current=self._last_current)
         if self.cfg.prefill_component:
-            self._apply(self.cfg.prefill_component, self.target_prefill_replicas(obs), obs)
+            ptarget = self.target_prefill_replicas(obs)
+            self._apply(self.cfg.prefill_component, ptarget, obs,
+                        current=self._last_prefill_current)
         return target
 
     async def step(self) -> int:

@@ -157,6 +157,7 @@ class DistributedRuntime:
         self._discoveries: dict[tuple[str, str, str], DiscoveryClient] = {}
         self._handles: list[ServeHandle] = []
         self._shutdown = asyncio.Event()
+        self._system_server = None
 
     @classmethod
     async def create(
@@ -167,7 +168,16 @@ class DistributedRuntime:
     ) -> "DistributedRuntime":
         config = config or Config.from_env()
         store = await connect_store(store_url or config.store.url, config.store.lease_ttl)
-        return cls(store, config, advertise_host)
+        rt = cls(store, config, advertise_host)
+        if config.system.enabled:
+            # Per-process /health /live /metrics (reference: every process
+            # runs the system server, http_server.rs:33-69).
+            from dynamo_tpu.runtime.http_server import SystemHttpServer
+
+            rt._system_server = await SystemHttpServer(
+                rt, config.system.host, config.system.port
+            ).start()
+        return rt
 
     def namespace(self, name: str) -> Namespace:
         return Namespace(self, name)
@@ -232,6 +242,9 @@ class DistributedRuntime:
 
     async def shutdown(self) -> None:
         """Graceful: deregister instances, drain, drop lease, close planes."""
+        if self._system_server is not None:
+            await self._system_server.close()
+            self._system_server = None
         self._shutdown.set()
         for handle in list(self._handles):
             await handle.close()

@@ -1,0 +1,61 @@
+"""Per-process system status server: /health /live /metrics.
+
+Reference analogue: the axum system server every reference process runs
+(reference: lib/runtime/src/http_server.rs:33-69, env-gated via
+config.rs:98-123). Enabled with ``DYNTPU_SYSTEM_ENABLED=1`` (port via
+``DYNTPU_SYSTEM_PORT``) or ``[system]`` in TOML — workers and frontends
+alike expose liveness/readiness probes and their full metrics registry
+without any store round-trip (k8s probes in deploy/k8s/ point here).
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("system_http")
+
+
+class SystemHttpServer:
+    def __init__(self, runtime, host: str = "0.0.0.0", port: int = 9090):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> "SystemHttpServer":
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]  # resolve port 0
+        log.info("system server on %s:%d", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        h = self.runtime.health
+        body = {
+            "status": "ready" if h.ready else "notready",
+            "live": h.live,
+            "endpoints": dict(h.endpoint_health),
+        }
+        return web.json_response(body, status=200 if h.ready else 503)
+
+    async def _live(self, request: web.Request) -> web.Response:
+        live = self.runtime.health.live
+        return web.json_response({"live": live}, status=200 if live else 503)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.runtime.metrics.render(), content_type="text/plain"
+        )

@@ -98,6 +98,13 @@ class Gauge(Metric):
         with self._lock:
             self._values[key] = float(value)
 
+    def remove(self, **labels: str) -> None:
+        """Drop one label series (e.g. a scaled-down worker's gauges —
+        without this, dead workers report their last values forever)."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values.pop(key, None)
+
     def add(self, amount: float, **labels: str) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:

@@ -60,6 +60,8 @@ def parse_args(argv=None):
     p.add_argument("--decode-steps", type=int, default=8)
     p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
                    default="auto", help="attention backend (ops/paged_attention.py)")
+    p.add_argument("--quant", choices=["none", "int8"], default="none",
+                   help="weight format (int8 = weight-only quantization, engine/quant.py)")
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="G2 host-RAM KV tier capacity in blocks (0 = off)")
     p.add_argument("--disk-kv-dir", default=None, help="G3 disk KV tier directory")
@@ -136,7 +138,7 @@ async def build_engine(args):
                 hf_cfg = config_from_hf(args.model_path)
                 sharding = ModelSharding(build_mesh(tp=args.tp, cfg=hf_cfg), hf_cfg)
             model, params = await asyncio.to_thread(
-                load_model, args.model_path, args.dtype, sharding
+                load_model, args.model_path, args.dtype, sharding, args.quant
             )
         else:
             model = ModelConfig.preset(args.preset)
@@ -282,6 +284,7 @@ def _engine_args(args, model):
         tp=args.tp,
         decode_steps=args.decode_steps,
         attn_impl=args.attn_impl,
+        quant=args.quant,
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_dir=args.disk_kv_dir,
         disk_kv_blocks=args.disk_kv_blocks,
@@ -303,7 +306,7 @@ def run_follower(args) -> None:
         model = config_from_hf(args.model_path)
         if args.tp > 1:
             sharding = ModelSharding(build_mesh(tp=args.tp, cfg=model), model)
-        model, params = load_model(args.model_path, args.dtype, sharding)
+        model, params = load_model(args.model_path, args.dtype, sharding, args.quant)
     else:
         model = ModelConfig.preset(args.preset)
     eargs = _engine_args(args, model)

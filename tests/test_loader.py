@@ -190,3 +190,44 @@ def test_missing_tensor_raises(tmp_path):
     save_file(tensors, os.path.join(path, "model.safetensors"))
     with pytest.raises(KeyError, match="up_proj"):
         load_model(path, dtype=jnp.float32)
+
+
+def test_golden_parity_vs_transformers(tmp_path):
+    """Load a REAL HF-format Llama checkpoint (written by transformers
+    itself) and match transformers' logits. This pins the RoPE layout
+    claim (loader.py: HF q/k load with no permutation fix-up) against the
+    reference implementation — a silent q/k permutation bug passes the
+    synthetic-checkpoint tests but fails here (VERDICT r3 weak #8)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+        rms_norm_eps=1e-5, tie_word_embeddings=False, torch_dtype="float32",
+    )
+    torch.manual_seed(0)
+    hf_model = LlamaForCausalLM(hf_cfg).eval()
+    path = tmp_path / "tiny-llama"
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    prompt = [3, 17, 99, 4, 56, 23, 81, 7]
+    with torch.no_grad():
+        ref = hf_model(torch.tensor([prompt])).logits[0].numpy()  # [T, V]
+
+    cfg, params = load_model(str(path), dtype="float32")
+    assert cfg.num_kv_heads == 2 and cfg.head_dim == 16
+
+    bs = 4
+    nblocks = (len(prompt) + bs - 1) // bs + 1
+    cache = M.init_kv_cache(cfg, 16, bs, jnp.float32)
+    table = jnp.asarray(list(range(1, nblocks + 1)), jnp.int32)
+    logits, _ = M.prefill(
+        cfg, params, cache, jnp.asarray(prompt, jnp.int32), table,
+        jnp.int32(0), jnp.int32(len(prompt)),
+    )
+    # prefill returns last-token logits; compare against transformers'.
+    np.testing.assert_allclose(
+        np.asarray(logits), ref[-1], atol=2e-4, rtol=2e-3
+    )

@@ -23,7 +23,7 @@ CFG = ModelConfig()  # test-tiny: 4 heads, 2 kv heads
 
 def test_build_mesh_shapes():
     mesh = build_mesh(tp=2, dp=4)
-    assert mesh.shape == {"dp": 4, "tp_kv": 2, "tp_rep": 1}
+    assert mesh.shape == {"dp": 4, "ep": 1, "tp_kv": 2, "tp_rep": 1}
     with pytest.raises(ValueError):
         build_mesh(tp=16, dp=1)
 
@@ -31,7 +31,7 @@ def test_build_mesh_shapes():
 def test_build_mesh_splits_tp_beyond_kv_heads():
     # test-tiny: 4 heads / 2 kv heads → tp=4 must replicate kv x2.
     mesh = build_mesh(tp=4, cfg=CFG)
-    assert mesh.shape == {"dp": 1, "tp_kv": 2, "tp_rep": 2}
+    assert mesh.shape == {"dp": 1, "ep": 1, "tp_kv": 2, "tp_rep": 2}
 
 
 def test_sharding_divisibility_checks():
@@ -136,7 +136,7 @@ from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
 cfg = ModelConfig(name="t70", vocab_size=512, hidden_size=128, intermediate_size=256,
                   num_layers=2, num_heads=16, num_kv_heads=8, head_dim=8)
 mesh = build_mesh(tp=16, cfg=cfg)
-assert mesh.shape == {"dp": 1, "tp_kv": 8, "tp_rep": 2}, mesh.shape
+assert mesh.shape == {"dp": 1, "ep": 1, "tp_kv": 8, "tp_rep": 2}, mesh.shape
 sh = ModelSharding(mesh, cfg)
 params = sh.shard_params(M.init_params(cfg, jax.random.PRNGKey(0), jnp.float32))
 cache = M.KVCache(*sh.shard_cache(M.init_kv_cache(cfg, 16, 4, jnp.float32)))
