@@ -1,0 +1,64 @@
+"""Standalone router component (reference: components/router/src/main.rs)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.router.__main__ import async_main, parse_args
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+
+def test_router_component_routes_and_proxies():
+    async def go():
+        url = "memory://routercomp"
+        # Backend mocker worker with KV event endpoints.
+        wrt = await DistributedRuntime.create(store_url=url)
+        engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=128, speedup=1000.0))
+        broadcaster = KvEventBroadcaster(engine.pool)
+        engine.pool.set_event_sink(broadcaster.publish)
+        comp = wrt.namespace("dyn").component("backend")
+
+        async def gen(payload, ctx):
+            async for item in engine.generate(payload, ctx):
+                yield item
+
+        await comp.endpoint("generate").serve(gen)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+
+        # Router component as a task (its CLI main, in-process).
+        args = parse_args(["--store-url", url, "--namespace", "dyn", "--block-size", "4"])
+        router_task = asyncio.get_running_loop().create_task(async_main(args))
+
+        # Client: route + proxied generate through the router component.
+        crt = await DistributedRuntime.create(store_url=url)
+        rcomp = crt.namespace("dyn").component("router")
+        route_r = await rcomp.endpoint("route").router(RouterMode.ROUND_ROBIN)
+        await route_r.discovery.wait_for_instances(1, timeout=30)
+        placement = None
+        async for item in route_r.generate({"token_ids": [1, 2, 3, 4]}, Context()):
+            placement = item
+        assert placement and "worker_instance_id" in placement
+
+        gen_r = await rcomp.endpoint("generate").router(RouterMode.ROUND_ROBIN)
+        req = PreprocessedRequest(model="m", token_ids=[1, 2, 3, 4])
+        req.stop.max_tokens = 5
+        req.stop.ignore_eos = True
+        toks = []
+        async for item in gen_r.generate(req.to_dict(), Context()):
+            toks += item.get("token_ids") or []
+        assert len(toks) == 5
+
+        router_task.cancel()
+        try:
+            await router_task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+        await crt.shutdown()
+        await wrt.shutdown()
+
+    asyncio.run(go())
