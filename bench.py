@@ -113,11 +113,14 @@ async def bench(args) -> dict:
     kv_block_bytes = 2 * model.num_layers * block_size * model.kv_size * 2
     budget = args.hbm_gb * 1e9 * 0.92 - weight_bytes - 1.2e9
     if budget < kv_block_bytes * blocks_per_seq * 2:
+        fixes = "a smaller model or tp>=2 (multi-chip)"
+        if args.quant != "int8":
+            fixes = "--quant int8, " + fixes
         raise SystemExit(
             f"{model.name} {args.quant} weights ({weight_bytes/1e9:.1f} GB) leave no KV room "
-            f"in {args.hbm_gb} GB HBM — use --quant int8, a smaller model, or tp>=2"
+            f"in {args.hbm_gb} GB HBM — use {fixes}"
         )
-    cap_blocks = max(int(budget // kv_block_bytes), blocks_per_seq * 2)
+    cap_blocks = int(budget // kv_block_bytes)
     num_kv_blocks = min(max(args.max_num_seqs * blocks_per_seq, 256), cap_blocks)
     max_num_seqs = max(8, min(args.max_num_seqs, num_kv_blocks // blocks_per_seq))
     eargs = EngineArgs(

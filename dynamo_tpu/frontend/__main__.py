@@ -36,12 +36,15 @@ def parse_args(argv=None):
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--no-kv-events", action="store_true",
                    help="KV mode without worker events (TTL-predictive index)")
+    p.add_argument("--record-dir", default=None,
+                   help="record response streams + routing events to JSONL here "
+                        "(replayable offline; llm/recorder.py)")
     return p.parse_args(argv)
 
 
 async def async_main(args) -> None:
     rt = await DistributedRuntime.create(store_url=args.store_url)
-    settings = RouterSettings(mode=RouterMode(args.router_mode))
+    settings = RouterSettings(mode=RouterMode(args.router_mode), record_dir=args.record_dir)
     if settings.mode == RouterMode.KV:
         settings.kv = KvRouterConfig(
             overlap_score_weight=args.kv_overlap_score_weight,

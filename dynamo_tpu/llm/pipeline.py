@@ -36,6 +36,10 @@ log = get_logger("pipeline")
 class RouterSettings:
     mode: RouterMode = RouterMode.ROUND_ROBIN
     kv: KvRouterConfig | None = None
+    # Record per-token response streams + router hit-rate events to
+    # <record_dir>/<model>.jsonl (llm/recorder.py; reference: perf.rs +
+    # recorder.rs replayable captures).
+    record_dir: str | None = None
 
 
 class _RouterEngine:
@@ -69,6 +73,20 @@ class ModelPipeline:
         self.discovery = None
         self._embed_router = None
         self._admin_router = None
+        self._recorder = None
+        if self.settings.record_dir:
+            import os
+
+            from dynamo_tpu.llm.recorder import JsonlRecorder
+
+            os.makedirs(self.settings.record_dir, exist_ok=True)
+            # slug: model names may contain '/' (HF-style); same
+            # sanitization discovery/store keys use.
+            from dynamo_tpu.llm.model_card import slugify
+
+            self._recorder = JsonlRecorder(
+                os.path.join(self.settings.record_dir, f"{slugify(card.name)}.jsonl")
+            )
 
     async def start(self) -> "ModelPipeline":
         ep = (
@@ -88,6 +106,10 @@ class ModelPipeline:
             push = await ep.router(self.settings.mode)
             engine = _RouterEngine(push)
         self.discovery = push.discovery
+        if self._recorder is not None:
+            from dynamo_tpu.llm.recorder import RecordingEngine
+
+            engine = RecordingEngine(engine, self._recorder)
         migration = Migration(engine, migration_limit=self.card.migration_limit)
         self.backend = Backend(migration, self.preprocessor.tokenizer)
         return self
@@ -105,12 +127,16 @@ class ModelPipeline:
         overlap = scope.counter("router_overlap_blocks_total", "Prefix blocks already on the chosen worker")
         hist = scope.histogram("router_hit_rate", "Per-request prefix hit rate")
 
+        rec_sink = self._recorder.hit_rate_sink() if self._recorder else None
+
         def sink(ev) -> None:
             model = self.card.name
             decisions.inc(model=model, worker=f"{ev.worker_id:x}")
             isl.inc(ev.isl_blocks, model=model)
             overlap.inc(ev.overlap_blocks, model=model)
             hist.observe(ev.hit_rate, model=model)
+            if rec_sink is not None:
+                rec_sink(ev)
 
         return sink
 
@@ -156,6 +182,8 @@ class ModelPipeline:
     async def close(self) -> None:
         if self.kv_router is not None:
             await self.kv_router.close()
+        if self._recorder is not None:
+            self._recorder.close()
 
     # -- request execution -------------------------------------------------
 

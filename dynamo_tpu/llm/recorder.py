@@ -33,12 +33,15 @@ class JsonlRecorder:
         self.lines = 0
 
     def write(self, kind: str, **payload: Any) -> None:
+        if self._f.closed:
+            return  # model removed mid-stream: drop, never kill the stream
         rec = {"t": round(time.monotonic() - self._t0, 6), "kind": kind, **payload}
         self._f.write(json.dumps(rec) + "\n")
         self.lines += 1
 
     def close(self) -> None:
-        self._f.close()
+        if not self._f.closed:
+            self._f.close()
 
     # -- sinks -------------------------------------------------------------
 

@@ -73,23 +73,26 @@ async def async_main(args) -> None:
         async for item in kv.generate(payload, ctx):
             yield item
 
-    comp = rt.namespace(args.namespace).component(args.component)
-    await comp.endpoint("route").serve(route)
-    await comp.endpoint(args.endpoint).serve(generate)
-    print(
-        f"dynamo_tpu router: {args.namespace}/{args.component} routing over "
-        f"{args.backend_component}/{args.endpoint}",
-        flush=True,
-    )
+    try:
+        comp = rt.namespace(args.namespace).component(args.component)
+        await comp.endpoint("route").serve(route)
+        await comp.endpoint(args.endpoint).serve(generate)
+        print(
+            f"dynamo_tpu router: {args.namespace}/{args.component} routing over "
+            f"{args.backend_component}/{args.endpoint}",
+            flush=True,
+        )
 
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        with contextlib.suppress(NotImplementedError):
-            loop.add_signal_handler(sig, stop.set)
-    await stop.wait()
-    await kv.close()
-    await rt.shutdown()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            with contextlib.suppress(NotImplementedError):
+                loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+    finally:
+        # Cancellation must still tear down subscriptions + deregister.
+        await kv.close()
+        await rt.shutdown()
 
 
 def main(argv=None) -> int:

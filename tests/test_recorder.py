@@ -83,3 +83,64 @@ def test_hit_rate_record(tmp_path):
     rec.close()
     [r] = list(read_records(path, kind="hit_rate"))
     assert r["overlap_blocks"] == 4 and r["worker_id"] == 3
+
+
+def test_frontend_pipeline_records_streams(tmp_path):
+    """record_dir on RouterSettings captures request/delta records with
+    timestamps through the real pipeline (reference: perf.rs)."""
+    import httpx
+
+    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+    from dynamo_tpu.llm.pipeline import RouterSettings
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.push_router import RouterMode
+
+    async def go():
+        url = "memory://recfe"
+        wrt = await DistributedRuntime.create(store_url=url)
+        engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=64, speedup=1000.0))
+        broadcaster = KvEventBroadcaster(engine.pool)
+        engine.pool.set_event_sink(broadcaster.publish)
+        comp = wrt.namespace("e2e").component("backend")
+
+        async def gen(payload, ctx):
+            async for item in engine.generate(payload, ctx):
+                yield item
+
+        await comp.endpoint("generate").serve(gen)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        await register_model(wrt, "e2e", ModelDeploymentCard(
+            name="rec-model", kv_cache_block_size=4,
+            eos_token_ids=[ByteTokenizer.EOS], context_length=128,
+        ))
+
+        frt = await DistributedRuntime.create(store_url=url)
+        manager = ModelManager(frt, RouterSettings(
+            mode=RouterMode.KV, record_dir=str(tmp_path)))
+        watcher = await ModelWatcher(frt, manager).start()
+        http = await HttpService(manager, frt.metrics, host="127.0.0.1", port=0).start()
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                r = await client.post(
+                    f"http://127.0.0.1:{http.port}/v1/chat/completions",
+                    json={"model": "rec-model",
+                          "messages": [{"role": "user", "content": "hi"}],
+                          "max_tokens": 4},
+                )
+                assert r.status_code == 200
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(go())
+    recs = list(read_records(str(tmp_path / "rec-model.jsonl")))
+    kinds = {r["kind"] for r in recs}
+    assert "request" in kinds and "delta" in kinds and "hit_rate" in kinds, kinds
