@@ -204,6 +204,15 @@ class ModelPipeline:
             self.card.name, kind=kind, prompt_tokens=len(pre.token_ids),
             want_logprobs=pre.sampling.logprobs,
             token_text_fn=lambda tid: self.preprocessor.tokenizer.decode([tid]),
+            want_tools=(
+                bool(getattr(req, "tools", None))
+                and getattr(req, "tool_choice", None) != "none"
+            ),
+            tool_names={
+                t.get("function", {}).get("name")
+                for t in getattr(req, "tools", []) or []
+                if isinstance(t, dict)
+            },
         )
         assert self.backend is not None, "pipeline not started"
         async for raw in self.backend.generate(pre.to_dict(), context):

@@ -8,7 +8,10 @@ Reference analogue: ``OpenAIPreprocessor`` (lib/llm/src/preprocessor.rs:
 
 from __future__ import annotations
 
+import json
+import re
 import time
+import uuid
 from typing import Any
 
 import jinja2
@@ -52,9 +55,11 @@ class ChatTemplate:
         env.globals["raise_exception"] = _raise_template_exception
         self._template = env.from_string(source or DEFAULT_CHAT_TEMPLATE)
 
-    def render(self, messages: list[ChatMessage], add_generation_prompt: bool = True) -> str:
+    def render(self, messages: list[ChatMessage], add_generation_prompt: bool = True,
+               tools: list[dict] | None = None) -> str:
         try:
             return self._template.render(
+                tools=tools or None,
                 messages=[m.to_dict() for m in messages],
                 add_generation_prompt=add_generation_prompt,
                 bos_token="",
@@ -122,7 +127,12 @@ class OpenAIPreprocessor:
         )
 
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
-        prompt = self.template.render(req.messages, add_generation_prompt=True)
+        # Tool definitions render through the chat template's `tools`
+        # variable — the HF chat-template contract (reference analogue:
+        # preprocessor/tools.rs builds the tool prompt for the template).
+        tools = req.tools if req.tool_choice != "none" else []
+        prompt = self.template.render(req.messages, add_generation_prompt=True,
+                                      tools=tools)
         token_ids = self.tokenizer.encode(prompt)
         annotations: dict[str, Any] = {}
         if "formatted_prompt" in req.annotations:
@@ -142,6 +152,60 @@ class OpenAIPreprocessor:
         return self._common(req, token_ids, annotations)
 
 
+_TOOL_CALL_RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.S)
+
+
+def parse_tool_calls(text: str, tool_names: set[str] | None = None) -> list[dict]:
+    """Best-effort tool-call extraction from generated text (reference:
+    preprocessor/tools.rs parses engine output into tool calls).
+    Recognizes the two common open-model conventions:
+    - Hermes/Qwen: ``<tool_call>{"name": ..., "arguments": {...}}</tool_call>``
+    - Llama-3.x JSON: the whole completion is one JSON object with
+      ``name`` + ``arguments``/``parameters``.
+    The bare-JSON fallback only fires when the parsed name matches a
+    DECLARED tool (``tool_names``) — a legitimate JSON answer that merely
+    contains a "name" key must not be hijacked into a phantom call.
+    → OpenAI-shaped tool_calls list ([] = no call detected)."""
+    calls: list[dict] = []
+
+    def mk(obj) -> dict | None:
+        if not isinstance(obj, dict) or "name" not in obj:
+            return None
+        args = obj.get("arguments", obj.get("parameters", {}))
+        if not isinstance(args, str):
+            args = json.dumps(args)
+        return {
+            "id": f"call_{uuid.uuid4().hex[:24]}",
+            "type": "function",
+            "function": {"name": str(obj["name"]), "arguments": args},
+        }
+
+    for m in _TOOL_CALL_RE.finditer(text):
+        try:
+            call = mk(json.loads(m.group(1)))
+        except json.JSONDecodeError:
+            continue
+        if call:
+            calls.append(call)
+    if calls:
+        return calls
+    stripped = text.strip()
+    if stripped.startswith("{") and stripped.endswith("}"):
+        try:
+            obj = json.loads(stripped)
+        except json.JSONDecodeError:
+            return []
+        if (
+            isinstance(obj, dict)
+            and tool_names is not None
+            and obj.get("name") in tool_names
+        ):
+            call = mk(obj)
+            if call:
+                return [call]
+    return []
+
+
 class DeltaGenerator:
     """Turns Backend text deltas into OpenAI SSE chunk payloads and the
     final aggregated response (reference: preprocessor.rs DeltaGenerator +
@@ -155,6 +219,8 @@ class DeltaGenerator:
         prompt_tokens: int = 0,
         want_logprobs: bool = False,
         token_text_fn=None,  # tid -> str, for logprob token labels
+        want_tools: bool = False,       # scan output for tool calls
+        tool_names: set[str] | None = None,  # declared tools (bare-JSON filter)
     ):
         assert kind in ("chat", "completion")
         self.kind = kind
@@ -167,6 +233,8 @@ class DeltaGenerator:
         self.finish_reason: str | None = None
         self._first = True
         self.want_logprobs = want_logprobs
+        self.want_tools = want_tools
+        self.tool_names = tool_names or set()
         self._token_text = token_text_fn or (lambda tid: "")
         # Accumulated (token_id, logprob) for the final response.
         self.lp_tokens: list[int] = []
@@ -223,6 +291,18 @@ class DeltaGenerator:
                 chunks.append(chat_chunk(self.id, self.model, self.created, content=text, logprobs=lp))
             if finish_reason:
                 self.finish_reason = finish_reason
+                calls = (
+                    parse_tool_calls("".join(self.text_parts), self.tool_names)
+                    if self.want_tools else []
+                )
+                if calls:
+                    # Streaming tool use: one delta carrying the parsed
+                    # calls, then the finish chunk flips to tool_calls —
+                    # matching the aggregate path (clients must never see
+                    # the two modes disagree).
+                    self.finish_reason = finish_reason = "tool_calls"
+                    chunks.append(chat_chunk(self.id, self.model, self.created,
+                                             tool_calls=calls))
                 chunks.append(
                     chat_chunk(
                         self.id, self.model, self.created,
@@ -250,7 +330,15 @@ class DeltaGenerator:
         finish = self.finish_reason or "stop"
         lp = self.final_logprobs()
         if self.kind == "chat":
-            return chat_completion(self.id, self.model, self.created, text, finish,
+            body = chat_completion(self.id, self.model, self.created, text, finish,
                                    self.usage(), logprobs=lp)
+            if self.want_tools:
+                calls = parse_tool_calls(text, self.tool_names)
+                if calls:
+                    msg = body["choices"][0]["message"]
+                    msg["content"] = None
+                    msg["tool_calls"] = calls
+                    body["choices"][0]["finish_reason"] = "tool_calls"
+            return body
         return completion_response(self.id, self.model, self.created, text, finish,
                                    self.usage(), logprobs=lp)

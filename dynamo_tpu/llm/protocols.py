@@ -224,6 +224,10 @@ class ChatMessage:
     role: str
     content: str
     name: str | None = None
+    # Tool-use turns: an assistant turn's calls and a tool turn's id —
+    # templates reference both (second turn of every tool conversation).
+    tool_calls: list[dict] = field(default_factory=list)
+    tool_call_id: str | None = None
 
     @classmethod
     def parse(cls, d: Any) -> "ChatMessage":
@@ -238,12 +242,20 @@ class ChatMessage:
             )
         if not isinstance(content, str):
             raise OpenAIError("message content must be a string or content-part list")
-        return cls(role=str(d["role"]), content=content, name=d.get("name"))
+        return cls(
+            role=str(d["role"]), content=content, name=d.get("name"),
+            tool_calls=list(d.get("tool_calls") or []),
+            tool_call_id=d.get("tool_call_id"),
+        )
 
     def to_dict(self) -> dict[str, Any]:
         d = {"role": self.role, "content": self.content}
         if self.name:
             d["name"] = self.name
+        if self.tool_calls:
+            d["tool_calls"] = self.tool_calls
+        if self.tool_call_id:
+            d["tool_call_id"] = self.tool_call_id
         return d
 
 
@@ -282,6 +294,8 @@ class ChatCompletionRequest:
     messages: list[ChatMessage]
     stream: bool = False
     logprobs: bool = False            # chosen-token logprobs per delta
+    tools: list[dict] = field(default_factory=list)   # OpenAI function tools
+    tool_choice: Any = None           # "auto" | "none" | {...}
     max_tokens: int | None = None
     temperature: float | None = None
     top_p: float | None = None
@@ -318,6 +332,8 @@ class ChatCompletionRequest:
             messages=[ChatMessage.parse(m) for m in msgs],
             stream=bool(d.get("stream", False)),
             logprobs=bool(d.get("logprobs", False)),
+            tools=list(d.get("tools") or []),
+            tool_choice=d.get("tool_choice"),
             max_tokens=max_tokens,
             temperature=_opt_float(d, "temperature", 0.0, 2.0),
             top_p=_opt_float(d, "top_p", 0.0, 1.0),
@@ -410,6 +426,7 @@ def chat_chunk(
     finish_reason: str | None = None,
     usage: dict[str, int] | None = None,
     logprobs: dict | None = None,
+    tool_calls: list[dict] | None = None,
 ) -> dict[str, Any]:
     """One `chat.completion.chunk` SSE payload."""
     delta: dict[str, Any] = {}
@@ -417,6 +434,10 @@ def chat_chunk(
         delta["role"] = role
     if content is not None:
         delta["content"] = content
+    if tool_calls:
+        delta["tool_calls"] = [
+            dict(tc, index=i) for i, tc in enumerate(tool_calls)
+        ]
     choice: dict[str, Any] = {"index": 0, "delta": delta, "finish_reason": finish_reason}
     if logprobs is not None:
         choice["logprobs"] = logprobs

@@ -290,3 +290,71 @@ def test_delta_generator_chat_stream_and_aggregate():
     final = gen.final_response()
     assert final["choices"][0]["message"]["content"] == "Hello"
     assert final["usage"]["completion_tokens"] == 2
+
+
+def test_tool_call_parsing_formats():
+    """reference analogue: preprocessor/tools.rs output parsing."""
+    import json
+
+    from dynamo_tpu.llm.preprocessor import parse_tool_calls
+
+    hermes = 'thinking...<tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>'
+    [c] = parse_tool_calls(hermes)
+    assert c["type"] == "function" and c["function"]["name"] == "get_weather"
+    assert json.loads(c["function"]["arguments"]) == {"city": "SF"}
+
+    llama = '{"name": "lookup", "parameters": {"q": 1}}'
+    [c] = parse_tool_calls(llama, {"lookup"})
+    assert c["function"]["name"] == "lookup"
+    assert json.loads(c["function"]["arguments"]) == {"q": 1}
+
+    assert parse_tool_calls("plain text answer") == []
+    assert parse_tool_calls('{"not_a_call": true}') == []
+    # A JSON ANSWER with a "name" key must not become a phantom call
+    # unless it names a declared tool.
+    answer = '{"name": "Alice", "parameters": {"age": 3}}'
+    assert parse_tool_calls(answer, {"get_weather"}) == []
+    assert parse_tool_calls(answer, None) == []
+
+
+def test_tools_render_and_tool_calls_response():
+    """tools flow into the chat template; a tool-call completion flips the
+    response to message.tool_calls + finish_reason=tool_calls."""
+    from dynamo_tpu.llm.preprocessor import ChatTemplate, DeltaGenerator
+    from dynamo_tpu.llm.protocols import ChatCompletionRequest, ChatMessage
+
+    tpl = ChatTemplate(
+        "{% if tools %}TOOLS:{% for t in tools %}{{ t.function.name }};{% endfor %}\n{% endif %}"
+        "{% for m in messages %}{{ m.role }}: {{ m.content }}\n{% endfor %}"
+    )
+    req = ChatCompletionRequest.parse({
+        "model": "m", "messages": [{"role": "user", "content": "hi"}],
+        "tools": [{"type": "function", "function": {"name": "get_weather", "parameters": {}}}],
+    })
+    out = tpl.render(req.messages, tools=req.tools)
+    assert out.startswith("TOOLS:get_weather;")
+    # tool_choice=none suppresses rendering (preprocess_chat behaviour)
+    assert "TOOLS" not in tpl.render(req.messages, tools=[])
+
+    gen = DeltaGenerator("m", kind="chat", want_tools=True, tool_names={"get_weather"})
+    chunks = gen.on_delta('<tool_call>{"name": "get_weather", "arguments": {}}</tool_call>', 6, "stop")
+    body = gen.final_response()
+    choice = body["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    assert choice["message"]["content"] is None
+    assert choice["message"]["tool_calls"][0]["function"]["name"] == "get_weather"
+    # Streaming agrees with the aggregate path: a tool_calls delta is
+    # emitted and the finish chunk flips to tool_calls.
+    deltas = [c["choices"][0] for c in chunks]
+    assert any(d["delta"].get("tool_calls") for d in deltas)
+    assert deltas[-1]["finish_reason"] == "tool_calls"
+
+    # Multi-turn: assistant tool_calls + tool result survive parse/to_dict.
+    from dynamo_tpu.llm.protocols import ChatMessage
+
+    m1 = ChatMessage.parse({"role": "assistant", "content": None,
+                            "tool_calls": [{"id": "call_1", "type": "function",
+                                            "function": {"name": "get_weather", "arguments": "{}"}}]})
+    m2 = ChatMessage.parse({"role": "tool", "tool_call_id": "call_1", "content": "sunny"})
+    assert m1.to_dict()["tool_calls"][0]["id"] == "call_1"
+    assert m2.to_dict()["tool_call_id"] == "call_1"
