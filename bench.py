@@ -225,7 +225,7 @@ async def bench(args) -> dict:
         "itl_mean_ms": round(float(np.mean(itls)) * 1000, 2) if itls else float("nan"),
         "mfu_est": round(mfu, 4),
         "weight_bw_util": round(bw_util, 4),
-        "weight_bw_basis": "decode_tok_s x weight_bytes / 819 GB/s HBM peak",
+        "weight_bw_basis": "decode_steps_per_s x weight_bytes / 819 GB/s HBM peak",
         "mfu_peak_assumed_tflops": PEAK_BF16_TFLOPS,
         "warmup_s": round(warmup_s, 1),
         "elapsed_s": round(elapsed, 1),

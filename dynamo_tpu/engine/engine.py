@@ -351,7 +351,7 @@ class TpuEngine:
                 self._finish(seq, FinishReason.ERROR, error=f"admission failed: {e}")
                 continue
             allocated.append((seq, start))
-        admitted: list[tuple[_Seq, jax.Array, int]] = []  # (seq, logits array, row)
+        admitted: list[tuple[_Seq, Any, int]] = []  # (seq, logits array, row)
         if allocated:
             try:
                 admitted = self._dispatch_prefills(allocated)
@@ -383,6 +383,11 @@ class TpuEngine:
         if self._running:
             self._decode_iteration()
             self._flush_offloads()
+        elif self._inflight is not None:
+            # Every row of the in-flight window died during its drain:
+            # release the window (all-dead rows; keeps StepRef/device
+            # arrays from idling and total_decode_steps honest).
+            self._drain_inflight()
 
     # -- embeddings (reference: http/service/openai.rs:302) ----------------
 
@@ -498,12 +503,12 @@ class TpuEngine:
 
     def _dispatch_prefills(
         self, allocated: list[tuple[_Seq, int]]
-    ) -> list[tuple[_Seq, jax.Array, int]]:
+    ) -> list[tuple[_Seq, Any, int]]:
         """Phase 2 of admission: run the wave's prefills. Suffixes that fit
         one chunk are PACKED by (T bucket) into prefill_batch dispatches;
         longer prompts fall back to per-sequence chunked prefill. Returns
         (seq, logits array, row index) triples (logits not synced)."""
-        out: list[tuple[_Seq, jax.Array, int]] = []
+        out: list[tuple[_Seq, Any, int]] = []
         singles: list[tuple[_Seq, int]] = []
         groups: dict[int, list[tuple[_Seq, int]]] = {}
         for seq, start in allocated:
@@ -528,7 +533,7 @@ class TpuEngine:
 
     def _prefill_packed(
         self, members: list[tuple[_Seq, int]], t_pad: int
-    ) -> jax.Array:
+    ) -> Any:
         """One packed prefill dispatch for same-bucket suffixes. Returns
         logits [Bp, V] (not synced)."""
         Bp = self.args.bucket_prefill_rows(len(members))
@@ -548,7 +553,7 @@ class TpuEngine:
             self._finish_prefill_bookkeeping(seq, start)
         return ref
 
-    def _prefill_chunked(self, seq: _Seq, start: int) -> jax.Array:
+    def _prefill_chunked(self, seq: _Seq, start: int) -> Any:
         """Per-sequence chunked prefill (suffix > max_prefill_tokens).
         Returns last-token logits [V] (not synced)."""
         prompt = seq.tokens
@@ -769,7 +774,11 @@ class TpuEngine:
             prev, self._inflight = self._inflight, w
             if prev is not None:
                 self._drain_window(prev)  # fetch overlaps w's execution
-            if not pipe:
+            if not pipe or not self._running:
+                # not self._running: every sequence finished during prev's
+                # drain — w is all zombie rows and nothing would ever wake
+                # the loop to fetch it (the idle predicate ignores
+                # _inflight), so release it now.
                 self._drain_inflight()
         else:
             self._decode_single_step()

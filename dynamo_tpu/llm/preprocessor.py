@@ -106,7 +106,14 @@ class OpenAIPreprocessor:
             seed=req.seed,
             frequency_penalty=getattr(req, "frequency_penalty", None) or 0.0,
             presence_penalty=getattr(req, "presence_penalty", None) or 0.0,
-            logprobs=bool(getattr(req, "logprobs", False)),
+            # Chat: logprobs is a bool. Completions: an int top-N where
+            # even 0 means "return chosen-token logprobs with 0
+            # alternatives" (OpenAI semantics), so presence enables it.
+            logprobs=(
+                getattr(req, "logprobs", None) is not None
+                if isinstance(req, CompletionRequest)
+                else bool(getattr(req, "logprobs", False))
+            ),
         )
         # Budget: explicit max_tokens, else whatever fits in context.
         budget = self.card.context_length - len(token_ids)

@@ -384,12 +384,20 @@ class CompletionRequest:
         max_tokens = d.get("max_tokens")
         if max_tokens is not None and (not isinstance(max_tokens, int) or max_tokens < 1):
             raise OpenAIError("'max_tokens' must be a positive integer")
+        # OpenAI completions: int top-N (0 still returns chosen-token
+        # logprobs). Chat-style booleans from confused clients are
+        # normalized: false → off, true → 0 (chosen token only).
+        logprobs = d.get("logprobs")
+        if isinstance(logprobs, bool):
+            logprobs = 0 if logprobs else None
+        elif logprobs is not None and (not isinstance(logprobs, int) or logprobs < 0):
+            raise OpenAIError("'logprobs' must be a non-negative integer")
         ext = d.get("nvext") or d.get("ext") or {}
         return cls(
             model=model,
             prompt=prompt,
             stream=bool(d.get("stream", False)),
-            logprobs=d.get("logprobs"),
+            logprobs=logprobs,
             max_tokens=max_tokens,
             temperature=_opt_float(d, "temperature", 0.0, 2.0),
             top_p=_opt_float(d, "top_p", 0.0, 1.0),

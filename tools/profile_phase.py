@@ -1,0 +1,262 @@
+"""Per-phase decode breakdown at the bench geometry (VERDICT r4 next #1).
+
+Attributes the per-step decode time of the 8B int8 single-chip bench to
+its phases so the gap between measured tok/s and the weight-bandwidth
+roofline is explainable:
+
+  membw        achieved HBM bandwidth ceiling (big-copy)
+  window       full multi_decode window, exactly as the engine runs it
+  weights      matmul+norm+logits only (no attention, no cache traffic)
+  attn         KV scatter + paged attention over all layers only
+  scatter      KV cache scatter only
+  logits       final logits matmul only
+
+Each phase is wrapped in a lax.scan of --decode-steps substeps like the
+real window, so dispatch overhead amortizes identically. Run with
+different --block-size / --attn-impl to answer the page-size question
+(ops/paged_attention.py says prefer >=32KB pages).
+
+Usage (real chip):
+  python tools/profile_phase.py --phases membw,weights,window
+  python tools/profile_phase.py --block-size 64 --phases window,attn
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timed_carry(fn, carry, iters=8, warmup=2):
+    """fn: carry -> carry (donated). Returns s/iter."""
+    for _ in range(warmup):
+        carry = fn(carry)
+    jax.block_until_ready(carry)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = fn(carry)
+    jax.block_until_ready(carry)
+    return (time.perf_counter() - t0) / iters, carry
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama-8b")
+    p.add_argument("--batch", type=int, default=40)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-kv-blocks", type=int, default=0, help="0 = auto (~5.5GB pool)")
+    p.add_argument("--table-blocks", type=int, default=0, help="0 = auto (~1136 tokens)")
+    p.add_argument("--seq-tokens", type=int, default=250, help="live context per row")
+    p.add_argument("--decode-steps", type=int, default=32)
+    p.add_argument("--iters", type=int, default=8)
+    p.add_argument("--attn-impl", default="pallas", choices=["pallas", "xla"])
+    p.add_argument("--phases", default="membw,weights,window",
+                   help="comma list: membw,window,weights,attn,scatter,logits")
+    p.add_argument("--cpu", action="store_true")
+    args = p.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      __file__.rsplit("/tools/", 1)[0] + "/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.quant import random_int8_params
+
+    cfg = ModelConfig.preset(args.model) if not args.cpu else ModelConfig.preset("test-tiny")
+    bs = args.block_size
+    B, K = args.batch, args.decode_steps
+    W = args.table_blocks or (1136 // bs + 1)
+    N = args.num_kv_blocks or max(int(5.5e9 // (2 * cfg.num_layers * bs * cfg.kv_size * 2)), B * W + 1)
+    phases = set(args.phases.split(","))
+    dtype = jnp.float32 if args.cpu else jnp.bfloat16
+    print(f"device={jax.devices()[0]} model={cfg.name} B={B} W={W} bs={bs} N={N} "
+          f"K={K} attn={args.attn_impl} ctx={args.seq_tokens}")
+
+    if args.cpu:
+        params = M.init_params(cfg, jax.random.PRNGKey(0), dtype)
+    else:
+        params = jax.tree.map(jnp.asarray, random_int8_params(cfg, 0))
+    weight_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    print(f"param bytes={weight_bytes/1e9:.2f} GB  "
+          f"weight roofline: {weight_bytes/819e9*1e3:.2f} ms/step")
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size - 1, size=B).astype(np.int32))
+    pos_val = min(args.seq_tokens, (W - 2) * bs)
+    positions = jnp.full((B,), pos_val, jnp.int32)
+    # Distinct pages per row (rows own disjoint blocks, like the pool).
+    need = B * W
+    perm = rng.permutation(np.arange(1, max(N, need + 1)))[:need]
+    tables = jnp.asarray(perm.reshape(B, W).astype(np.int32))
+    active = jnp.ones((B,), bool)
+    zf = jnp.zeros((B,), jnp.float32)
+    zi = jnp.zeros((B,), jnp.int32)
+    ones = jnp.ones((B,), jnp.float32)
+    seeds = jnp.zeros((B,), jnp.uint32)
+    pen = jnp.full((B, 1), -1, jnp.int32)
+
+    def report(name, t, extra=""):
+        print(f"{name:10s} {t*1e3:9.3f} ms/step   {B*1.0/t:9.0f} tok/s(step-norm) {extra}")
+
+    # -- membw ceiling ------------------------------------------------------
+    if "membw" in phases:
+        big = jnp.zeros((128, 1024, 1024), dtype)  # 256 MB bf16
+        add1 = jax.jit(lambda x: x + 1, donate_argnums=0)
+        t, big = timed_carry(add1, big, iters=16)
+        print(f"membw: copy 2x{big.nbytes/1e9:.2f} GB in {t*1e3:.2f} ms → "
+              f"{2*big.nbytes/t/1e9:.0f} GB/s achieved")
+        del big
+
+    # -- full window (the real engine dispatch) -----------------------------
+    if "window" in phases:
+        cache = M.init_kv_cache(cfg, N, bs, dtype)
+
+        def window(carry):
+            c, tok = carry
+            toks, _lp, c = M.multi_decode_impl(
+                cfg, K, "greedy", params, c, tok, positions, tables, active,
+                ones, seeds, zi, zi, ones, zf, zf, pen,
+                attn_impl=args.attn_impl)
+            return (c, toks[-1])
+
+        jw = jax.jit(window, donate_argnums=0)
+        t, carry = timed_carry(jw, (cache, tokens + 0), iters=args.iters)
+        report("window", t / K, f"({t*1e3:.1f} ms/window)")
+        del carry, cache
+
+    # -- weights only: matmuls + norms + logits, no cache/attention ---------
+    if "weights" in phases:
+        def weights_step(carry):
+            x0, = carry
+
+            def substep(x, _):
+                h = M._embed_rows(params, tokens, dtype)
+
+                def layer(hx, lp):
+                    a = M._rms_norm(hx, lp["attn_norm"], cfg.rms_norm_eps)
+                    q = M._dot_q(a, lp, "wq")
+                    k = M._dot_q(a, lp, "wk")
+                    v = M._dot_q(a, lp, "wv")
+                    o = q + jnp.pad(k, ((0, 0), (0, cfg.q_size - cfg.kv_size))) \
+                          + jnp.pad(v, ((0, 0), (0, cfg.q_size - cfg.kv_size)))
+                    hx = hx + M._dot_q(o, lp, "wo")
+                    m = M._rms_norm(hx, lp["mlp_norm"], cfg.rms_norm_eps)
+                    return hx + M._mlp(m, lp), None
+
+                h, _ = lax.scan(layer, h, params["layers"])
+                lg = M._logits(cfg, params, h)
+                return x + jnp.argmax(lg, -1).astype(jnp.int32), None
+
+            x0, _ = lax.scan(substep, x0, None, length=K)
+            return (x0,)
+
+        t, _ = timed_carry(jax.jit(weights_step), (jnp.zeros((B,), jnp.int32),),
+                           iters=args.iters)
+        report("weights", t / K)
+
+    # -- attention only: scatter + paged attention over all layers ----------
+    if "attn" in phases:
+        from dynamo_tpu.ops.paged_attention import (
+            paged_decode_attention, paged_decode_attention_xla)
+
+        cache = M.init_kv_cache(cfg, N, bs, dtype)
+        G = cfg.num_heads // cfg.num_kv_heads
+        blk = tables[jnp.arange(B), positions // bs]
+        off = positions % bs
+        lengths = positions + 1
+
+        def attn_step(carry):
+            kc, vc, acc = carry
+
+            def substep(cr, _):
+                kc, vc, acc = cr
+                kv = jnp.broadcast_to(acc[:, : cfg.kv_size], (B, cfg.kv_size))
+                q = jnp.broadcast_to(
+                    acc[:, None, None, :cfg.head_dim],
+                    (B, cfg.num_kv_heads, G, cfg.head_dim))
+
+                def layer(c2, li):
+                    kc, vc, acc = c2
+                    kc = kc.at[li, blk, off].set(kv)
+                    vc = vc.at[li, blk, off].set(kv)
+                    if args.attn_impl == "xla":
+                        o = paged_decode_attention_xla(q, kc, vc, li, tables, lengths)
+                    else:
+                        o = paged_decode_attention(q, kc, vc, li, tables, lengths)
+                    return (kc, vc, acc + o.reshape(B, cfg.q_size)), None
+
+                (kc, vc, acc), _ = lax.scan(
+                    layer, (kc, vc, acc),
+                    jnp.arange(cfg.num_layers, dtype=jnp.int32))
+                return (kc, vc, acc), None
+
+            (kc, vc, acc), _ = lax.scan(substep, (kc, vc, acc), None, length=K)
+            return kc, vc, acc
+
+        acc0 = jnp.zeros((B, cfg.q_size), dtype)
+        t, carry = timed_carry(jax.jit(attn_step, donate_argnums=0),
+                               (cache.k, cache.v, acc0), iters=args.iters)
+        kv_bytes = 2 * cfg.num_layers * int(pos_val) * cfg.kv_size * 2 * B
+        report("attn", t / K, f"(live KV {kv_bytes/1e9:.2f} GB → {kv_bytes/(t/K)/1e9:.0f} GB/s)")
+        del carry, cache
+
+    # -- scatter only -------------------------------------------------------
+    if "scatter" in phases:
+        cache = M.init_kv_cache(cfg, N, bs, dtype)
+        blk = tables[jnp.arange(B), positions // bs]
+        off = positions % bs
+        kv = jnp.zeros((B, cfg.kv_size), dtype)
+
+        def scatter_step(carry):
+            kc, vc = carry
+
+            def substep(cr, _):
+                kc, vc = cr
+
+                def layer(c2, li):
+                    kc, vc = c2
+                    kc = kc.at[li, blk, off].set(kv)
+                    vc = vc.at[li, blk, off].set(kv)
+                    return (kc, vc), None
+
+                (kc, vc), _ = lax.scan(layer, (kc, vc),
+                                       jnp.arange(cfg.num_layers, dtype=jnp.int32))
+                return (kc, vc), None
+
+            (kc, vc), _ = lax.scan(substep, (kc, vc), None, length=K)
+            return kc, vc
+
+        t, carry = timed_carry(jax.jit(scatter_step, donate_argnums=0),
+                               (cache.k, cache.v), iters=args.iters)
+        report("scatter", t / K)
+        del carry, cache
+
+    # -- logits only --------------------------------------------------------
+    if "logits" in phases:
+        x = jnp.zeros((B, cfg.hidden_size), dtype)
+
+        def logits_step(carry):
+            x, = carry
+
+            def substep(h, _):
+                lg = M._logits(cfg, params, h)
+                return h + lg[:, : cfg.hidden_size].astype(h.dtype) * 0, None
+
+            x, _ = lax.scan(substep, x, None, length=K)
+            return (x,)
+
+        t, _ = timed_carry(jax.jit(logits_step), (x,), iters=args.iters)
+        report("logits", t / K)
+
+
+if __name__ == "__main__":
+    main()
