@@ -77,6 +77,11 @@ class OpenAIClient:
         body = {"model": model or self.default_model, "prompt": prompt, **kw}
         return await self._post_json("/v1/completions", body)
 
+    async def responses(self, input: Any, model: str | None = None, **kw) -> dict:
+        """POST /v1/responses (unary). `input`: string or message list."""
+        body = {"model": model or self.default_model, "input": input, **kw}
+        return await self._post_json("/v1/responses", body)
+
     async def embeddings(self, input: Any, model: str | None = None) -> dict:
         body = {"model": model or self.default_model, "input": input}
         return await self._post_json("/v1/embeddings", body)

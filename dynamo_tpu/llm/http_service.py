@@ -25,8 +25,14 @@ from dynamo_tpu.llm.protocols import (
     ChatCompletionRequest,
     CompletionRequest,
     OpenAIError,
+    ResponsesRequest,
+    gen_request_id,
     model_list,
+    responses_body,
+    responses_message_item,
+    responses_usage,
     sse_event,
+    sse_typed_event,
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import current_trace, get_logger
@@ -65,6 +71,7 @@ class HttpService:
         app = web.Application()
         app.router.add_post("/v1/chat/completions", self.handle_chat)
         app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_post("/v1/responses", self.handle_responses)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_get("/health", self.handle_health)
         app.router.add_get("/live", self.handle_live)
@@ -189,8 +196,18 @@ class HttpService:
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._handle_inference(request, "completion")
 
+    async def handle_responses(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle_inference(request, "responses")
+
+    _PARSERS = {
+        "chat": ChatCompletionRequest.parse,
+        "completion": CompletionRequest.parse,
+        "responses": ResponsesRequest.parse,
+    }
+    _ENDPOINT_LABEL = {"chat": "chat", "completion": "completions", "responses": "responses"}
+
     async def _handle_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
-        endpoint = "chat" if kind == "chat" else "completions"
+        endpoint = self._ENDPOINT_LABEL[kind]
         model = "unknown"
         t0 = time.perf_counter()
         try:
@@ -198,7 +215,7 @@ class HttpService:
                 body = await request.json()
             except (json.JSONDecodeError, UnicodeDecodeError):
                 raise OpenAIError("request body must be valid JSON") from None
-            req = ChatCompletionRequest.parse(body) if kind == "chat" else CompletionRequest.parse(body)
+            req = self._PARSERS[kind](body)
             model = req.model
             pipe = self.manager.get(req.model)
             if pipe is None:
@@ -207,6 +224,10 @@ class HttpService:
             ctx = Context(trace=current_trace())
             with InflightGuard(self.m_inflight, model=model):
                 try:
+                    if kind == "responses":
+                        if req.stream:
+                            return await self._responses_stream(request, pipe, req, ctx, model, t0)
+                        return await self._responses_aggregate(pipe, req, ctx, model, t0)
                     if req.stream:
                         return await self._stream(request, pipe, req, ctx, model, endpoint, t0)
                     return await self._aggregate(pipe, req, ctx, model, endpoint, t0)
@@ -301,6 +322,174 @@ class HttpService:
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 await resp.write(SSE_DONE)
                 await resp.write_eof()
+        return resp
+
+    # -- /v1/responses (OpenAI Responses API) ------------------------------
+    #
+    # Reference parity: lib/llm/src/http/service/openai.rs:584-850 — the
+    # reference converts to chat completions and serves unary only; here
+    # the streaming path emits the full typed event sequence too.
+
+    @staticmethod
+    def _responses_status(finish_reason: str | None) -> tuple[str, str | None]:
+        """finish_reason → (response status, incomplete reason)."""
+        if finish_reason == "length":
+            return "incomplete", "max_output_tokens"
+        return "completed", None
+
+    async def _responses_aggregate(
+        self, pipe, req: ResponsesRequest, ctx: Context, model: str, t0: float
+    ) -> web.Response:
+        gen = None
+        first = True
+        t_first_tok = t_last_tok = None
+        async for g, _chunk in pipe.run(req.to_chat(), ctx):
+            gen = g
+            t_last_tok = time.perf_counter()
+            if first:
+                first = False
+                t_first_tok = t_last_tok
+                self.m_ttft.observe(time.perf_counter() - t0, model=model)
+        assert gen is not None
+        self.m_output_tokens.inc(gen.completion_tokens, model=model)
+        if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
+            self.m_itl.observe(
+                (t_last_tok - t_first_tok) / (gen.completion_tokens - 1), model=model
+            )
+        status, why = self._responses_status(gen.finish_reason)
+        body = responses_body(
+            gen_request_id("resp"), model, gen.created, status=status,
+            output=[responses_message_item(gen_request_id("msg"), "".join(gen.text_parts))],
+            usage=responses_usage(gen.prompt_tokens, gen.completion_tokens),
+            incomplete_reason=why, req=req,
+        )
+        self.m_requests.inc(model=model, endpoint="responses", status="200")
+        return web.json_response(body)
+
+    async def _responses_stream(
+        self, request: web.Request, pipe, req: ResponsesRequest, ctx: Context,
+        model: str, t0: float
+    ) -> web.StreamResponse:
+        """Typed Responses event stream: created → in_progress →
+        output_item.added → content_part.added → output_text.delta* →
+        output_text.done → content_part.done → output_item.done →
+        completed/incomplete."""
+        stream = pipe.run(req.to_chat(), ctx).__aiter__()
+        try:
+            head = await stream.__anext__()
+        except StopAsyncIteration:
+            head = None
+
+        resp_id = gen_request_id("resp")
+        item_id = gen_request_id("msg")
+        created = int(time.time())
+        seq = 0
+
+        resp = web.StreamResponse(status=200, headers={
+            "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+
+        disconnected = False
+
+        async def emit(event: str, payload: dict) -> bool:
+            nonlocal seq, disconnected
+            payload = {"type": event, **payload, "sequence_number": seq}
+            seq += 1
+            try:
+                await resp.write(sse_typed_event(event, json.dumps(payload)))
+                return True
+            except (ConnectionResetError, ConnectionError):
+                disconnected = True
+                ctx.cancel()
+                log.info("client disconnected mid-stream (%s)", ctx.id)
+                return False
+
+        snapshot = responses_body(resp_id, model, created, status="in_progress", req=req)
+        ok = await emit("response.created", {"response": snapshot})
+        ok = ok and await emit("response.in_progress", {"response": snapshot})
+        ok = ok and await emit("response.output_item.added", {
+            "output_index": 0,
+            "item": responses_message_item(item_id, "", status="in_progress"),
+        })
+        ok = ok and await emit("response.content_part.added", {
+            "item_id": item_id, "output_index": 0, "content_index": 0,
+            "part": {"type": "output_text", "text": "", "annotations": []},
+        })
+
+        gen = None
+        first = True
+        failed = False
+        t_first_tok = t_last_tok = None
+        try:
+            while ok and head is not None:
+                g, chunk = head
+                gen = g
+                if chunk is not None:
+                    delta = (chunk.get("choices") or [{}])[0].get("delta", {}).get("content")
+                    if delta:
+                        t_last_tok = time.perf_counter()
+                        if first:
+                            first = False
+                            t_first_tok = t_last_tok
+                            self.m_ttft.observe(time.perf_counter() - t0, model=model)
+                        ok = await emit("response.output_text.delta", {
+                            "item_id": item_id, "output_index": 0,
+                            "content_index": 0, "delta": delta,
+                        })
+                try:
+                    head = await stream.__anext__()
+                except StopAsyncIteration:
+                    head = None
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — mid-stream failure → error event
+            failed = True
+            if not isinstance(e, OpenAIError):
+                log.exception("responses stream failed mid-flight (%s)", ctx.id)
+            err = e if isinstance(e, OpenAIError) else OpenAIError(
+                "stream failed", status=500, err_type="internal_error")
+            self.m_requests.inc(model=model, endpoint="responses", status=str(err.status))
+            with contextlib.suppress(ConnectionResetError, ConnectionError):
+                # Responses typed-event error shape (emit injects
+                # type+sequence_number), not the chat-SSE error body.
+                await emit("error", {"code": err.err_type, "message": str(err),
+                                     "param": None})
+                await resp.write_eof()
+        if gen is not None:
+            self.m_output_tokens.inc(gen.completion_tokens, model=model)
+            if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
+                self.m_itl.observe(
+                    (t_last_tok - t_first_tok) / (gen.completion_tokens - 1),
+                    model=model,
+                )
+        if ok and not disconnected and not failed and gen is not None:
+            text = "".join(gen.text_parts)
+            status, why = self._responses_status(gen.finish_reason)
+            ok = await emit("response.output_text.done", {
+                "item_id": item_id, "output_index": 0, "content_index": 0,
+                "text": text,
+            })
+            ok = ok and await emit("response.content_part.done", {
+                "item_id": item_id, "output_index": 0, "content_index": 0,
+                "part": {"type": "output_text", "text": text, "annotations": []},
+            })
+            ok = ok and await emit("response.output_item.done", {
+                "output_index": 0,
+                "item": responses_message_item(item_id, text),
+            })
+            final = responses_body(
+                resp_id, model, created, status=status,
+                output=[responses_message_item(item_id, text)],
+                usage=responses_usage(gen.prompt_tokens, gen.completion_tokens),
+                incomplete_reason=why, req=req,
+            )
+            event = "response.completed" if status == "completed" else "response.incomplete"
+            ok = ok and await emit(event, {"response": final})
+            if ok and not disconnected:
+                self.m_requests.inc(model=model, endpoint="responses", status="200")
+                with contextlib.suppress(ConnectionResetError, ConnectionError):
+                    await resp.write_eof()
         return resp
 
     async def _aggregate(

@@ -14,6 +14,7 @@ from __future__ import annotations
 import time
 import uuid
 from dataclasses import dataclass, field
+from typing import ClassVar
 from enum import Enum
 from typing import Any, Iterable
 
@@ -412,6 +413,199 @@ class CompletionRequest:
         )
 
 
+@dataclass
+class ResponsesRequest:
+    """Parsed+validated POST /v1/responses body (OpenAI Responses API).
+
+    Reference parity: lib/llm/src/http/service/openai.rs:584-850 converts
+    the request to chat completions and serves it unary-only (":TODO:
+    handle streaming"); here streaming is served too. Text-only input;
+    agentic fields (tools, previous_response_id, background, include)
+    are rejected with 501 like the reference's
+    validate_response_unsupported_fields (openai.rs:739). Unlike the
+    reference, `instructions` IS supported — it is just a leading system
+    message."""
+
+    model: str
+    messages: list[ChatMessage]          # converted from `input` (+instructions)
+    stream: bool = False
+    max_output_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    seed: int | None = None
+    instructions: str | None = None
+    raw: dict[str, Any] = field(default_factory=dict)
+
+    _UNSUPPORTED = (
+        "background", "include", "max_tool_calls", "parallel_tool_calls",
+        "previous_response_id", "prompt", "reasoning", "service_tier",
+        "text", "tool_choice", "tools", "truncation",
+    )
+    # Values of "unsupported" fields that mean the same as omitting them
+    # (incl. everything responses_body echoes back, so a response's own
+    # fields round-trip into a new request).
+    _NOOP_VALUES: ClassVar[dict[str, tuple]] = {
+        "truncation": ("disabled",),
+        "tool_choice": ("none", "auto"),
+        "service_tier": ("auto", "default"),
+        "text": ({"format": {"type": "text"}},),
+    }
+
+    @classmethod
+    def parse(cls, d: Any) -> "ResponsesRequest":
+        if not isinstance(d, dict):
+            raise OpenAIError("request body must be a JSON object")
+        model = d.get("model")
+        if not model or not isinstance(model, str):
+            raise OpenAIError("'model' is required")
+        for key in cls._UNSUPPORTED:
+            v = d.get(key)
+            if v in (None, False) or v == [] or v == {}:
+                continue
+            if v in cls._NOOP_VALUES.get(key, ()):
+                continue
+            raise OpenAIError(
+                f"'{key}' is not supported", status=501,
+                err_type="not_implemented_error",
+            )
+        if d.get("store") is True:
+            raise OpenAIError("'store: true' is not supported (stateless service)",
+                              status=501, err_type="not_implemented_error")
+        instructions = d.get("instructions")
+        if instructions is not None and not isinstance(instructions, str):
+            raise OpenAIError("'instructions' must be a string")
+        messages: list[ChatMessage] = []
+        if instructions:
+            messages.append(ChatMessage(role="system", content=instructions))
+        messages.extend(cls._parse_input(d.get("input")))
+        max_out = d.get("max_output_tokens")
+        if max_out is not None and (not isinstance(max_out, int) or max_out < 1):
+            raise OpenAIError("'max_output_tokens' must be a positive integer")
+        return cls(
+            model=model,
+            messages=messages,
+            stream=bool(d.get("stream", False)),
+            max_output_tokens=max_out,
+            temperature=_opt_float(d, "temperature", 0.0, 2.0),
+            top_p=_opt_float(d, "top_p", 0.0, 1.0),
+            top_k=d.get("top_k"),
+            seed=d.get("seed"),
+            instructions=instructions,
+            raw=d,
+        )
+
+    @staticmethod
+    def _parse_input(raw: Any) -> list[ChatMessage]:
+        """`input`: a string (one user message) or a list of message items.
+        Text-only: content parts must be input_text/output_text."""
+        if isinstance(raw, str):
+            return [ChatMessage(role="user", content=raw)]
+        if not isinstance(raw, list) or not raw:
+            raise OpenAIError("'input' must be a string or a non-empty array")
+        out: list[ChatMessage] = []
+        for item in raw:
+            if not isinstance(item, dict):
+                raise OpenAIError("'input' items must be objects")
+            itype = item.get("type", "message")
+            if itype != "message":
+                raise OpenAIError(
+                    f"input item type {itype!r} is not supported (text-only)",
+                    status=501, err_type="not_implemented_error",
+                )
+            role = item.get("role")
+            if role not in ("user", "assistant", "system", "developer"):
+                raise OpenAIError("input message 'role' must be user/assistant/system/developer")
+            content = item.get("content")
+            if isinstance(content, list):
+                parts = []
+                for p in content:
+                    if not isinstance(p, dict) or p.get("type") not in (
+                        "input_text", "output_text", "text"
+                    ):
+                        raise OpenAIError(
+                            "only text content parts are supported",
+                            status=501, err_type="not_implemented_error",
+                        )
+                    parts.append(str(p.get("text", "")))
+                content = "".join(parts)
+            if not isinstance(content, str):
+                raise OpenAIError("input message 'content' must be a string or part list")
+            # `developer` is the Responses-era spelling of `system`.
+            out.append(ChatMessage(role="system" if role == "developer" else role,
+                                   content=content))
+        return out
+
+    def to_chat(self) -> ChatCompletionRequest:
+        return ChatCompletionRequest(
+            model=self.model,
+            messages=self.messages,
+            stream=self.stream,
+            max_tokens=self.max_output_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            seed=self.seed,
+            raw=self.raw,
+        )
+
+
+def responses_usage(prompt_tokens: int, completion_tokens: int) -> dict[str, Any]:
+    return {
+        "input_tokens": prompt_tokens,
+        "input_tokens_details": {"cached_tokens": 0},
+        "output_tokens": completion_tokens,
+        "output_tokens_details": {"reasoning_tokens": 0},
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def responses_message_item(item_id: str, text: str, status: str = "completed") -> dict[str, Any]:
+    return {
+        "type": "message", "id": item_id, "status": status, "role": "assistant",
+        "content": [{"type": "output_text", "text": text, "annotations": []}]
+        if status != "in_progress" else [],
+    }
+
+
+def responses_body(
+    response_id: str,
+    model: str,
+    created: int,
+    *,
+    status: str = "completed",
+    output: list[dict] | None = None,
+    usage: dict | None = None,
+    incomplete_reason: str | None = None,
+    req: "ResponsesRequest | None" = None,
+) -> dict[str, Any]:
+    """The Responses API response object (final or in-progress snapshot)."""
+    return {
+        "id": response_id,
+        "object": "response",
+        "created_at": created,
+        "status": status,
+        "error": None,
+        "incomplete_details": (
+            {"reason": incomplete_reason} if incomplete_reason else None
+        ),
+        "instructions": req.instructions if req else None,
+        "max_output_tokens": req.max_output_tokens if req else None,
+        "model": model,
+        "output": output or [],
+        "parallel_tool_calls": False,
+        "previous_response_id": None,
+        "store": False,
+        "temperature": req.temperature if req else None,
+        "top_p": req.top_p if req else None,
+        "tool_choice": "none",
+        "tools": [],
+        "truncation": "disabled",
+        "usage": usage,
+        "metadata": {},
+    }
+
+
 def gen_request_id(prefix: str = "chatcmpl") -> str:
     return f"{prefix}-{uuid.uuid4().hex}"
 
@@ -546,6 +740,13 @@ SSE_DONE = b"data: [DONE]\n\n"
 
 def sse_event(data: str) -> bytes:
     return f"data: {data}\n\n".encode()
+
+
+def sse_typed_event(event: str, data: str) -> bytes:
+    """Named SSE frame (`event:` + `data:`) — the Responses API stream
+    format (each semantic event carries its type both in the SSE field
+    and in the JSON payload)."""
+    return f"event: {event}\ndata: {data}\n\n".encode()
 
 
 def parse_sse_lines(chunks: Iterable[bytes]) -> Iterable[str]:

@@ -1,0 +1,177 @@
+"""/v1/responses endpoint (OpenAI Responses API).
+
+Reference parity: lib/llm/src/http/service/openai.rs:584-850 serves
+responses by converting to chat completions (unary only there; here the
+typed event stream is served too).
+"""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from dynamo_tpu.llm.protocols import OpenAIError, ResponsesRequest
+
+from test_frontend_e2e import start_frontend, start_worker
+
+pytestmark = pytest.mark.integration
+
+
+# -- request parsing (pure) -------------------------------------------------
+
+
+def test_parse_string_input_and_instructions():
+    req = ResponsesRequest.parse({
+        "model": "m", "input": "hi there",
+        "instructions": "be brief", "max_output_tokens": 9,
+        "temperature": 0.5,
+    })
+    assert [m.role for m in req.messages] == ["system", "user"]
+    assert req.messages[1].content == "hi there"
+    chat = req.to_chat()
+    assert chat.max_tokens == 9
+    assert chat.temperature == 0.5
+    assert chat.messages[0].content == "be brief"
+
+
+def test_parse_message_list_with_parts_and_developer_role():
+    req = ResponsesRequest.parse({
+        "model": "m",
+        "input": [
+            {"role": "developer", "content": "rules"},
+            {"role": "user", "content": [
+                {"type": "input_text", "text": "a"},
+                {"type": "input_text", "text": "b"},
+            ]},
+        ],
+    })
+    assert [m.role for m in req.messages] == ["system", "user"]
+    assert req.messages[1].content == "ab"
+
+
+@pytest.mark.parametrize("body,status", [
+    ({"model": "m", "input": "x", "tools": [{"type": "function"}]}, 501),
+    ({"model": "m", "input": "x", "previous_response_id": "r"}, 501),
+    ({"model": "m", "input": "x", "background": True}, 501),
+    ({"model": "m", "input": "x", "store": True}, 501),
+    ({"model": "m", "input": [{"role": "user", "content": [
+        {"type": "input_image", "image_url": "u"}]}]}, 501),
+    ({"model": "m"}, 400),
+    ({"model": "m", "input": []}, 400),
+    ({"input": "x"}, 400),
+])
+def test_parse_rejections(body, status):
+    with pytest.raises(OpenAIError) as ei:
+        ResponsesRequest.parse(body)
+    assert ei.value.status == status
+
+
+def test_parse_tolerates_explicit_null_and_empty_unsupported():
+    req = ResponsesRequest.parse({
+        "model": "m", "input": "x",
+        "tools": [], "previous_response_id": None, "background": False,
+    })
+    assert req.messages[0].content == "x"
+
+
+def test_parse_tolerates_documented_defaults():
+    """A response's own echoed fields must round-trip into a request."""
+    req = ResponsesRequest.parse({
+        "model": "m", "input": "x",
+        "truncation": "disabled", "tool_choice": "none",
+        "service_tier": "auto", "text": {"format": {"type": "text"}},
+        "store": False,
+    })
+    assert req.messages[0].content == "x"
+    with pytest.raises(OpenAIError):
+        ResponsesRequest.parse({"model": "m", "input": "x", "truncation": "auto"})
+
+
+# -- served endpoint (in-process mocker fleet) ------------------------------
+
+
+def test_responses_unary_and_streaming():
+    async def go():
+        url = "memory://resp1"
+        wrt, _eng = await start_worker(url)
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                # unary
+                r = await client.post(f"{base}/v1/responses", json={
+                    "model": "mock-model", "input": "hello responses",
+                    "max_output_tokens": 8,
+                })
+                assert r.status_code == 200
+                body = r.json()
+                assert body["object"] == "response"
+                assert body["status"] in ("completed", "incomplete")
+                item = body["output"][0]
+                assert item["type"] == "message" and item["role"] == "assistant"
+                assert item["content"][0]["type"] == "output_text"
+                assert len(item["content"][0]["text"]) > 0
+                assert body["usage"]["output_tokens"] == 8
+                assert body["usage"]["input_tokens"] > 0
+                assert body["usage"]["total_tokens"] == (
+                    body["usage"]["input_tokens"] + body["usage"]["output_tokens"]
+                )
+
+                # streaming: typed event sequence
+                events = []
+                async with client.stream(
+                    "POST", f"{base}/v1/responses",
+                    json={"model": "mock-model", "input": "hello responses",
+                          "max_output_tokens": 8, "stream": True},
+                ) as resp:
+                    assert resp.status_code == 200
+                    raw = b"".join([c async for c in resp.aiter_bytes()])
+                for frame in raw.split(b"\n\n"):
+                    ev = data = None
+                    for line in frame.split(b"\n"):
+                        if line.startswith(b"event: "):
+                            ev = line[7:].decode()
+                        elif line.startswith(b"data: "):
+                            data = json.loads(line[6:])
+                    if ev is not None:
+                        events.append((ev, data))
+                names = [e for e, _ in events]
+                assert names[:4] == [
+                    "response.created", "response.in_progress",
+                    "response.output_item.added", "response.content_part.added",
+                ]
+                assert "response.output_text.delta" in names
+                assert names[-4:] == [
+                    "response.output_text.done", "response.content_part.done",
+                    "response.output_item.done", names[-1],
+                ]
+                assert names[-1] in ("response.completed", "response.incomplete")
+                # sequence numbers are contiguous and payload types match
+                for i, (ev, data) in enumerate(events):
+                    assert data["sequence_number"] == i
+                    assert data["type"] == ev
+                # deltas concatenate to the final text
+                text = "".join(d["delta"] for e, d in events
+                               if e == "response.output_text.delta")
+                final = events[-1][1]["response"]
+                assert final["output"][0]["content"][0]["text"] == text
+                assert final["usage"]["output_tokens"] == 8
+
+                # 404 on unknown model
+                r = await client.post(f"{base}/v1/responses", json={
+                    "model": "nope", "input": "x"})
+                assert r.status_code == 404
+                # 501 on unsupported field
+                r = await client.post(f"{base}/v1/responses", json={
+                    "model": "mock-model", "input": "x",
+                    "previous_response_id": "resp_1"})
+                assert r.status_code == 501
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(go())
