@@ -175,14 +175,25 @@ def load_params(
         if tuple(leaf.shape) != shape:
             raise ValueError(f"{key}: checkpoint shape {tuple(leaf.shape)} != expected {shape}")
 
+    return finalize_params(params, dtype=dtype, sharding=sharding, quant=quant)
+
+
+def finalize_params(params: dict, dtype: Any = None, sharding=None, quant: str = "none"):
+    """Shared checkpoint tail (safetensors + GGUF): optional host-side
+    int8 quantization, serving-dtype conversion, sharded device placement.
+
+    Quantization happens HOST-side, pre-placement: an 8B bf16 staging
+    copy on device is exactly the OOM int8 exists to avoid."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(dtype or jnp.bfloat16)
     if quant == "int8":
-        # Quantize HOST-side, pre-placement: an 8B bf16 staging copy on
-        # device is exactly the OOM int8 exists to avoid.
         from dynamo_tpu.engine.quant import quantize_params_np
 
         params = quantize_params_np(params)
 
-    def place(leaf: np.ndarray, shard) -> jax.Array:
+    def place(leaf: np.ndarray, shard) -> Any:
         # int8 weights keep their dtype; everything else converts to the
         # serving dtype (scales included: bf16 scales are plenty).
         host = leaf if leaf.dtype == np.int8 else (
@@ -198,8 +209,31 @@ def load_params(
     return jax.tree.map(lambda x: place(x, None), params)
 
 
-def load_model(model_path: str, dtype: Any = None, sharding=None, quant: str = "none"):
-    """→ (ModelConfig, params) from a local HF checkpoint directory."""
+def load_config(name_or_path: str) -> ModelConfig:
+    """Config only (no weights): local HF dir, .gguf file, or hub name
+    (reference: local_model.rs config resolution)."""
+    from dynamo_tpu.engine.hub import is_gguf, resolve_model
+
+    path = resolve_model(name_or_path)
+    if is_gguf(path):
+        from dynamo_tpu.engine.gguf import GGUFFile
+
+        return GGUFFile(path).model_config()
+    return config_from_hf(path)
+
+
+def load_model(name_or_path: str, dtype: Any = None, sharding=None, quant: str = "none"):
+    """→ (ModelConfig, params). Accepts a local HF checkpoint directory,
+    a .gguf file, or an `org/repo` hub name (resolved through the HF hub
+    cache / downloaded when a downloader is available — engine/hub.py;
+    reference: hub.rs:126, gguf/)."""
+    from dynamo_tpu.engine.hub import is_gguf, resolve_model
+
+    model_path = resolve_model(name_or_path)
+    if is_gguf(model_path):
+        from dynamo_tpu.engine.gguf import load_gguf_model
+
+        return load_gguf_model(model_path, dtype=dtype, sharding=sharding, quant=quant)
     cfg = config_from_hf(model_path)
     params = load_params(model_path, cfg, dtype=dtype, sharding=sharding, quant=quant)
     n = cfg.param_count()

@@ -128,14 +128,63 @@ class HFTokenizer:
         return self._tok.get_vocab_size()
 
 
+class RawTokenizer:
+    """Wraps an in-memory `tokenizers.Tokenizer` (e.g. built from GGUF
+    metadata, engine/gguf.py) behind the framework Tokenizer protocol."""
+
+    def __init__(self, tok, eos_ids: list[int], special_ids: list[int] | None = None):
+        self._tok = tok
+        self._eos_ids = [int(i) for i in eos_ids]
+        for sid in special_ids or []:
+            t = tok.id_to_token(int(sid))
+            if t is not None:
+                try:
+                    from tokenizers import AddedToken
+
+                    tok.add_special_tokens([AddedToken(t, special=True)])
+                except Exception:  # noqa: BLE001 — decode still works unskipped
+                    pass
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text).ids
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    @property
+    def eos_token_ids(self) -> list[int]:
+        return list(self._eos_ids)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+
+def parse_tokenizer_spec(arg: str) -> dict:
+    """CLI string → tokenizer spec dict: "byte" | "hf:<path>" |
+    "gguf:<path>" (shared by the worker and run entrypoints)."""
+    if arg == "byte":
+        return {"type": "byte"}
+    if arg.startswith("hf:"):
+        return {"type": "hf", "path": arg[3:]}
+    if arg.startswith("gguf:"):
+        return {"type": "gguf", "path": arg[5:]}
+    raise SystemExit(f"unknown tokenizer spec {arg!r}")
+
+
 def load_tokenizer(spec: dict) -> Tokenizer:
     """Build a tokenizer from a ModelDeploymentCard tokenizer spec:
-    {"type": "byte"} or {"type": "hf", "path": "..."}."""
+    {"type": "byte"}, {"type": "hf", "path": ...}, or
+    {"type": "gguf", "path": ...}."""
     kind = spec.get("type", "byte")
     if kind == "byte":
         return ByteTokenizer(add_bos=bool(spec.get("add_bos", False)))
     if kind == "hf":
         return HFTokenizer(spec["path"])
+    if kind == "gguf":
+        from dynamo_tpu.engine.gguf import GGUFFile, tokenizer_from_gguf
+
+        return tokenizer_from_gguf(GGUFFile(spec["path"]))
     raise ValueError(f"unknown tokenizer type: {kind!r}")
 
 

@@ -21,7 +21,7 @@ from dynamo_tpu.llm.http_service import HttpService
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.pipeline import ModelPipeline
 from dynamo_tpu.llm.protocols import ChatCompletionRequest, CompletionRequest
-from dynamo_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer, parse_tokenizer_spec
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.metrics import MetricsRegistry
 
@@ -96,11 +96,14 @@ async def build_pipeline(args) -> LocalPipeline:
 
         params = None
         if args.model_path:
-            from dynamo_tpu.engine.loader import config_from_hf, load_model
+            from dynamo_tpu.engine.hub import is_gguf, resolve_model
+            from dynamo_tpu.engine.loader import load_model
 
+            args.model_path = resolve_model(args.model_path)
             model, params = load_model(args.model_path, args.dtype)
             if args.tokenizer == "byte":
-                args.tokenizer = f"hf:{args.model_path}"
+                prefix = "gguf:" if is_gguf(args.model_path) else "hf:"
+                args.tokenizer = prefix + args.model_path
         else:
             model = ModelConfig.preset(args.preset)
         engine = await TpuEngine(EngineArgs(
@@ -109,14 +112,11 @@ async def build_pipeline(args) -> LocalPipeline:
             max_model_len=args.max_model_len, dtype=args.dtype,
             decode_steps=args.decode_steps,
         ), params=params, seed=args.seed).start()
-        tokenizer = load_tokenizer(
-            {"type": "byte"} if args.tokenizer == "byte"
-            else {"type": "hf", "path": args.tokenizer[3:]}
-        )
+        tokenizer = load_tokenizer(parse_tokenizer_spec(args.tokenizer))
         name = model.name
     card = ModelDeploymentCard(
         name=name,
-        tokenizer={"type": "byte"} if args.tokenizer == "byte" else {"type": "hf", "path": args.tokenizer[3:]},
+        tokenizer=parse_tokenizer_spec(args.tokenizer),
         context_length=args.max_model_len,
         kv_cache_block_size=args.block_size,
         eos_token_ids=list(tokenizer.eos_token_ids) or [ByteTokenizer.EOS],
@@ -153,14 +153,21 @@ async def run_batch(args, pipe: LocalPipeline, path: str) -> None:
     with open(path) as f:
         lines = [ln.rstrip("\n") for ln in f if ln.strip()]
     for ln in lines:
+        obj: dict = {}
         try:
-            obj = json.loads(ln)
-            prompt = obj["prompt"] if isinstance(obj, dict) else str(obj)
+            parsed = json.loads(ln)
+            prompt = parsed["prompt"] if isinstance(parsed, dict) else str(parsed)
+            if isinstance(parsed, dict):
+                obj = parsed  # only dicts WITH a prompt contribute overrides
         except (json.JSONDecodeError, KeyError):
             prompt = ln
+        # Per-line sampling overrides win over the CLI defaults.
         req = CompletionRequest.parse({
             "model": pipe.card.name, "prompt": prompt,
-            "max_tokens": args.max_tokens, "temperature": args.temperature,
+            "max_tokens": obj.get("max_tokens", args.max_tokens),
+            "temperature": obj.get("temperature", args.temperature),
+            "top_p": obj.get("top_p"), "seed": obj.get("seed"),
+            "stop": obj.get("stop"),
         })
         gen = None
         async for g, _chunk in pipe.run(req, Context()):

@@ -93,18 +93,21 @@ def parse_args(argv=None):
     return args
 
 
-def tokenizer_spec(arg: str) -> dict:
-    if arg == "byte":
-        return {"type": "byte"}
-    if arg.startswith("hf:"):
-        return {"type": "hf", "path": arg[3:]}
-    raise SystemExit(f"unknown tokenizer spec {arg!r}")
+from dynamo_tpu.llm.tokenizer import parse_tokenizer_spec as tokenizer_spec
 
 
 async def build_engine(args):
     """→ (engine, model_card). Engine exposes .generate/.metrics/.pool."""
-    if args.model_path and args.tokenizer == "byte":
-        args.tokenizer = f"hf:{args.model_path}"
+    if args.model_path:
+        # Hub names (`org/repo`) and .gguf files resolve to local paths
+        # up front (engine/hub.py; reference: hub.rs:126) so every later
+        # consumer (tokenizer, loader, card) sees a concrete path.
+        from dynamo_tpu.engine.hub import is_gguf, resolve_model
+
+        args.model_path = resolve_model(args.model_path)
+        if args.tokenizer == "byte":
+            prefix = "gguf:" if is_gguf(args.model_path) else "hf:"
+            args.tokenizer = prefix + args.model_path
     tok_spec = tokenizer_spec(args.tokenizer)
     tokenizer = load_tokenizer(tok_spec)
     eos_ids = list(tokenizer.eos_token_ids)
@@ -130,12 +133,12 @@ async def build_engine(args):
         params = None
         sharding = None
         if args.model_path:
-            from dynamo_tpu.engine.loader import config_from_hf, load_model
+            from dynamo_tpu.engine.loader import load_config, load_model
 
             if args.tp > 1:
                 from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
 
-                hf_cfg = config_from_hf(args.model_path)
+                hf_cfg = load_config(args.model_path)
                 sharding = ModelSharding(build_mesh(tp=args.tp, cfg=hf_cfg), hf_cfg)
             model, params = await asyncio.to_thread(
                 load_model, args.model_path, args.dtype, sharding, args.quant
@@ -300,10 +303,10 @@ def run_follower(args) -> None:
     params = None
     sharding = None
     if args.model_path:
-        from dynamo_tpu.engine.loader import config_from_hf, load_model
+        from dynamo_tpu.engine.loader import load_config, load_model
         from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
 
-        model = config_from_hf(args.model_path)
+        model = load_config(args.model_path)
         if args.tp > 1:
             sharding = ModelSharding(build_mesh(tp=args.tp, cfg=model), model)
         model, params = load_model(args.model_path, args.dtype, sharding, args.quant)

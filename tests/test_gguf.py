@@ -1,0 +1,280 @@
+"""GGUF ingestion + hub resolution (engine/gguf.py, engine/hub.py).
+
+A GGUF *writer* lives in this test: it serializes the test-tiny model's
+params into a real GGUF v3 file (F32/F16/Q8_0 tensors + llama/gpt2
+tokenizer metadata), which the loader then ingests — parity is checked
+against the directly-built pytree, mirroring how test_loader.py checks
+safetensors against transformers.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import ModelConfig
+
+pytestmark = pytest.mark.unit
+
+# -- minimal GGUF v3 writer -------------------------------------------------
+
+_T_U32, _T_F32, _T_STRING, _T_ARRAY, _T_U64 = 4, 6, 8, 9, 10
+_ALIGN = 32
+
+
+def _w_str(out: bytearray, s: str):
+    b = s.encode()
+    out += struct.pack("<Q", len(b)) + b
+
+
+def _w_kv(out: bytearray, key: str, vtype: int, value):
+    _w_str(out, key)
+    out += struct.pack("<I", vtype)
+    if vtype == _T_STRING:
+        _w_str(out, value)
+    elif vtype == _T_U32:
+        out += struct.pack("<I", value)
+    elif vtype == _T_U64:
+        out += struct.pack("<Q", value)
+    elif vtype == _T_F32:
+        out += struct.pack("<f", value)
+    elif vtype == _T_ARRAY:
+        etype, vals = value
+        out += struct.pack("<IQ", etype, len(vals))
+        for v in vals:
+            if etype == _T_STRING:
+                _w_str(out, v)
+            elif etype == _T_F32:
+                out += struct.pack("<f", v)
+            else:
+                raise NotImplementedError
+    else:
+        raise NotImplementedError
+
+
+def _q8_0(a: np.ndarray) -> bytes:
+    """ggml Q8_0 encode: 32-elem blocks of f16 scale + 32 int8."""
+    flat = a.astype(np.float32).reshape(-1, 32)
+    d = np.abs(flat).max(axis=1) / 127.0
+    d = np.where(d == 0, 1.0, d)
+    qs = np.clip(np.rint(flat / d[:, None]), -127, 127).astype(np.int8)
+    out = bytearray()
+    for i in range(flat.shape[0]):
+        out += np.float16(d[i]).tobytes() + qs[i].tobytes()
+    return bytes(out)
+
+
+def write_gguf(path: str, metadata: list[tuple], tensors: dict[str, tuple]):
+    """tensors: name -> (np array in numpy shape, ggml_type)."""
+    head = bytearray(b"GGUF")
+    head += struct.pack("<I", 3)
+    head += struct.pack("<QQ", len(tensors), len(metadata))
+    for key, vtype, value in metadata:
+        _w_kv(head, key, vtype, value)
+    # tensor directory + data blobs (each tensor aligned to 32)
+    blobs = []
+    offset = 0
+    for name, (arr, gtype) in tensors.items():
+        if gtype == 0:
+            blob = np.ascontiguousarray(arr, np.float32).tobytes()
+        elif gtype == 1:
+            blob = np.ascontiguousarray(arr, np.float16).tobytes()
+        elif gtype == 8:
+            blob = _q8_0(np.ascontiguousarray(arr))
+        else:
+            raise NotImplementedError
+        _w_str(head, name)
+        dims = tuple(reversed(arr.shape))  # ggml: fastest axis first
+        head += struct.pack("<I", len(dims))
+        head += struct.pack(f"<{len(dims)}Q", *dims)
+        head += struct.pack("<IQ", gtype, offset)
+        pad = (-len(blob)) % _ALIGN
+        blobs.append(blob + b"\0" * pad)
+        offset += len(blob) + pad
+    pad = (-len(head)) % _ALIGN
+    with open(path, "wb") as f:
+        f.write(bytes(head) + b"\0" * pad + b"".join(blobs))
+
+
+def tiny_gguf(path: str, cfg: ModelConfig, params_np: dict, *,
+              quant_map: dict | None = None, tok_model: str = "llama"):
+    """Write cfg+params as a llama-arch GGUF with a tiny tokenizer."""
+    tokens = ["<unk>", "<s>", "</s>"] + [f"▁w{i}" for i in range(cfg.vocab_size - 3)]
+    meta = [
+        ("general.architecture", _T_STRING, "llama"),
+        ("general.name", _T_STRING, "tiny-gguf"),
+        ("llama.context_length", _T_U32, cfg.max_position),
+        ("llama.embedding_length", _T_U32, cfg.hidden_size),
+        ("llama.block_count", _T_U32, cfg.num_layers),
+        ("llama.feed_forward_length", _T_U32, cfg.intermediate_size),
+        ("llama.attention.head_count", _T_U32, cfg.num_heads),
+        ("llama.attention.head_count_kv", _T_U32, cfg.num_kv_heads),
+        ("llama.attention.key_length", _T_U32, cfg.head_dim),
+        ("llama.rope.freq_base", _T_F32, cfg.rope_theta),
+        ("llama.attention.layer_norm_rms_epsilon", _T_F32, cfg.rms_norm_eps),
+        ("llama.vocab_size", _T_U32, cfg.vocab_size),
+        ("tokenizer.ggml.model", _T_STRING, tok_model),
+        ("tokenizer.ggml.tokens", _T_ARRAY, (_T_STRING, tokens)),
+        ("tokenizer.ggml.scores", _T_ARRAY,
+         (_T_F32, [0.0] * 3 + [-float(i) for i in range(cfg.vocab_size - 3)])),
+        ("tokenizer.ggml.unknown_token_id", _T_U32, 0),
+        ("tokenizer.ggml.bos_token_id", _T_U32, 1),
+        ("tokenizer.ggml.eos_token_id", _T_U32, 2),
+    ]
+    quant_map = quant_map or {}
+    tensors: dict[str, tuple] = {
+        "token_embd.weight": (params_np["embed"], quant_map.get("token_embd.weight", 0)),
+        "output_norm.weight": (params_np["final_norm"], 0),
+    }
+    lmap = {
+        "attn_q": ("wq", True), "attn_k": ("wk", True), "attn_v": ("wv", True),
+        "attn_output": ("wo", True), "ffn_gate": ("w_gate", True),
+        "ffn_up": ("w_up", True), "ffn_down": ("w_down", True),
+        "attn_norm": ("attn_norm", False), "ffn_norm": ("mlp_norm", False),
+    }
+    for i in range(cfg.num_layers):
+        for gname, (ours, tr) in lmap.items():
+            a = params_np["layers"][ours][i]
+            name = f"blk.{i}.{gname}.weight"
+            tensors[name] = (a.T if tr else a, quant_map.get(gname, 0))
+    if not cfg.tie_embeddings:
+        tensors["output.weight"] = (params_np["lm_head"].T, 0)
+    write_gguf(path, meta, tensors)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup(tmp_path_factory):
+    import jax
+
+    from dynamo_tpu.engine import model as M
+
+    cfg = ModelConfig.preset("test-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(3), np.float32)
+    params_np = jax.tree.map(np.asarray, params)
+    path = str(tmp_path_factory.mktemp("gguf") / "tiny.gguf")
+    tiny_gguf(path, cfg, params_np)
+    return cfg, params_np, path
+
+
+def test_metadata_to_model_config(tiny_setup):
+    from dynamo_tpu.engine.gguf import GGUFFile
+
+    cfg, _params, path = tiny_setup
+    g = GGUFFile(path)
+    got = g.model_config()
+    assert got.vocab_size == cfg.vocab_size
+    assert got.hidden_size == cfg.hidden_size
+    assert got.num_layers == cfg.num_layers
+    assert got.num_heads == cfg.num_heads
+    assert got.num_kv_heads == cfg.num_kv_heads
+    assert got.head_dim == cfg.head_dim
+    assert got.rope_theta == pytest.approx(cfg.rope_theta)
+    assert got.tie_embeddings  # no output.weight written for test-tiny
+    assert g.eos_token_ids() == [2]
+
+
+def test_tensor_parity_f32(tiny_setup):
+    from dynamo_tpu.engine.gguf import load_gguf_params
+
+    cfg, params_np, path = tiny_setup
+    from dynamo_tpu.engine.gguf import GGUFFile
+
+    loaded = load_gguf_params(GGUFFile(path), cfg, dtype=np.float32)
+    np.testing.assert_array_equal(np.asarray(loaded["embed"]), params_np["embed"])
+    for key in ("wq", "wo", "w_down", "attn_norm"):
+        np.testing.assert_array_equal(
+            np.asarray(loaded["layers"][key]), params_np["layers"][key]
+        )
+
+
+def test_logit_parity_via_load_model(tiny_setup):
+    """End-to-end: loader.load_model on a .gguf path → same logits as the
+    directly-built params (golden-parity shape of test_loader.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.loader import load_model
+
+    cfg, params_np, path = tiny_setup
+    got_cfg, got_params = load_model(path, dtype=np.float32)
+    assert got_cfg.hidden_size == cfg.hidden_size
+    toks = np.array([5, 9, 17, 3], np.int32)
+    cache = M.init_kv_cache(cfg, 8, 4, jnp.float32)
+    table = np.array([1, 2, 3, 4], np.int32)
+    lg1, _ = M.prefill(cfg, jax.tree.map(jnp.asarray, params_np),
+                       cache, jnp.asarray(toks), jnp.asarray(table),
+                       jnp.int32(0), jnp.int32(4))
+    cache2 = M.init_kv_cache(cfg, 8, 4, jnp.float32)
+    lg2, _ = M.prefill(got_cfg, got_params, cache2, jnp.asarray(toks),
+                       jnp.asarray(table), jnp.int32(0), jnp.int32(4))
+    np.testing.assert_allclose(np.asarray(lg1), np.asarray(lg2), atol=1e-5)
+
+
+def test_quantized_tensors_dequantize(tiny_setup, tmp_path):
+    from dynamo_tpu.engine.gguf import GGUFFile
+
+    cfg, params_np, _ = tiny_setup
+    path = str(tmp_path / "q.gguf")
+    tiny_gguf(path, cfg, params_np,
+              quant_map={"ffn_up": 8, "attn_q": 1})  # Q8_0 + F16
+    g = GGUFFile(path)
+    up = g.tensor("blk.0.ffn_up.weight")
+    ref = params_np["layers"]["w_up"][0].T
+    assert up.shape == ref.shape
+    # Q8_0 is lossy: per-32-block scale quantization, ~1% of absmax
+    assert np.max(np.abs(up - ref)) <= np.abs(ref).max() / 64
+    q = g.tensor("blk.0.attn_q.weight")
+    np.testing.assert_allclose(q, params_np["layers"]["wq"][0].T, atol=1e-3)
+
+
+def test_unsupported_ggml_type_rejected(tiny_setup, tmp_path):
+    import struct as _s
+
+    from dynamo_tpu.engine.gguf import GGUFFile
+
+    cfg, params_np, path = tiny_setup
+    g = GGUFFile(path)
+    # Forge a directory entry with an unsupported type id.
+    g.tensors["token_embd.weight"].ggml_type = 2  # Q4_0
+    with pytest.raises(NotImplementedError, match="re-export"):
+        g.tensor("token_embd.weight")
+
+
+def test_tokenizer_llama_and_gpt2(tiny_setup, tmp_path):
+    from dynamo_tpu.engine.gguf import GGUFFile, tokenizer_from_gguf
+
+    cfg, params_np, path = tiny_setup
+    tok = tokenizer_from_gguf(GGUFFile(path))
+    ids = tok.encode("w1 w2")
+    assert ids and all(0 <= i < cfg.vocab_size for i in ids)
+    assert ids[0] == 1  # SentencePiece llama convention: BOS prepended
+    assert "w1" in tok.decode(ids)
+    assert tok.eos_token_ids == [2]
+    assert tok.vocab_size == cfg.vocab_size
+
+
+def test_hub_cache_resolution(tmp_path, monkeypatch):
+    from dynamo_tpu.engine.hub import hub_cache_dir, resolve_model
+
+    monkeypatch.setenv("HF_HUB_CACHE", str(tmp_path / "hub"))
+    monkeypatch.setenv("HF_HUB_OFFLINE", "1")  # zero-egress: never download
+    assert hub_cache_dir() == str(tmp_path / "hub")
+    snap = tmp_path / "hub" / "models--acme--tiny" / "snapshots" / "abc123"
+    snap.mkdir(parents=True)
+    (snap / "config.json").write_text("{}")
+    refs = tmp_path / "hub" / "models--acme--tiny" / "refs"
+    refs.mkdir()
+    (refs / "main").write_text("abc123")
+
+    assert resolve_model("acme/tiny") == str(snap)
+    # revision pinning
+    assert resolve_model("acme/tiny", revision="abc123") == str(snap)
+    # local paths pass through untouched
+    assert resolve_model(str(snap)) == str(snap)
+    # unknown name → remediation error (no downloader in this image)
+    with pytest.raises(FileNotFoundError, match="hub cache"):
+        resolve_model("acme/absent")
+    with pytest.raises(FileNotFoundError, match="org/repo"):
+        resolve_model("/no/such/path")
