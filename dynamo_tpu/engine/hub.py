@@ -36,11 +36,17 @@ def hub_cache_dir() -> str:
 
 
 def _cached_snapshot(name: str, revision: str | None = None) -> str | None:
-    """→ snapshot dir for a cached `org/repo`, or None."""
+    """→ snapshot dir for a cached `org/repo`, or None.
+
+    A pinned `revision` either resolves exactly or fails — silently
+    serving different weights than pinned is never acceptable. The
+    any-snapshot fallback applies only when nothing was pinned and the
+    cache has no refs/main."""
     repo_dir = os.path.join(hub_cache_dir(), "models--" + name.replace("/", "--"))
     snaps = os.path.join(repo_dir, "snapshots")
     if not os.path.isdir(snaps):
         return None
+    pinned = revision is not None
     if revision is None:
         # refs/main records the snapshot commit the way the hub cache does.
         ref = os.path.join(repo_dir, "refs", "main")
@@ -51,8 +57,14 @@ def _cached_snapshot(name: str, revision: str | None = None) -> str | None:
         cand = os.path.join(snaps, revision)
         if os.path.isdir(cand):
             return cand
+        if pinned:
+            raise FileNotFoundError(
+                f"{name}@{revision} is not in the hub cache ({snaps}) — "
+                f"cached snapshots: {sorted(os.listdir(snaps))}"
+            )
+        log.warning("%s: refs/main points at missing snapshot %s", name, revision)
     commits = os.listdir(snaps)
-    if commits:  # fall back to any snapshot (newest mtime)
+    if commits:  # nothing pinned: any snapshot (newest mtime)
         commits.sort(key=lambda c: os.path.getmtime(os.path.join(snaps, c)))
         return os.path.join(snaps, commits[-1])
     return None

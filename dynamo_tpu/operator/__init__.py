@@ -1,0 +1,1 @@
+"""Kubernetes operator analogue: DynamoGraphDeployment reconciler (reference: deploy/cloud/operator/)."""

@@ -269,8 +269,10 @@ def test_hub_cache_resolution(tmp_path, monkeypatch):
     (refs / "main").write_text("abc123")
 
     assert resolve_model("acme/tiny") == str(snap)
-    # revision pinning
+    # revision pinning: exact or error — never a silent other-snapshot
     assert resolve_model("acme/tiny", revision="abc123") == str(snap)
+    with pytest.raises(FileNotFoundError, match="abc999"):
+        resolve_model("acme/tiny", revision="abc999")
     # local paths pass through untouched
     assert resolve_model(str(snap)) == str(snap)
     # unknown name → remediation error (no downloader in this image)

@@ -1,0 +1,260 @@
+"""Operator analogue: GraphSpec parsing, manifest building, reconcile
+convergence, teardown + store cleanup (dynamo_tpu/operator/).
+
+Reference analogue: the envtest controller suite (reference:
+deploy/cloud/operator/internal/controller/suite_test.go) — here against
+FakeKubeApi + the in-memory store.
+"""
+
+import asyncio
+
+import pytest
+import yaml
+
+from dynamo_tpu.operator.controller import Reconciler
+from dynamo_tpu.operator.graph import (
+    GRAPH_LABEL,
+    SPEC_HASH_ANNOTATION,
+    GraphSpec,
+    load_graph_file,
+)
+from dynamo_tpu.operator.kube import FakeKubeApi
+
+pytestmark = pytest.mark.unit
+
+GRAPH_YAML = """
+apiVersion: dynamo-tpu.dev/v1alpha1
+kind: DynamoGraphDeployment
+metadata: {name: g1, namespace: prod}
+spec:
+  image: registry/dynamo-tpu:v1
+  dynamoNamespace: dyn
+  services:
+    Frontend:
+      replicas: 1
+      port: 8000
+      extraArgs: ["--router-mode", "kv"]
+    Worker:
+      replicas: 3
+      extraArgs: ["--preset", "llama-8b", "--quant", "int8"]
+      resources: {limits: {google.com/tpu: 1}}
+      nodeSelector: {cloud.google.com/gke-tpu-topology: 1x1}
+    PrefillWorker:
+      replicas: 2
+    MetricsExporter:
+      port: 9091
+"""
+
+
+def graph() -> GraphSpec:
+    return GraphSpec.parse(yaml.safe_load(GRAPH_YAML))
+
+
+def test_parse_and_infer_types():
+    g = graph()
+    assert g.name == "g1" and g.namespace == "prod"
+    assert g.services["Frontend"].component_type == "frontend"
+    assert g.services["Worker"].component_type == "worker"
+    assert g.services["PrefillWorker"].component_type == "prefill"
+    assert g.services["MetricsExporter"].component_type == "metrics"
+    assert g.manage_store  # no storeUrl → in-graph store
+    assert g.resolved_store_url() == "tcp://g1-store:4222"
+
+
+@pytest.mark.parametrize("mutate,err", [
+    (lambda d: d.update(kind="Oops"), "kind"),
+    (lambda d: d["metadata"].pop("name"), "name"),
+    (lambda d: d["spec"].update(services={}), "non-empty"),
+    (lambda d: d["spec"]["services"]["Worker"].update(replicas=-1), "negative"),
+    (lambda d: d["spec"]["services"].update(Oddball={"componentType": "nope"}), "componentType"),
+    (lambda d: d["spec"]["services"].update(Oddball={"componentType": "custom"}), "command"),
+])
+def test_parse_rejections(mutate, err):
+    doc = yaml.safe_load(GRAPH_YAML)
+    mutate(doc)
+    with pytest.raises(ValueError, match=err):
+        GraphSpec.parse(doc)
+
+
+def test_build_manifests_shape():
+    g = graph()
+    ms = g.build_manifests()
+    by = {(m["kind"], m["metadata"]["name"]): m for m in ms}
+    # store deployment+service, 4 service deployments, 2 Services (ports)
+    assert ("Deployment", "g1-store") in by
+    assert ("Service", "g1-store") in by
+    assert ("Deployment", "g1-frontend") in by
+    assert ("Service", "g1-frontend") in by
+    assert ("Deployment", "g1-prefillworker") in by
+    dep = by[("Deployment", "g1-worker")]
+    assert dep["spec"]["replicas"] == 3
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "registry/dynamo-tpu:v1"
+    assert c["command"][:3] == ["python", "-m", "dynamo_tpu.worker"]
+    assert "--store-url" in c["command"]
+    assert c["command"][c["command"].index("--store-url") + 1] == "tcp://g1-store:4222"
+    assert c["command"][-2:] == ["--preset", "llama-8b"] or "--quant" in c["command"]
+    assert c["resources"]["limits"]["google.com/tpu"] == 1
+    pf = by[("Deployment", "g1-prefillworker")]
+    assert "--is-prefill-worker" in pf["spec"]["template"]["spec"]["containers"][0]["command"]
+    for m in ms:
+        assert m["metadata"]["labels"][GRAPH_LABEL] == "g1"
+        assert SPEC_HASH_ANNOTATION in m["metadata"]["annotations"]
+
+
+def test_reconcile_converges_and_is_idempotent():
+    g = graph()
+    kube = FakeKubeApi()
+    rec = Reconciler(kube)
+    counts = rec.reconcile(g)
+    assert counts["created"] == len(g.build_manifests())
+    assert counts["updated"] == counts["deleted"] == 0
+
+    # Second pass: no drift, nothing to do.
+    counts = rec.reconcile(g)
+    assert counts["created"] == counts["updated"] == counts["deleted"] == 0
+    assert counts["unchanged"] > 0
+
+
+def test_reconcile_applies_spec_changes_and_deletes_stale():
+    g = graph()
+    kube = FakeKubeApi()
+    rec = Reconciler(kube)
+    rec.reconcile(g)
+
+    # Scale the worker + drop the metrics exporter.
+    g.services["Worker"].replicas = 5
+    del g.services["MetricsExporter"]
+    counts = rec.reconcile(g)
+    assert counts["updated"] == 1
+    assert counts["deleted"] == 2  # exporter Deployment + Service
+    dep = kube.get("Deployment", "prod", "g1-worker")
+    assert dep["spec"]["replicas"] == 5
+    assert kube.get("Deployment", "prod", "g1-metricsexporter") is None
+
+
+def test_manual_scale_drift_is_not_reverted_but_spec_drift_is():
+    """The planner patches replicas directly (connector). A live object
+    whose hash annotation still matches is left alone — replicas drift is
+    the planner's business, spec drift is ours."""
+    g = graph()
+    kube = FakeKubeApi()
+    rec = Reconciler(kube)
+    rec.reconcile(g)
+    live = kube.get("Deployment", "prod", "g1-worker")
+    live["spec"]["replicas"] = 7  # planner scaled; annotation unchanged
+    counts = rec.reconcile(g)
+    assert counts["updated"] == 0
+    assert kube.get("Deployment", "prod", "g1-worker")["spec"]["replicas"] == 7
+
+
+def test_teardown_deletes_objects_and_cleans_store():
+    from dynamo_tpu.runtime.store import connect_store
+
+    async def go():
+        g = graph()
+        kube = FakeKubeApi()
+        store = await connect_store("memory://op-test")
+        await store.put("instances/dyn/backend/generate:abc", b"x")
+        await store.put("models/dyn/llama", b"y")
+        await store.put("instances/other/keep", b"z")
+
+        async def factory(url):
+            assert url == g.resolved_store_url()
+            return store
+
+        rec = Reconciler(kube, store_factory=factory)
+        rec.reconcile(g)
+        assert len(kube.list("Deployment", "prod", f"{GRAPH_LABEL}=g1")) == 5
+
+        counts = await asyncio.to_thread(rec.teardown, g)
+        assert counts["deleted"] == len(g.build_manifests())
+        assert counts["store_keys"] == 2
+        assert kube.list("Deployment", "prod", f"{GRAPH_LABEL}=g1") == []
+        assert await store.get("instances/other/keep") is not None
+
+    asyncio.run(go())
+
+
+def test_sync_namespace_reconciles_and_tears_down_vanished():
+    kube = FakeKubeApi()
+    doc = yaml.safe_load(GRAPH_YAML)
+    kube.graphs[("prod", "g1")] = doc
+
+    class NoStoreRec(Reconciler):
+        def _clean_store(self, graph):
+            return 0
+
+    rec = NoStoreRec(kube)
+    known = rec.sync_namespace("prod", {})
+    assert set(known) == {"g1"}
+    assert kube.get("Deployment", "prod", "g1-worker") is not None
+    assert doc["status"]["observedServices"] == 4
+
+    # CR vanishes → teardown.
+    del kube.graphs[("prod", "g1")]
+    known = rec.sync_namespace("prod", known)
+    assert known == {}
+    assert kube.get("Deployment", "prod", "g1-worker") is None
+
+
+def test_planner_service_generates_rbac():
+    doc = yaml.safe_load(GRAPH_YAML)
+    doc["spec"]["services"]["Planner"] = {"replicas": 1}
+    g = GraphSpec.parse(doc)
+    by = {(m["kind"], m["metadata"]["name"]) for m in g.build_manifests()}
+    assert ("ServiceAccount", "g1-planner") in by
+    assert ("Role", "g1-planner") in by
+    assert ("RoleBinding", "g1-planner") in by
+    dep = next(m for m in g.build_manifests()
+               if m["metadata"]["name"] == "g1-planner" and m["kind"] == "Deployment")
+    assert dep["spec"]["template"]["spec"]["serviceAccountName"] == "g1-planner"
+    # reconcile handles the RBAC kinds end to end
+    kube = FakeKubeApi()
+    Reconciler(kube).reconcile(g)
+    assert kube.get("Role", "prod", "g1-planner") is not None
+
+
+def test_invalid_cr_does_not_tear_down_live_graph():
+    kube = FakeKubeApi()
+    doc = yaml.safe_load(GRAPH_YAML)
+    kube.graphs[("prod", "g1")] = doc
+
+    class NoStoreRec(Reconciler):
+        torn = 0
+
+        def _clean_store(self, graph):
+            return 0
+
+        def teardown(self, graph, clean_store=True):
+            NoStoreRec.torn += 1
+            return super().teardown(graph, clean_store)
+
+    rec = NoStoreRec(kube)
+    known = rec.sync_namespace("prod", {})
+    # Corrupt the CR in place (still exists!): must NOT tear down.
+    doc["spec"]["services"]["Worker"]["componentType"] = "worrker"
+    known = rec.sync_namespace("prod", known)
+    assert NoStoreRec.torn == 0
+    assert "g1" in known  # last-good spec retained
+    assert kube.get("Deployment", "prod", "g1-worker") is not None
+    assert "componentType" in doc["status"]["error"]
+
+
+def test_cli_render(tmp_path, capsys):
+    from dynamo_tpu.operator.__main__ import main
+
+    p = tmp_path / "g.yaml"
+    p.write_text(GRAPH_YAML)
+    assert main(["--graph", str(p), "--render"]) == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds.count("Deployment") == 5
+    assert kinds.count("Service") == 3  # store + frontend + metrics
+
+
+def test_load_graph_file(tmp_path):
+    p = tmp_path / "g.yaml"
+    p.write_text(GRAPH_YAML)
+    g = load_graph_file(str(p))
+    assert g.name == "g1"
