@@ -58,10 +58,12 @@ def _cached_snapshot(name: str, revision: str | None = None) -> str | None:
         if os.path.isdir(cand):
             return cand
         if pinned:
-            raise FileNotFoundError(
-                f"{name}@{revision} is not in the hub cache ({snaps}) — "
-                f"cached snapshots: {sorted(os.listdir(snaps))}"
-            )
+            # NOT a silent-fallback candidate: a pinned revision either
+            # resolves exactly here or goes to the downloader (which
+            # fetches exactly that revision) — never another snapshot.
+            log.info("%s@%s not cached (have %s)", name, revision,
+                     sorted(os.listdir(snaps)))
+            return None
         log.warning("%s: refs/main points at missing snapshot %s", name, revision)
     commits = os.listdir(snaps)
     if commits:  # nothing pinned: any snapshot (newest mtime)
@@ -98,8 +100,9 @@ def resolve_model(name_or_path: str, revision: str | None = None) -> str:
     if cached is not None:
         log.info("resolved %s from hub cache: %s", name_or_path, cached)
         return cached
+    pin = f"@{revision}" if revision else ""
     remedy = (
-        f"{name_or_path!r} is not in the hub cache ({hub_cache_dir()}) — "
+        f"{name_or_path}{pin!s} is not in the hub cache ({hub_cache_dir()}) — "
         f"pre-populate the cache (`huggingface-cli download {name_or_path}` "
         f"on a connected machine, then ship $HF_HOME) or pass a local path"
     )

@@ -39,6 +39,10 @@ class MockerArgs:
     itl_kv_pressure: float = 1.0     # ITL multiplier at 100% KV usage: 1+this
     prefill_contention: float = 0.5  # TTFT multiplier at full slots: 1+this
     speedup: float = 1.0
+    # Tokens per emitted delta: the real engine streams K-token window
+    # bursts (engine decode_steps), not single tokens — mirror that shape
+    # so frontend-path costs are modeled per delta, not per token.
+    delta_tokens: int = 1
 
     def scaled(self, ms: float) -> float:
         return ms / (1000.0 * self.speedup)
@@ -127,6 +131,7 @@ class MockerEngine:
             max_tokens = req.stop.max_tokens or 64
             eos = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
             emitted = 0
+            burst: list[int] = []
             while emitted < max_tokens:
                 if emitted:
                     # Batch effect + KV paging pressure (superlinear near
@@ -159,7 +164,10 @@ class MockerEngine:
                     finish = FinishReason.STOP
                 elif emitted >= max_tokens:
                     finish = FinishReason.LENGTH
-                yield LLMEngineOutput(token_ids=[token], finish_reason=finish).to_dict()
+                burst.append(token)
+                if finish is not None or len(burst) >= max(a.delta_tokens, 1):
+                    yield LLMEngineOutput(token_ids=burst, finish_reason=finish).to_dict()
+                    burst = []
                 if finish is not None:
                     return
         finally:

@@ -50,6 +50,8 @@ def parse_args(argv=None):
         p.error("one of --graph or --watch is required")
     if args.render and not args.graph:
         p.error("--render needs --graph")
+    if args.delete and not args.graph:
+        p.error("--delete needs --graph")
     return args
 
 

@@ -85,6 +85,8 @@ def parse_args(argv=None):
     p.add_argument("--mocker-ttft-ms", type=float, default=20.0)
     p.add_argument("--mocker-itl-ms", type=float, default=5.0)
     p.add_argument("--mocker-speedup", type=float, default=1.0)
+    p.add_argument("--mocker-delta-tokens", type=int, default=1,
+                   help="tokens per emitted delta (mirror engine window bursts)")
     args = p.parse_args(argv)
     if args.engine == "mocker" and (args.remote_prefill or args.is_prefill_worker):
         # The disagg handlers drive the real engine's KV extract/inject
@@ -122,6 +124,7 @@ async def build_engine(args):
                 ttft_ms=args.mocker_ttft_ms,
                 itl_ms=args.mocker_itl_ms,
                 speedup=args.mocker_speedup,
+                delta_tokens=args.mocker_delta_tokens,
             )
         )
         name = args.model_name or "mock-model"

@@ -269,7 +269,8 @@ def test_hub_cache_resolution(tmp_path, monkeypatch):
     (refs / "main").write_text("abc123")
 
     assert resolve_model("acme/tiny") == str(snap)
-    # revision pinning: exact or error — never a silent other-snapshot
+    # revision pinning: exact, or falls to the downloader (offline here →
+    # error naming the pin) — never a silent other-snapshot
     assert resolve_model("acme/tiny", revision="abc123") == str(snap)
     with pytest.raises(FileNotFoundError, match="abc999"):
         resolve_model("acme/tiny", revision="abc999")
