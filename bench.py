@@ -241,10 +241,12 @@ async def bench(args) -> dict:
         lo, hi = 0.05 * max_rate, 1.0 * max_rate
         best: dict | None = None
         probes = 0
+        lowest_tested = float("inf")
         r = 0.6 * max_rate
         while probes < 4:
             probe = await poisson_run(r)
             probes += 1
+            lowest_tested = min(lowest_tested, r)
             if probe["itl_mean_ms"] <= args.itl_sla_ms:
                 best = probe
                 lo = r
@@ -266,7 +268,7 @@ async def bench(args) -> dict:
         else:
             sla = {"tok_s_at_itl_sla": 0.0, "itl_sla_ms": args.itl_sla_ms,
                    "sla_note": f"ITL > {args.itl_sla_ms} ms even at "
-                               f"{r:.2f} req/s (probes={probes})"}
+                               f"{lowest_tested:.2f} req/s (probes={probes})"}
 
     await engine.stop()
 

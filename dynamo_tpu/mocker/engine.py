@@ -142,14 +142,19 @@ class MockerEngine:
                     ) * (1.0 + a.itl_kv_pressure * usage * usage)
                     await asyncio.sleep(a.scaled(itl))
                 if context.cancelled:
-                    yield LLMEngineOutput(finish_reason=FinishReason.CANCELLED).to_dict()
+                    # flush the pending burst so counted tokens are delivered
+                    yield LLMEngineOutput(
+                        token_ids=burst, finish_reason=FinishReason.CANCELLED
+                    ).to_dict()
                     return
                 token = prompt[emitted % plen]  # deterministic echo
                 if block_seq.total_tokens + 1 > len(block_ids) * bs:
                     try:
                         block_ids.append(self.pool.allocate_block())
                     except NoFreeBlocksError:
-                        yield LLMEngineOutput(finish_reason=FinishReason.LENGTH).to_dict()
+                        yield LLMEngineOutput(
+                            token_ids=burst, finish_reason=FinishReason.LENGTH
+                        ).to_dict()
                         return
                 sealed = block_seq.append(token)
                 emitted += 1

@@ -1,0 +1,66 @@
+"""Prefix trace synthesizer + routing A/B harness (benchmarks/).
+
+Reference analogue: benchmarks/data_generator/tests (synthesizer
+correctness) + the mocker-fleet e2e shape of
+tests/router/test_router_e2e_with_mockers.py.
+"""
+
+import asyncio
+
+import pytest
+
+from benchmarks.synthesize import synthesize
+
+pytestmark = pytest.mark.integration
+
+
+def test_synthesize_structure():
+    trace = synthesize(num_requests=60, groups=4, prefix_len=100,
+                       suffix_len=16, block_size=16, arrival_rate=100.0, seed=7)
+    assert len(trace) == 60
+    # block-aligned prefixes, shared within group, distinct across groups
+    by_group: dict[int, list] = {}
+    for r in trace:
+        assert r["prefix_len"] == 96  # 100 rounded down to block multiple
+        assert len(r["prompt"]) == 96 + 16
+        by_group.setdefault(r["group"], []).append(r)
+    assert len(by_group) == 4
+    prefixes = {}
+    for g, rs in by_group.items():
+        heads = {tuple(r["prompt"][:96]) for r in rs}
+        assert len(heads) == 1          # same prefix within a group
+        prefixes[g] = heads.pop()
+        tails = {tuple(r["prompt"][96:]) for r in rs}
+        assert len(tails) == len(rs)    # unique suffixes
+    assert len(set(prefixes.values())) == 4  # distinct across groups
+    # arrivals are sorted (cumulative Poisson)
+    times = [r["arrival_s"] for r in trace]
+    assert times == sorted(times)
+
+
+def test_synthesize_zipf_skews_popularity():
+    trace = synthesize(num_requests=400, groups=8, zipf=1.5, seed=1,
+                       arrival_rate=0)
+    counts = [0] * 8
+    for r in trace:
+        counts[r["group"]] += 1
+    assert counts[0] > counts[-1] * 2
+
+
+def test_routing_ab_smoke():
+    """Tiny fleet, cache-pressure trace: the kv mode must win hit rate
+    (the TTFT ordering is asserted loosely — timing on CI is noisy)."""
+    import argparse
+
+    from benchmarks.routing_ab import run_ab
+
+    args = argparse.Namespace(
+        workers=2, num_requests=60, groups=12, prefix_len=128,
+        suffix_len=16, gen_len=4, arrival_rate=200.0, zipf=0.0,
+        block_size=16, kv_blocks=96, speedup=20.0, seed=0,
+    )
+    summary = asyncio.run(run_ab(args))
+    kv, rr = summary["kv"], summary["round_robin"]
+    assert kv["requests"] == rr["requests"] == 60
+    assert kv["prefix_hit_rate_mean"] > rr["prefix_hit_rate_mean"]
+    assert summary["hit_rate_delta"] > 0.0

@@ -39,9 +39,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _drive_streams(base: str, k: int, gen_len: int) -> int:
-    """Subprocess load generator: k concurrent SSE streams; → chunk count."""
+def _drive_streams(base: str, k: int, gen_len: int) -> tuple[int, int]:
+    """Subprocess load generator: k concurrent SSE streams →
+    (delivered tokens, errored streams)."""
     import asyncio as aio
+    import json as _json
 
     import httpx
 
@@ -49,8 +51,9 @@ def _drive_streams(base: str, k: int, gen_len: int) -> int:
         async with httpx.AsyncClient(
             timeout=300, limits=httpx.Limits(max_connections=k + 4)
         ) as client:
-            async def one(i: int) -> int:
-                n = 0
+            async def one(i: int) -> tuple[int, int]:
+                """→ (delivered tokens from the finish chunk's usage, error)."""
+                n_tok = 0
                 async with client.stream(
                     "POST", f"{base}/v1/chat/completions",
                     json={"model": "mock-model",
@@ -58,12 +61,20 @@ def _drive_streams(base: str, k: int, gen_len: int) -> int:
                           "max_tokens": gen_len, "stream": True,
                           "ignore_eos": True},
                 ) as resp:
+                    if resp.status_code != 200:
+                        return 0, 1
                     async for line in resp.aiter_lines():
                         if line.startswith("data: ") and line != "data: [DONE]":
-                            n += 1
-                return n
+                            try:
+                                u = _json.loads(line[6:]).get("usage")
+                            except ValueError:
+                                continue
+                            if u:
+                                n_tok = u.get("completion_tokens", 0)
+                return n_tok, 0
 
-            return sum(await aio.gather(*(one(i) for i in range(k))))
+            pairs = await aio.gather(*(one(i) for i in range(k)))
+            return sum(t for t, _ in pairs), sum(e for _, e in pairs)
 
     return aio.run(go())
 
@@ -82,32 +93,34 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
     env = dict(os.environ, PYTHONPATH=REPO)
     port = _free_port()
     url = f"tcp://127.0.0.1:{port}"
-    procs: list[subprocess.Popen] = [subprocess.Popen(
-        [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
-         "--host", "127.0.0.1", "--port", str(port)], env=env,
-    )]
-    await asyncio.sleep(1.0)
-    for _ in range(n_workers):
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "dynamo_tpu.worker",
-             "--store-url", url, "--engine", "mocker",
-             "--mocker-speedup", "1000", "--mocker-ttft-ms", "0.1",
-             "--mocker-itl-ms", "0.01",
-             "--mocker-delta-tokens", str(delta_tokens),
-             "--max-num-seqs", "512", "--num-kv-blocks", "16384",
-             "--max-model-len", "8192"], env=env,
-        ))
-
-    frt = await DistributedRuntime.create(store_url=url)
-    manager = ModelManager(
-        frt, RouterSettings(mode=RouterMode[router_mode.upper().replace("-", "_")])
-    )
-    watcher = await ModelWatcher(frt, manager).start()
-    http = await HttpService(manager, MetricsRegistry(), host="127.0.0.1", port=0).start()
-    base = f"http://127.0.0.1:{http.port}"
-
+    procs: list[subprocess.Popen] = []
+    frt = manager = watcher = http = None
     results = []
-    try:
+    try:  # from the FIRST Popen: any setup failure must reap subprocesses
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
+             "--host", "127.0.0.1", "--port", str(port)], env=env,
+        ))
+        await asyncio.sleep(1.0)
+        for _ in range(n_workers):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.worker",
+                 "--store-url", url, "--engine", "mocker",
+                 "--mocker-speedup", "1000", "--mocker-ttft-ms", "0.1",
+                 "--mocker-itl-ms", "0.01",
+                 "--mocker-delta-tokens", str(delta_tokens),
+                 "--max-num-seqs", "512", "--num-kv-blocks", "16384",
+                 "--max-model-len", "8192"], env=env,
+            ))
+
+        frt = await DistributedRuntime.create(store_url=url)
+        manager = ModelManager(
+            frt, RouterSettings(mode=RouterMode[router_mode.upper().replace("-", "_")])
+        )
+        watcher = await ModelWatcher(frt, manager).start()
+        http = await HttpService(manager, MetricsRegistry(), host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{http.port}"
+
         deadline = time.monotonic() + 30
         while "mock-model" not in manager.list_names():
             if time.monotonic() > deadline:
@@ -134,6 +147,12 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
             max_workers=n_procs, mp_context=mp.get_context("spawn")
         ) as pool:
             loop = asyncio.get_running_loop()
+            # Warm the spawned workers (interpreter + httpx import) so
+            # pool startup never lands inside a timed run.
+            await asyncio.gather(*(
+                loop.run_in_executor(pool, _drive_streams, base, 1, 2)
+                for _ in range(n_procs)
+            ))
             for s in streams_list:
                 per = [s // n_procs + (1 if i < s % n_procs else 0)
                        for i in range(n_procs)]
@@ -143,13 +162,14 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
                     for k in per if k
                 ))
                 dur = time.perf_counter() - t0
-                total = s * gen_len
+                total = sum(t for t, _ in counts)   # DELIVERED tokens only
+                errs = sum(e for _, e in counts)
                 row = {
                     "streams": s, "gen_len": gen_len, "workers": n_workers,
                     "router_mode": router_mode, "delta_tokens": delta_tokens,
                     "elapsed_s": round(dur, 3),
                     "frontend_tok_s": round(total / dur, 1),
-                    "chunks": int(sum(counts)),
+                    "errors": errs,
                 }
                 results.append(row)
                 if as_json:
@@ -158,10 +178,14 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
                     print(f"streams={s:4d}: {total/dur:10.0f} tok/s "
                           f"({dur:.2f}s for {total} tokens)", flush=True)
     finally:
-        await http.close()
-        await watcher.close()
-        await manager.close()
-        await frt.shutdown()
+        if http is not None:
+            await http.close()
+        if watcher is not None:
+            await watcher.close()
+        if manager is not None:
+            await manager.close()
+        if frt is not None:
+            await frt.shutdown()
         for p in reversed(procs):
             p.send_signal(signal.SIGTERM)
         for p in procs:
