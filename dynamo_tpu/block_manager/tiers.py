@@ -206,6 +206,19 @@ class TierStack:
         self.offloaded_blocks += n
         return n
 
+    def peek_run_len(self, hashes: list[int]) -> int:
+        """Length of the leading run resident in ANY tier — no page copies,
+        no G3→G2 promotion (cheap existence probe for llm/peer_kv.py)."""
+        n = 0
+        for h in hashes:
+            if not (
+                (self.host is not None and self.host.contains(h))
+                or (self.disk is not None and self.disk.contains(h))
+            ):
+                break
+            n += 1
+        return n
+
     def lookup_run(self, hashes: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
         out: list[tuple[np.ndarray, np.ndarray]] = []
         for h in hashes:

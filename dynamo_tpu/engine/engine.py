@@ -498,16 +498,11 @@ class TpuEngine:
         seq.block_seq = TokenBlockSequence(prompt, bs)
         start = n_hit * bs
 
-        # Disagg: pre-load remotely-prefilled pages as a materialized
-        # prefix hit — the suffix (< 2 blocks) is recomputed locally, which
-        # also regenerates the first-token logits (no logit shipping).
-        if seq.inject is not None:
-            start, n_hit = self._inject_kv(seq, n_hit, max_hit)
-            seq.prefix_hit_blocks = n_hit
-
         # G2/G3 onboard: blocks evicted from HBM but still host-resident
         # re-enter as a prefix hit instead of being recomputed
-        # (reference: block_manager/offload.rs onboard path).
+        # (reference: block_manager/offload.rs onboard path). Runs BEFORE
+        # a remote inject: a peer payload may start past the local tiers'
+        # coverage (llm/peer_kv.py delta fetch).
         if self.tiers.enabled and n_hit < max_hit:
             run = self.tiers.lookup_run(hashes_matchable[n_hit:])
             if run:
@@ -518,6 +513,14 @@ class TpuEngine:
                 n_hit = n_onb
                 start = n_hit * bs
                 seq.prefix_hit_blocks = n_hit
+
+        # Disagg / peer fetch: pre-load remotely-prefilled pages as a
+        # materialized prefix hit — the suffix (< 2 blocks) is recomputed
+        # locally, which also regenerates the first-token logits (no logit
+        # shipping).
+        if seq.inject is not None:
+            start, n_hit = self._inject_kv(seq, n_hit, max_hit)
+            seq.prefix_hit_blocks = n_hit
         return start
 
     def _dispatch_prefills(
@@ -610,18 +613,25 @@ class TpuEngine:
 
     def _inject_kv(self, seq: _Seq, n_hit: int, max_hit: int) -> tuple[int, int]:
         """Scatter fetched pages into this sequence's blocks beyond the
-        locally-hit prefix. → (new start position, new hit-block count)."""
+        locally-hit prefix. The payload's first page corresponds to prompt
+        block ``block_offset`` (0 for disagg exports; >0 for peer delta
+        fetches, llm/peer_kv.py). → (new start position, new hit count)."""
         payload = seq.inject
+        off = 0
         if isinstance(payload, dict):
+            off = int(payload.get("block_offset") or 0)
             payload = kv_transfer.KvPagePayload.from_dict(payload)
         bs = self.args.block_size
-        n_inj = min(payload.num_tokens // bs, max_hit, payload.k.shape[1])
-        if n_inj <= n_hit:
-            return n_hit * bs, n_hit  # local cache already covers it
+        n_inj = min(off + payload.num_tokens // bs, max_hit, off + payload.k.shape[1])
+        if n_inj <= n_hit or off > n_hit:
+            # Already covered locally, or the payload starts past what the
+            # cache holds (blocks evicted between fetch and admission) —
+            # injecting would leave a KV gap, so recompute instead.
+            return n_hit * bs, n_hit
         self._runner.inject_pages(
             seq.block_ids[n_hit:n_inj],
-            payload.k[:, n_hit:n_inj],
-            payload.v[:, n_hit:n_inj],
+            payload.k[:, n_hit - off : n_inj - off],
+            payload.v[:, n_hit - off : n_inj - off],
         )
         seq.inject = None  # free host pages promptly
         return n_inj * bs, n_inj

@@ -43,6 +43,13 @@ class KvRouterConfig:
     use_kv_events: bool = True
     approx_ttl_s: float = 120.0
     max_attempts: int = 3
+    # Cross-worker KV reuse (the reference's G4 remote tier,
+    # lib/llm/src/block_manager.rs:68-81): when the chosen worker's local
+    # overlap trails another worker's by at least this many blocks, the
+    # request carries a ``peer_prefix`` hint naming that worker; the
+    # chosen worker fetches the prefix pages from the peer's host tier
+    # (llm/peer_kv.py) instead of recomputing them. 0 disables.
+    peer_fetch_min_blocks: int = 4
 
 
 class KvPushRouter:
@@ -147,7 +154,8 @@ class KvPushRouter:
 
     def _place(self, token_ids: list[int], excluded: set[int] = frozenset()):
         """Shared placement recipe: hash → overlap lookup → cost schedule.
-        → (Placement, hashes). Raises NoInstancesError when no candidate."""
+        → (Placement, hashes, per-worker overlap scores). Raises
+        NoInstancesError when no candidate."""
         bs = self.config.block_size
         hashes = compute_block_hashes(token_ids, bs)
         request_blocks = max(1, (len(token_ids) + bs - 1) // bs)
@@ -156,12 +164,31 @@ class KvPushRouter:
             raise NoInstancesError("no available instances")
         overlaps = self.index.find_matches(hashes)
         placement = self.scheduler.schedule(workers, request_blocks, overlaps, self.active)
-        return placement, hashes
+        return placement, hashes, overlaps.scores, workers
+
+    def _peer_hint(self, placement, scores: dict[int, int],
+                   eligible: list[int]) -> dict | None:
+        """G4 cross-worker reuse hint: the live, non-excluded worker
+        holding the most extra prefix blocks relative to the chosen
+        placement, if the gap clears ``peer_fetch_min_blocks``. The index
+        can lag discovery, so candidates are filtered to ``eligible``
+        (the same set placement chose from)."""
+        m = self.config.peer_fetch_min_blocks
+        if m <= 0:
+            return None
+        live = set(eligible)
+        best_wid, best_overlap = None, placement.overlap_blocks + m - 1
+        for wid, overlap in scores.items():
+            if wid != placement.worker and wid in live and overlap > best_overlap:
+                best_wid, best_overlap = wid, overlap
+        if best_wid is None:
+            return None
+        return {"instance_id": best_wid, "num_blocks": int(best_overlap)}
 
     def find_best_match(self, token_ids: list[int]) -> tuple[int, int]:
         """→ (worker_instance_id, overlap_blocks) without routing — the
         reference's `query_instance_id` surface (kv_router.rs:225-264)."""
-        placement, _ = self._place(token_ids)
+        placement, _, _, _ = self._place(token_ids)
         return placement.worker, placement.overlap_blocks
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
@@ -175,10 +202,14 @@ class KvPushRouter:
         attempts = 0
         excluded: set[int] = set()
         last_err: Exception | None = None
+        # KV transfer state the CALLER attached (disagg inject/export)
+        # is preserved verbatim; our own peer hint is recomputed per
+        # attempt so a retry never carries a stale/failed peer.
+        user_ktp = request.get("kv_transfer_params") if isinstance(request, dict) else None
         while attempts < self.config.max_attempts:
             attempts += 1
             try:
-                placement, hashes = self._place(token_ids, excluded)
+                placement, hashes, scores, eligible = self._place(token_ids, excluded)
             except NoInstancesError:
                 break
             wid = placement.worker
@@ -194,6 +225,13 @@ class KvPushRouter:
             if isinstance(request, dict):
                 request = dict(request)
                 request["estimated_prefix_hit_num_blocks"] = placement.overlap_blocks
+                if user_ktp:
+                    request["kv_transfer_params"] = user_ktp
+                else:
+                    hint = self._peer_hint(placement, scores, eligible)
+                    request["kv_transfer_params"] = (
+                        {"peer_prefix": hint} if hint is not None else None
+                    )
             self.active.add_request(
                 context.id, wid, placement.total_blocks, placement.overlap_blocks, len(token_ids)
             )

@@ -194,6 +194,11 @@ class DisaggDecodeHandler:
                 hit_blocks * self.engine.args.block_size,
                 self.engine.prefix_hit_length(tokens),
             )
+            # A peer-fetched prefix (llm/peer_kv.py) already attached as an
+            # inject payload counts as cached work too.
+            inject = (req.get("kv_transfer_params") or {}).get("inject")
+            if isinstance(inject, dict):
+                hit_len = max(hit_len, int(inject.get("num_tokens") or 0))
             if should_prefill_remote(plen, hit_len, self.cfg.max_local_prefill_length):
                 inject = await self._remote_prefill(req, ctx)
                 if inject is not None:

@@ -188,6 +188,13 @@ async def async_main(args) -> None:
 
     comp = rt.namespace(args.namespace).component(args.component)
 
+    # G4 cross-worker reuse: every real engine answers peer prefix
+    # fetches from its host tiers (llm/peer_kv.py; no-op without tiers).
+    if args.engine == "tpu":
+        from dynamo_tpu.llm.peer_kv import KV_PREFIX_ENDPOINT, make_kv_prefix_handler
+
+        await comp.endpoint(KV_PREFIX_ENDPOINT).serve(make_kv_prefix_handler(engine))
+
     if args.is_prefill_worker:
         from dynamo_tpu.llm.disagg import DisaggConfig, PrefillHandler, PrefillPuller
         from dynamo_tpu.runtime.queue import WorkQueue
@@ -232,6 +239,17 @@ async def async_main(args) -> None:
             )
         else:
             handler = engine
+
+        if args.engine == "tpu":
+            # Resolve router peer_prefix hints (G4) ahead of disagg/admission.
+            from dynamo_tpu.llm.peer_kv import KV_PREFIX_ENDPOINT, PeerPrefixFetcher
+            from dynamo_tpu.runtime.push_router import RouterMode
+
+            handler = PeerPrefixFetcher(
+                engine,
+                await comp.endpoint(KV_PREFIX_ENDPOINT).router(RouterMode.DIRECT),
+                inner=handler,
+            )
 
         async def gen_handler(payload, ctx):
             async for item in handler.generate(payload, ctx):
@@ -321,6 +339,16 @@ def run_follower(args) -> None:
 
 
 def main(argv=None) -> int:
+    import os
+
+    # CPU dev/e2e-testing of the real engine CLI: JAX_PLATFORMS in the env
+    # is ignored when a sitecustomize pre-imports jax (TPU tunnels), but
+    # the config update still works before backend init.
+    plat = os.environ.get("DYNTPU_JAX_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     args = parse_args(argv)
     if args.dist_num_processes > 1:
         import jax
