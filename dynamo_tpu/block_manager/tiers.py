@@ -233,6 +233,21 @@ class TierStack:
         self.onboarded_blocks += len(out)
         return out
 
+    def read_run(self, hashes: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Non-promoting ``lookup_run``: G3 hits are NOT copied into G2 and
+        the onboard counter is untouched. For serving a PEER's fetch
+        (llm/peer_kv.py) — exporting a block must not evict this worker's
+        own hot pages or masquerade as a local onboard."""
+        out: list[tuple[np.ndarray, np.ndarray]] = []
+        for h in hashes:
+            pages = self.host.get(h) if self.host is not None else None
+            if pages is None and self.disk is not None:
+                pages = self.disk.get(h)
+            if pages is None:
+                break
+            out.append(pages)
+        return out
+
     def stats(self) -> dict:
         return {
             "g2_blocks": len(self.host) if self.host else 0,

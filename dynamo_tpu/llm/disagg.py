@@ -195,10 +195,15 @@ class DisaggDecodeHandler:
                 self.engine.prefix_hit_length(tokens),
             )
             # A peer-fetched prefix (llm/peer_kv.py) already attached as an
-            # inject payload counts as cached work too.
+            # inject payload counts as cached work too — it covers
+            # [0, block_offset*bs + num_tokens) (the offset part is local).
             inject = (req.get("kv_transfer_params") or {}).get("inject")
             if isinstance(inject, dict):
-                hit_len = max(hit_len, int(inject.get("num_tokens") or 0))
+                covered = (
+                    int(inject.get("block_offset") or 0) * self.engine.args.block_size
+                    + int(inject.get("num_tokens") or 0)
+                )
+                hit_len = max(hit_len, covered)
             if should_prefill_remote(plen, hit_len, self.cfg.max_local_prefill_length):
                 inject = await self._remote_prefill(req, ctx)
                 if inject is not None:

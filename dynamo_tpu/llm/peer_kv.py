@@ -49,7 +49,7 @@ def make_kv_prefix_handler(engine, frame_bytes: int = KvPagePayload.DEFAULT_FRAM
         if tiers is None or not tiers.enabled or not hashes:
             yield {"error": "no kv tiers on this worker"}
             return
-        run = tiers.lookup_run(hashes)
+        run = tiers.read_run(hashes)
         if not run:
             yield {"error": "prefix not resident"}
             return

@@ -69,6 +69,19 @@ def parse_args(argv=None):
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
+    # DP-attention: one worker PROCESS per dp rank, all serving the same
+    # model behind the router — rank separation is process separation, so
+    # no collective spans ranks and a dead rank loses only its own KV
+    # (reference: one dynamo worker per vLLM dp_rank,
+    # components/backends/vllm/launch/dsr1_dep.sh:86-105; per-rank port
+    # math args.py:170-203). `--dp-size N` alone spawns and supervises N
+    # rank processes; `--dp-rank i` marks one rank (set by the spawner).
+    p.add_argument("--dp-size", type=int, default=1)
+    p.add_argument("--dp-rank", type=int, default=None)
+    p.add_argument("--dp-base-port", type=int, default=29600,
+                   help="first port of the per-rank port blocks (dp_rank_ports)")
+    p.add_argument("--dp-chips-per-rank", type=int, default=0,
+                   help="pin TPU_VISIBLE_CHIPS=[r*k, (r+1)*k) per rank (0 = no pinning)")
     # multi-host: ONE logical worker spanning several processes/hosts.
     # Launch one process per host; process 0 serves the endpoint, the
     # rest replay its dispatch stream (engine/runner.py). All processes
@@ -92,7 +105,27 @@ def parse_args(argv=None):
         # The disagg handlers drive the real engine's KV extract/inject
         # surface (prefix_hit_length, kv pages); the mocker has neither.
         p.error("--engine mocker cannot combine with --remote-prefill/--is-prefill-worker")
+    if args.dp_rank is not None and args.dist_num_processes > 1:
+        # A dp rank is a self-contained JAX world; spanning hosts within a
+        # rank would need per-rank coordinator port blocks — run multi-host
+        # workers as independent fleet replicas instead.
+        p.error("--dp-rank cannot combine with --dist-num-processes > 1")
+    if args.dp_rank is not None and not 0 <= args.dp_rank < args.dp_size:
+        p.error("--dp-rank must be in [0, --dp-size)")
     return args
+
+
+def dp_rank_ports(base_port: int, dp_rank: int, stride: int = 4) -> dict:
+    """Deterministic per-rank port block (reference analogue: vLLM
+    dp_rank port math, components/backends/vllm/src/dynamo/vllm/
+    args.py:170-203): rank r owns [base + r*stride, base + (r+1)*stride).
+    Only the ``system`` slot (status HTTP when DYNTPU_SYSTEM_ENABLED) is
+    consumed today — per-rank multi-host is rejected in parse_args, so no
+    coordinator/step ports are needed; the rest of the block is reserved
+    for rank-local services so external launchers can rely on the
+    stride."""
+    b = base_port + dp_rank * stride
+    return {"system": b, "reserved": (b + 1, b + stride)}
 
 
 from dynamo_tpu.llm.tokenizer import parse_tokenizer_spec as tokenizer_spec
@@ -273,7 +306,12 @@ async def async_main(args) -> None:
             await comp.endpoint("clear_kv").serve(clear_handler)
         await register_model(rt, args.namespace, card)
         role = "worker"
-    print(f"dynamo_tpu {role}: serving {card.name} as {args.namespace}/{args.component}/{args.endpoint}", flush=True)
+    rank = "" if args.dp_rank is None else f" [dp rank {args.dp_rank}/{args.dp_size}]"
+    print(
+        f"dynamo_tpu {role}: serving {card.name} as "
+        f"{args.namespace}/{args.component}/{args.endpoint}{rank}",
+        flush=True,
+    )
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -338,6 +376,54 @@ def run_follower(args) -> None:
     follower_loop(eargs, _step_addr(args), params=params, seed=args.seed, sharding=sharding)
 
 
+def run_dp_spawner(args, argv) -> int:
+    """Spawn and supervise one worker process per dp rank (reference:
+    dsr1_dep.sh launches one dynamo worker per vLLM dp_rank). Ranks are
+    independent replicas of the same model: a dead rank loses only its
+    own KV and lease — the rest keep serving, so the spawner does not
+    gang-kill on a single failure; it forwards SIGINT/SIGTERM and exits
+    with the worst child code once all ranks are done."""
+    import os
+    import signal as sig
+    import subprocess
+    import sys
+
+    base = [a for a in (argv if argv is not None else sys.argv[1:])]
+    procs: list[subprocess.Popen] = []
+    try:
+        for r in range(args.dp_size):
+            env = dict(os.environ)
+            if args.dp_chips_per_rank > 0:
+                k = args.dp_chips_per_rank
+                env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in range(r * k, (r + 1) * k))
+            if env.get("DYNTPU_SYSTEM_ENABLED"):
+                env["DYNTPU_SYSTEM_PORT"] = str(
+                    dp_rank_ports(args.dp_base_port, r)["system"]
+                )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.worker", *base, "--dp-rank", str(r)],
+                env=env,
+            ))
+    except Exception:
+        # A failed spawn must not leave earlier ranks orphaned (they hold
+        # chips and store leases with nobody to signal them).
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        raise
+    print(f"dynamo_tpu dp spawner: {args.dp_size} ranks launched", flush=True)
+
+    def forward(signum, _frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signum)
+
+    sig.signal(sig.SIGTERM, forward)
+    sig.signal(sig.SIGINT, forward)
+    rcs = [p.wait() for p in procs]
+    return max((abs(rc) for rc in rcs), default=0)
+
+
 def main(argv=None) -> int:
     import os
 
@@ -350,6 +436,8 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", plat)
     args = parse_args(argv)
+    if args.dp_size > 1 and args.dp_rank is None:
+        return run_dp_spawner(args, argv)
     if args.dist_num_processes > 1:
         import jax
 
