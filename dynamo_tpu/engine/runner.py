@@ -51,13 +51,11 @@ _RETAIN = 128  # refs kept for chaining/sampling (identical on all hosts)
 
 
 @jax.jit
-def _update_last(last_toks, window_toks):
-    """Fold a window's final sampled tokens into the persistent buffer
-    (one tiny compiled variant per batch bucket)."""
-    import jax.numpy as _jnp
-
-    B = window_toks.shape[1]
-    return last_toks.at[:B].set(window_toks[-1])
+def _fold_tokens(last_toks, toks, slots):
+    """Scatter sampled tokens into the persistent per-slot buffer (one
+    tiny compiled variant per batch bucket). ``slots`` names each row's
+    stable sequence slot; padding rows point at the dummy tail slot."""
+    return last_toks.at[slots].set(toks)
 
 
 class StepRef:
@@ -94,8 +92,12 @@ class LocalRunner:
         self.attn_impl = "xla"
         self._rid = 0
         self._refs: OrderedDict[int, StepRef] = OrderedDict()
-        # Previous decode window's final sampled tokens [max_num_seqs],
-        # kept on device for window chaining (no host sync).
+        # Per-SLOT latest sampled token [max_num_seqs + 1], kept on
+        # device: decode windows chain their input from it (no host
+        # sync), and it is fed by both window folds and admission-time
+        # first-token samples (async admission — the engine keeps
+        # dispatching while first tokens are still in flight). The extra
+        # tail slot is the scatter sink for padding rows.
         self._last_toks: jax.Array | None = None
 
     # -- lifecycle --------------------------------------------------------
@@ -182,17 +184,22 @@ class LocalRunner:
         )
         return self._new_ref((logits,), rid)
 
+    def _ensure_last_toks(self) -> None:
+        if self._last_toks is None:
+            self._last_toks = jnp.zeros((self.args.max_num_seqs + 1,), jnp.int32)
+
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
-                     *, rid=None) -> StepRef:
-        """chain: None | (dst rows, src rows) — rows of this window whose
-        input token is the previous window's last on-device output
-        (self._last_toks; no host sync). Shapes stay fixed per batch
-        bucket: chaining is expressed as a [B] mask + src map inside the
-        jit, and last_toks is a persistent [max_num_seqs] buffer."""
+                     fold_slots=None, *, rid=None) -> StepRef:
+        """chain: None | (dst rows, src slots) — rows of this window whose
+        input token is the latest on-device sample for that sequence SLOT
+        (previous window fold or admission first-token fold; no host
+        sync). Shapes stay fixed per batch bucket: chaining is expressed
+        as a [B] mask + slot map inside the jit. ``fold_slots`` [B] names
+        each row's slot so the window's final tokens land back in the
+        buffer (padding rows → dummy tail slot)."""
         B = len(tokens)
-        if self._last_toks is None:
-            self._last_toks = jnp.zeros((self.args.max_num_seqs,), jnp.int32)
+        self._ensure_last_toks()
         mask = np.zeros((B,), bool)
         srcmap = np.zeros((B,), np.int32)
         if chain is not None:
@@ -209,7 +216,11 @@ class LocalRunner:
             jnp.asarray(mask), jnp.asarray(srcmap), self._last_toks,
             attn_impl=self.attn_impl,
         )
-        self._last_toks = _update_last(self._last_toks, toks_d)
+        if fold_slots is None:
+            fold_slots = np.full((B,), self.args.max_num_seqs, np.int32)
+        self._last_toks = _fold_tokens(
+            self._last_toks, toks_d[-1], jnp.asarray(fold_slots, jnp.int32)
+        )
         return self._new_ref((toks_d, logps_d), rid)
 
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
@@ -232,8 +243,11 @@ class LocalRunner:
         return jnp.stack(rows)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
-                    steps, full: bool):
-        """→ (tokens [B], logprobs [B]) as device arrays (leader fetches)."""
+                    steps, full: bool, fold_slots=None):
+        """→ (tokens [B], logprobs [B]) as device arrays (leader fetches).
+        With ``fold_slots``, the sampled tokens also land in the per-slot
+        chain buffer so the next decode window can consume them without a
+        host sync (async admission)."""
         logits = self.stack_rows(srcs)
         if full:
             out = sample_full(
@@ -243,6 +257,11 @@ class LocalRunner:
             )
         else:
             out = sample_simple(logits, jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps))
+        if fold_slots is not None:
+            self._ensure_last_toks()
+            self._last_toks = _fold_tokens(
+                self._last_toks, out, jnp.asarray(fold_slots, jnp.int32)
+            )
         return out, token_logprobs(logits, out)
 
     def embed(self, toks, tlen, *, rid=None) -> StepRef:
@@ -349,7 +368,7 @@ class LeaderRunner(LocalRunner):
 
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
-                     *, rid=None) -> StepRef:
+                     fold_slots=None, *, rid=None) -> StepRef:
         rid = self._rid
         wire_chain = None
         if chain is not None:
@@ -362,10 +381,11 @@ class LeaderRunner(LocalRunner):
                     "seeds": _pack_np(seeds), "steps0": _pack_np(steps0),
                     "tks": _pack_np(tks), "tps": _pack_np(tps),
                     "freqs": _pack_np(freqs), "press": _pack_np(press),
-                    "pen": _pack_np(pen)})
+                    "pen": _pack_np(pen),
+                    "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().multi_decode(K, mode, tokens, chain, positions, tables,
                                     active, temps, seeds, steps0, tks, tps,
-                                    freqs, press, pen, rid=rid)
+                                    freqs, press, pen, fold_slots, rid=rid)
 
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
         rid = self._rid
@@ -375,7 +395,7 @@ class LeaderRunner(LocalRunner):
         return super().decode_step(tokens, positions, tables, active, rid=rid)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
-                    steps, full: bool):
+                    steps, full: bool, fold_slots=None):
         wire_srcs = [
             [ref.rid if isinstance(ref, StepRef) else ref,
              None if row is None else int(row)]
@@ -386,9 +406,10 @@ class LeaderRunner(LocalRunner):
                     "tps": _pack_np(tps), "pen": _pack_np(pen),
                     "freqs": _pack_np(freqs), "press": _pack_np(press),
                     "seeds": _pack_np(seeds), "steps": _pack_np(steps),
-                    "full": bool(full)})
+                    "full": bool(full),
+                    "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().sample_rows(srcs, temps, tks, tps, pen, freqs, press,
-                                   seeds, steps, full)
+                                   seeds, steps, full, fold_slots)
 
     def embed(self, toks, tlen, *, rid=None) -> StepRef:
         rid = self._rid
@@ -455,6 +476,7 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
             chain = desc["chain"]
             if chain is not None:
                 chain = (chain[0], chain[1])
+            fold = desc.get("fold")
             runner.multi_decode(
                 desc["K"], desc["mode"], _unpack_np(desc["tokens"]), chain,
                 _unpack_np(desc["positions"]), _unpack_np(desc["tables"]),
@@ -462,20 +484,22 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["seeds"]), _unpack_np(desc["steps0"]),
                 _unpack_np(desc["tks"]), _unpack_np(desc["tps"]),
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
-                _unpack_np(desc["pen"]), rid=desc["rid"])
+                _unpack_np(desc["pen"]),
+                None if fold is None else _unpack_np(fold), rid=desc["rid"])
         elif op == "decode_step":
             runner.decode_step(
                 _unpack_np(desc["tokens"]), _unpack_np(desc["positions"]),
                 _unpack_np(desc["tables"]), _unpack_np(desc["active"]),
                 rid=desc["rid"])
         elif op == "sample_rows":
+            fold = desc.get("fold")
             runner.sample_rows(
                 [(s[0], s[1]) for s in desc["srcs"]],
                 _unpack_np(desc["temps"]), _unpack_np(desc["tks"]),
                 _unpack_np(desc["tps"]), _unpack_np(desc["pen"]),
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
                 _unpack_np(desc["seeds"]), _unpack_np(desc["steps"]),
-                desc["full"])
+                desc["full"], None if fold is None else _unpack_np(fold))
         elif op == "embed":
             runner.embed(_unpack_np(desc["toks"]), desc["tlen"], rid=desc["rid"])
         elif op == "extract_pages":
