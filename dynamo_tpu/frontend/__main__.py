@@ -36,6 +36,10 @@ def parse_args(argv=None):
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--no-kv-events", action="store_true",
                    help="KV mode without worker events (TTL-predictive index)")
+    p.add_argument("--index-shards", type=int, default=0,
+                   help="run the KV index across N shard threads so event "
+                        "floods never stall routing (0 = in-loop index; "
+                        "reference: KvIndexerSharded)")
     p.add_argument("--record-dir", default=None,
                    help="record response streams + routing events to JSONL here "
                         "(replayable offline; llm/recorder.py)")
@@ -50,6 +54,7 @@ async def async_main(args) -> None:
             overlap_score_weight=args.kv_overlap_score_weight,
             router_temperature=args.router_temperature,
             use_kv_events=not args.no_kv_events,
+            index_shards=args.index_shards,
         )
     manager = ModelManager(rt, settings)
     watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()

@@ -144,3 +144,157 @@ class RadixIndex:
             self._remove(worker, h)
         self._worker_blocks.pop(worker, None)
         self._worker_event_ids.pop(worker, None)
+
+
+class ShardedRadixIndex:
+    """Scale-out indexer (reference: ``KvIndexerSharded``,
+    lib/llm/src/kv_router/indexer.rs:856-985): workers are assigned to
+    shards least-loaded-first, each shard owns an independent
+    ``RadixIndex`` driven by its own thread, and ``find_matches`` merges
+    per-shard scores (a worker's blocks live wholly in its shard, so the
+    merged dicts are disjoint).
+
+    Python twist on the reference's tokio-tasks-per-shard: daemon threads
+    with ordered per-shard queues. The payoff here is less about raw
+    events/s (the GIL bounds dict mutation) and more that event FLOODS
+    never run on the routing asyncio loop — routing latency stays flat
+    while shard threads chew through bursts (tools/profile_indexer.py
+    measures both). Overflow policy matches the reference's gap story:
+    a shard queue past its bound drops that worker's state and reports
+    False so the subscription layer re-snapshots; all mutations ride the
+    queue, so drop → resnapshot ordering is preserved."""
+
+    def __init__(self, num_shards: int = 4, max_queue: int = 8192):
+        import queue as _queue
+        import threading
+
+        self.num_shards = max(1, num_shards)
+        self.max_queue = max_queue
+        self._shards = [RadixIndex() for _ in range(self.num_shards)]
+        self._locks = [threading.Lock() for _ in range(self.num_shards)]
+        self._queues: list[_queue.Queue] = [_queue.Queue() for _ in range(self.num_shards)]
+        self._assign: dict[WorkerId, int] = {}
+        self._counts = [0] * self.num_shards
+        # A removed worker that rejoins (gap/overflow → resnapshot) MUST
+        # land on its old shard: its queued remove op and the fresh
+        # snapshot then share one queue, so ordering guarantees the state
+        # never straddles two shards (find_matches merges assuming
+        # disjoint workers). Bounded: it only holds ints.
+        self._last_shard: dict[WorkerId, int] = {}
+        self._worker_event_ids: dict[WorkerId, int] = {}
+        self._threads = [
+            threading.Thread(target=self._shard_loop, args=(i,),
+                             name=f"kv-index-shard-{i}", daemon=True)
+            for i in range(self.num_shards)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _shard_loop(self, i: int) -> None:
+        # Ops are drained in batches under ONE lock acquisition, with an
+        # explicit yield between batches: per-op lock cycling starves
+        # concurrent find_matches callers (measured p99 26→0.1 ms with
+        # batching, tools/profile_indexer.py).
+        import queue as _queue
+        import time as _time
+
+        q, shard, lock = self._queues[i], self._shards[i], self._locks[i]
+        while True:
+            batch = [q.get()]
+            while len(batch) < 256 and batch[-1] is not None:
+                try:
+                    batch.append(q.get_nowait())
+                except _queue.Empty:
+                    break
+            stop = batch[-1] is None
+            if stop:
+                batch.pop()
+            with lock:
+                for kind, worker, event in batch:
+                    if kind == "apply":
+                        shard.apply(worker, event)
+                    else:
+                        shard.remove_worker(worker)
+            for _ in range(len(batch) + (1 if stop else 0)):
+                q.task_done()
+            if stop:
+                return
+            _time.sleep(0)  # let queued find_matches grab the lock
+
+    def _shard_of(self, worker: WorkerId) -> int:
+        s = self._assign.get(worker)
+        if s is None:
+            s = self._last_shard.get(worker)  # sticky rejoin (see above)
+            if s is None:
+                s = min(range(self.num_shards), key=lambda i: self._counts[i])
+            self._assign[worker] = s
+            self._counts[s] += 1
+        return s
+
+    # -- RadixIndex-compatible surface -------------------------------------
+
+    def apply(self, worker: WorkerId, event: KvCacheEvent) -> bool:
+        # Gap tracking stays synchronous (cheap int compare) so the
+        # caller's drop+resnapshot contract is preserved; the heavy dict
+        # mutation is what moves to the shard thread.
+        if event.event_id == 0:
+            if event.kind == CLEARED:
+                self.remove_worker(worker)
+                return True
+        else:
+            last = self._worker_event_ids.get(worker)
+            if last is not None and event.event_id != last + 1:
+                self.remove_worker(worker)
+                return False
+            self._worker_event_ids[worker] = event.event_id
+        s = self._shard_of(worker)
+        if self._queues[s].qsize() >= self.max_queue:
+            # Back-pressure: cheaper to resync this worker from a fresh
+            # snapshot than to buffer an unbounded backlog.
+            self.remove_worker(worker)
+            return False
+        self._queues[s].put(("apply", worker, event))
+        return True
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        s = self._assign.pop(worker, None)
+        self._worker_event_ids.pop(worker, None)
+        if s is not None:
+            self._counts[s] -= 1
+            if len(self._last_shard) > 4096:
+                self._last_shard.clear()  # churn bound; stickiness is best-effort
+            self._last_shard[worker] = s
+            self._queues[s].put(("remove", worker, None))
+
+    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+        scores: dict[WorkerId, int] = {}
+        for shard, lock in zip(self._shards, self._locks):
+            with lock:
+                scores.update(shard.find_matches(seq_hashes).scores)
+        return OverlapScores(scores)
+
+    def workers(self) -> set[WorkerId]:
+        out: set[WorkerId] = set()
+        for shard, lock in zip(self._shards, self._locks):
+            with lock:
+                out |= shard.workers()
+        return out
+
+    def num_blocks(self, worker: WorkerId) -> int:
+        s = self._assign.get(worker)
+        if s is None:
+            return 0
+        with self._locks[s]:
+            return self._shards[s].num_blocks(worker)
+
+    def flush(self) -> None:
+        """Block until every queued mutation has been applied (tests,
+        shutdown barriers)."""
+        for q in self._queues:
+            q.join()
+
+    def close(self) -> None:
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5.0)

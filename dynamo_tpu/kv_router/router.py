@@ -22,7 +22,7 @@ from typing import Any, AsyncIterator
 
 from dynamo_tpu.kv_router.approx import ApproxKvIndexer
 from dynamo_tpu.kv_router.protocols import KVHitRateEvent
-from dynamo_tpu.kv_router.indexer import RadixIndex
+from dynamo_tpu.kv_router.indexer import RadixIndex, ShardedRadixIndex
 from dynamo_tpu.kv_router.publisher import KvEventSubscription
 from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig
 from dynamo_tpu.kv_router.sequence import ActiveSequences
@@ -43,6 +43,10 @@ class KvRouterConfig:
     use_kv_events: bool = True
     approx_ttl_s: float = 120.0
     max_attempts: int = 3
+    # Index sharding (reference: KvIndexerSharded, indexer.rs:856-985):
+    # >0 runs the event-driven index across this many shard threads so
+    # event floods never stall the routing loop. 0 = single in-loop index.
+    index_shards: int = 0
     # Cross-worker KV reuse (the reference's G4 remote tier,
     # lib/llm/src/block_manager.rs:68-81): when the chosen worker's local
     # overlap trails another worker's by at least this many blocks, the
@@ -71,10 +75,14 @@ class KvPushRouter:
             )
         )
         self.active = ActiveSequences()
-        if self.config.use_kv_events:
-            self.index: RadixIndex | ApproxKvIndexer = RadixIndex()
+        if not self.config.use_kv_events:
+            self.index: RadixIndex | ShardedRadixIndex | ApproxKvIndexer = (
+                ApproxKvIndexer(ttl_s=self.config.approx_ttl_s)
+            )
+        elif self.config.index_shards > 0:
+            self.index = ShardedRadixIndex(self.config.index_shards)
         else:
-            self.index = ApproxKvIndexer(ttl_s=self.config.approx_ttl_s)
+            self.index = RadixIndex()
         self._subs: dict[int, KvEventSubscription] = {}
         self._sub_started: dict[int, float] = {}
         self._sync_task: asyncio.Task | None = None
@@ -97,6 +105,8 @@ class KvPushRouter:
         for sub in list(self._subs.values()):
             await sub.close()
         self._subs.clear()
+        if isinstance(self.index, ShardedRadixIndex):
+            self.index.close()
 
     async def _sync_loop(self) -> None:
         loop = asyncio.get_running_loop()
@@ -118,7 +128,7 @@ class KvPushRouter:
         task.add_done_callback(self._bg_tasks.discard)
 
     def _reconcile(self) -> None:
-        assert isinstance(self.index, RadixIndex)
+        assert isinstance(self.index, (RadixIndex, ShardedRadixIndex))
         live = {i.instance_id: i for i in self.discovery.available()}
         for wid in list(self._subs):
             if wid not in live:
@@ -141,7 +151,7 @@ class KvPushRouter:
         # subscription that died young (endpoint missing/broken) is retried
         # with a delay so a permanently-failing worker can't hot-loop us.
         self._subs.pop(wid, None)
-        if isinstance(self.index, RadixIndex):
+        if isinstance(self.index, (RadixIndex, ShardedRadixIndex)):
             self.index.remove_worker(wid)
         loop = asyncio.get_running_loop()
         lifetime = loop.time() - self._sub_started.pop(wid, 0.0)

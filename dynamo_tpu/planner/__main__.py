@@ -26,7 +26,8 @@ def parse_args(argv=None):
     p.add_argument("--metrics-url", required=True, help="frontend /metrics URL")
     p.add_argument("--component", default="backend")
     p.add_argument("--adjustment-interval", type=float, default=30.0)
-    p.add_argument("--predictor", default="ar", choices=["constant", "moving-average", "ar"])
+    p.add_argument("--predictor", default="ar",
+                   choices=["constant", "moving-average", "ar", "seasonal"])
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--max-replicas", type=int, default=8)
     p.add_argument("--replica-tok-s", type=float, default=1000.0)

@@ -39,6 +39,8 @@ def parse_args(argv=None):
     p.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     p.add_argument("--router-temperature", type=float, default=0.0)
     p.add_argument("--no-kv-events", action="store_true")
+    p.add_argument("--index-shards", type=int, default=0,
+                   help="KV index shard threads (0 = in-loop; reference: KvIndexerSharded)")
     return p.parse_args(argv)
 
 
@@ -55,6 +57,7 @@ async def async_main(args) -> None:
             overlap_score_weight=args.kv_overlap_score_weight,
             router_temperature=args.router_temperature,
             use_kv_events=not args.no_kv_events,
+            index_shards=args.index_shards,
         ),
     ).start()
 

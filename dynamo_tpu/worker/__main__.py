@@ -105,11 +105,12 @@ def parse_args(argv=None):
         # The disagg handlers drive the real engine's KV extract/inject
         # surface (prefix_hit_length, kv pages); the mocker has neither.
         p.error("--engine mocker cannot combine with --remote-prefill/--is-prefill-worker")
-    if args.dp_rank is not None and args.dist_num_processes > 1:
+    if (args.dp_rank is not None or args.dp_size > 1) and args.dist_num_processes > 1:
         # A dp rank is a self-contained JAX world; spanning hosts within a
         # rank would need per-rank coordinator port blocks — run multi-host
-        # workers as independent fleet replicas instead.
-        p.error("--dp-rank cannot combine with --dist-num-processes > 1")
+        # workers as independent fleet replicas instead. Checked for the
+        # spawner too so the parent fails fast instead of every child.
+        p.error("--dp-size/--dp-rank cannot combine with --dist-num-processes > 1")
     if args.dp_rank is not None and not 0 <= args.dp_rank < args.dp_size:
         p.error("--dp-rank must be in [0, --dp-size)")
     return args
@@ -390,6 +391,16 @@ def run_dp_spawner(args, argv) -> int:
 
     base = [a for a in (argv if argv is not None else sys.argv[1:])]
     procs: list[subprocess.Popen] = []
+
+    def forward(signum, _frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signum)
+
+    # Installed BEFORE spawning: a signal mid-launch must still reach the
+    # ranks already running, or they orphan with chips and leases held.
+    sig.signal(sig.SIGTERM, forward)
+    sig.signal(sig.SIGINT, forward)
     try:
         for r in range(args.dp_size):
             env = dict(os.environ)
@@ -412,14 +423,6 @@ def run_dp_spawner(args, argv) -> int:
                 p.terminate()
         raise
     print(f"dynamo_tpu dp spawner: {args.dp_size} ranks launched", flush=True)
-
-    def forward(signum, _frame):
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signum)
-
-    sig.signal(sig.SIGTERM, forward)
-    sig.signal(sig.SIGINT, forward)
     rcs = [p.wait() for p in procs]
     return max((abs(rc) for rc in rcs), default=0)
 

@@ -1,0 +1,98 @@
+"""Logprob analysis toolkit (llm/logprobs.py; reference:
+lib/llm/src/perf/logprobs.rs + logprob_analysis_integration.rs)."""
+
+import json
+import math
+
+from dynamo_tpu.llm.logprobs import (
+    SensitivityAnalysis,
+    TokenLogprob,
+    TokenLogProbs,
+    analyze_logprob_sensitivity,
+    analyze_recording,
+    extract_logprobs,
+)
+
+
+def chat_chunk(entries, index=0):
+    return {"choices": [{"index": index, "logprobs": {"content": entries}}]}
+
+
+def entry(token, logprob, tops=None):
+    return {"token": token, "logprob": logprob,
+            "top_logprobs": [{"token": t, "logprob": v} for t, v in (tops or [])]}
+
+
+def test_token_logprobs_ranking_and_mass():
+    pos = TokenLogProbs(
+        TokenLogprob("a", math.log(0.6)),
+        [TokenLogprob("b", math.log(0.3)), TokenLogprob("c", math.log(0.05))],
+    )
+    ranked = pos.all_tokens()
+    assert [t.token for t in ranked] == ["a", "b", "c"]
+    assert abs(pos.top2_probability_gap() - 0.3) < 1e-9
+    assert abs(pos.missing_mass() - 0.05) < 1e-9
+    assert not pos.normalized
+    # Selected-only: gap unknowable.
+    assert TokenLogProbs(TokenLogprob("x", -0.1)).top2_probability_gap() is None
+
+
+def test_sensitivity_ranks_close_positions_first():
+    chunks = [
+        chat_chunk([
+            entry("the", math.log(0.9), [("a", math.log(0.05))]),      # confident
+            entry("cat", math.log(0.45), [("dog", math.log(0.44))]),   # razor thin
+        ]),
+        chat_chunk([
+            entry("sat", math.log(0.6), [("ran", math.log(0.3))]),     # medium
+        ]),
+    ]
+    analysis = analyze_logprob_sensitivity(chunks)
+    assert analysis.responses_analyzed == 2
+    ch = analysis.choices[0]
+    assert len(ch.positions) == 3
+    # Most-uncertain-first: cat/dog gap ~0.01 ranks before sat (0.3).
+    assert ch.positions[0].token_index == 1
+    assert ch.positions[0].probability_gap < 0.02
+    assert [p.token_index for p in ch.closest(2)] == [1, 2]
+    assert len(ch.close_positions(0.1)) == 1
+
+    s = analysis.summary()
+    c0 = s["choices"]["0"]
+    assert c0["positions"] == 3 and c0["close_at_0.1"] == 1
+    assert c0["perplexity"] > 1.0
+    assert c0["top5_closest"][0]["selected"] == "cat"
+
+
+def test_extract_completions_shape():
+    resp = {"choices": [{"index": 0, "logprobs": {
+        "tokens": ["x", "y"], "token_logprobs": [-0.1, -2.0],
+        "top_logprobs": [{"x": -0.1, "z": -2.5}, None],
+    }}]}
+    by_choice = extract_logprobs(resp)
+    assert len(by_choice[0]) == 2
+    assert by_choice[0][0].all_tokens()[1].token == "z"
+
+
+def test_analyze_recording_engine_outputs(tmp_path):
+    """Recorder captures LLMEngineOutput deltas; the CLI path analyzes
+    them via the chosen-token fallback."""
+    path = tmp_path / "cap.jsonl"
+    with open(path, "w") as f:
+        for rec in [
+            {"t": 0.0, "kind": "request", "rid": "r1"},
+            {"t": 0.1, "kind": "delta", "rid": "r1",
+             "item": {"token_ids": [5, 7], "log_probs": [-0.05, -1.8]}},
+            {"t": 0.2, "kind": "delta", "rid": "r2",
+             "item": {"token_ids": [9], "log_probs": [-0.5]}},
+            {"t": 0.3, "kind": "delta", "rid": "r1", "item": {"token_ids": []}},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    analysis = analyze_recording(str(path), rid="r1")
+    ch = analysis.choices[0]
+    assert len(ch.positions) == 2
+    # Low-probability selection ranks as most uncertain without alts.
+    assert ch.positions[0].selected_prob < 0.2
+    assert isinstance(analysis, SensitivityAnalysis)
+    # Unfiltered: r2's position joins too.
+    assert len(analyze_recording(str(path)).choices[0].positions) == 3

@@ -37,6 +37,39 @@ def test_predictors_track_level_and_trend():
     assert ar.predict() > 30  # extrapolates the trend past the last value
 
 
+def test_seasonal_predictor_learns_cycle():
+    # Arbitrary repeating daily pattern + slow drift. (A sine would be
+    # unfair to compare on: sinusoids satisfy an exact AR(2) recurrence,
+    # so the AR baseline is perfect there; real diurnal load is not a
+    # sinusoid.)
+    period = 24
+    rng = np.random.default_rng(0)
+    pattern = rng.uniform(20, 150, period)
+
+    def load(t):
+        return 100 + 0.2 * t + pattern[t % period]
+
+    sp = make_predictor("seasonal", window=240)
+    ar = make_predictor("ar", window=24)
+    errs_sp, errs_ar = [], []
+    for t in range(6 * period):
+        if t >= 4 * period:  # score after warm history exists
+            errs_sp.append(abs(sp.predict() - load(t)))
+            errs_ar.append(abs(ar.predict() - load(t)))
+        sp.observe(load(t))
+        ar.observe(load(t))
+    # Season auto-discovered and exploited: seasonal beats AR clearly.
+    assert sum(errs_sp) < 0.5 * sum(errs_ar)
+    assert sp._fitted_m in (period - 1, period, period + 1)
+
+    # Aperiodic series: falls back to AR-quality behaviour, no phantom
+    # seasonality (predict stays near the ramp).
+    sp2 = make_predictor("seasonal", window=96)
+    for t in range(60):
+        sp2.observe(10 + 2 * t)
+    assert abs(sp2.predict() - 130) < 20
+
+
 def test_interpolators_and_roundtrip(tmp_path):
     dec = DecodeInterpolator(
         np.array([8, 32, 128]), np.array([10.0, 20.0, 80.0]), np.array([800.0, 1600.0, 3200.0])

@@ -1,0 +1,111 @@
+"""Sharded KV indexer (reference: KvIndexerSharded, indexer.rs:856-985):
+parity with the single index, gap/overflow drop+resync semantics, and
+e2e behind the KvPushRouter."""
+
+import asyncio
+
+from dynamo_tpu.kv_router.indexer import RadixIndex, ShardedRadixIndex
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, StoredBlock
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+
+from test_kv_router import BS, make_request, start_mock_worker
+
+
+def chain_events(worker, hashes, start_eid=1):
+    parent = None
+    for eid, h in enumerate(hashes, start=start_eid):
+        yield worker, KvCacheEvent.stored([StoredBlock(h, parent)], event_id=eid)
+        parent = h
+
+
+def test_sharded_matches_single_index():
+    single = RadixIndex()
+    sharded = ShardedRadixIndex(num_shards=3)
+    try:
+        # 5 workers, chains of varying depth over a shared prefix.
+        for w in range(1, 6):
+            for worker, ev in chain_events(w, list(range(100, 100 + 2 * w))):
+                assert single.apply(worker, ev)
+                assert sharded.apply(worker, ev)
+        sharded.flush()
+        query = list(range(100, 112))
+        assert sharded.find_matches(query).scores == single.find_matches(query).scores
+        assert sharded.workers() == single.workers()
+        for w in range(1, 6):
+            assert sharded.num_blocks(w) == single.num_blocks(w)
+        # Removal parity.
+        single.remove_worker(3)
+        sharded.remove_worker(3)
+        sharded.flush()
+        assert sharded.find_matches(query).scores == single.find_matches(query).scores
+    finally:
+        sharded.close()
+
+
+def test_sharded_gap_drops_worker():
+    sharded = ShardedRadixIndex(num_shards=2)
+    try:
+        assert sharded.apply(1, KvCacheEvent.stored([StoredBlock(10, None)], event_id=1))
+        # Event-id gap → synchronous False + state drop (resync contract).
+        assert not sharded.apply(1, KvCacheEvent.stored([StoredBlock(20, 10)], event_id=3))
+        sharded.flush()
+        assert sharded.find_matches([10]).scores == {}
+    finally:
+        sharded.close()
+
+
+def test_sharded_overflow_drops_and_resyncs():
+    sharded = ShardedRadixIndex(num_shards=1, max_queue=4)
+    try:
+        # Stall the shard thread by flooding more events than the bound.
+        dropped = False
+        for worker, ev in chain_events(7, list(range(1000, 1200))):
+            if not sharded.apply(worker, ev):
+                dropped = True
+                break
+        assert dropped  # overflow reported so the subscription resyncs
+        sharded.flush()
+        # Resync: snapshot events (id 0) then a fresh live sequence.
+        sharded.apply(7, KvCacheEvent.stored([StoredBlock(1, None)], event_id=0))
+        assert sharded.apply(7, KvCacheEvent.stored([StoredBlock(2, 1)], event_id=5))
+        sharded.flush()
+        assert sharded.find_matches([1, 2]).scores == {7: 2}
+    finally:
+        sharded.close()
+
+
+def test_kv_router_with_sharded_index_concentrates_traffic():
+    async def go():
+        url = "memory://shard_e2e"
+        rt_a, eng_a = await start_mock_worker(url)
+        rt_b, eng_b = await start_mock_worker(url)
+        rt_c = await DistributedRuntime.create(store_url=url)
+        ep = rt_c.namespace("kvtest").component("backend").endpoint("generate")
+        push = await ep.router(RouterMode.DIRECT)
+        await push.discovery.wait_for_instances(2)
+        router = await KvPushRouter(
+            push, KvRouterConfig(block_size=BS, index_shards=2)
+        ).start()
+        try:
+            assert isinstance(router.index, ShardedRadixIndex)
+            shared_prefix = list(range(1, 17))
+            ctx1 = Context()
+            _ = [x async for x in router.generate(make_request(shared_prefix + [50]), ctx1)]
+            warm = ctx1.metadata["worker_instance_id"]
+            await asyncio.sleep(0.1)
+            router.index.flush()
+            for i in range(5):
+                ctx = Context()
+                _ = [x async for x in router.generate(make_request(shared_prefix + [60 + i]), ctx)]
+                assert ctx.metadata["worker_instance_id"] == warm
+                await asyncio.sleep(0.02)
+        finally:
+            await router.close()
+            await rt_c.shutdown()
+            await rt_a.shutdown()
+            await rt_b.shutdown()
+
+    asyncio.run(go())

@@ -1,0 +1,148 @@
+"""Soak tier: sustained churn and stability (reference:
+lib/runtime/tests/soak.rs and the `stress` marker tier,
+pyproject.toml:170-183).
+
+Default scale finishes in ~1 minute so the tier runs in CI; set
+SOAK_SCALE=N to multiply iteration counts for real soaks
+(e.g. `SOAK_SCALE=60 pytest -m soak` ≈ an hour of churn).
+
+What must stay flat over the run:
+- store key space after lease churn (no orphaned instance keys),
+- watch delivery under event backlog (no drops, bounded lag),
+- engine block pool + RSS across request waves (no leak per wave).
+"""
+
+import asyncio
+import gc
+import os
+import resource
+
+import pytest
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+
+SCALE = int(os.environ.get("SOAK_SCALE", "1"))
+
+pytestmark = pytest.mark.soak
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def test_soak_lease_churn_leaves_no_orphans():
+    """Hundreds of worker join/leave cycles: every instance key must
+    vanish with its runtime; the store's key count stays flat."""
+
+    async def go():
+        url = "memory://soak_lease"
+        anchor = await DistributedRuntime.create(store_url=url)
+        try:
+            base_keys = len(await anchor.store.get_prefix(""))
+            cycles = 150 * SCALE
+            for i in range(cycles):
+                rt = await DistributedRuntime.create(store_url=url)
+                comp = rt.namespace("soak").component(f"c{i % 7}")
+
+                async def h(payload, ctx):
+                    yield {"ok": True}
+
+                await comp.endpoint("generate").serve(h)
+                if i % 3 == 0:  # some leave mid-serve without drain
+                    await rt.shutdown()
+                else:
+                    await rt.shutdown()
+                if i % 50 == 49:
+                    keys = len(await anchor.store.get_prefix(""))
+                    assert keys <= base_keys + 2, f"key leak at cycle {i}: {keys}"
+            assert len(await anchor.store.get_prefix("")) <= base_keys + 2
+        finally:
+            await anchor.shutdown()
+
+    asyncio.run(go())
+
+
+def test_soak_watch_backlog_delivers_in_order():
+    """A watcher behind a heavy write burst sees every event for its
+    prefix, in order, without the writer stalling."""
+
+    async def go():
+        url = "memory://soak_watch"
+        rt = await DistributedRuntime.create(store_url=url)
+        try:
+            n = 3000 * SCALE
+            watch = await rt.store.watch_prefix("soak/")
+            seen: list[int] = []
+
+            async def reader():
+                async for ev in watch:
+                    if ev.value is not None:
+                        seen.append(int(ev.value))
+                        if len(seen) >= n:
+                            return
+
+            task = asyncio.get_running_loop().create_task(reader())
+            for i in range(n):
+                await rt.store.put(f"soak/k{i % 97}", str(i).encode())
+                if i % 500 == 0:
+                    await asyncio.sleep(0)  # writer yields like a real loop
+            await asyncio.wait_for(task, timeout=60)
+            assert seen == sorted(seen), "watch delivered out of order"
+            assert len(seen) == n
+            await watch.cancel()
+        finally:
+            await rt.shutdown()
+
+    asyncio.run(go())
+
+
+def test_soak_engine_many_waves_no_leak():
+    """Waves of requests through a real engine: the pool must return to
+    empty between waves and RSS growth stays bounded (no per-request
+    leak)."""
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+    async def go():
+        engine = await TpuEngine(EngineArgs(
+            model=ModelConfig(), block_size=4, num_kv_blocks=128, max_num_seqs=8,
+            max_model_len=128, dtype="float32", decode_steps=4,
+        )).start()
+        try:
+            async def one(i):
+                r = PreprocessedRequest(
+                    model="tiny", token_ids=[(i * 13 + j) % 500 + 1 for j in range(1, 18)]
+                )
+                r.sampling.temperature = 0.0
+                r.stop.max_tokens = 8
+                r.stop.ignore_eos = True
+                n = 0
+                async for item in engine.generate(r, Context()):
+                    n += len(item.get("token_ids") or [])
+                return n
+
+            waves = 12 * SCALE
+            gc.collect()
+            rss_after_first = None
+            for w in range(waves):
+                counts = await asyncio.gather(*(one(w * 16 + i) for i in range(16)))
+                assert all(c == 8 for c in counts)
+                if w == 0:
+                    gc.collect()
+                    rss_after_first = rss_mb()
+            # Engine idle: every block released (prefix cache may retain
+            # registered blocks, but none active).
+            for _ in range(50):
+                if engine.pool.num_active == 0:
+                    break
+                await asyncio.sleep(0.05)
+            assert engine.pool.num_active == 0
+            gc.collect()
+            growth = rss_mb() - rss_after_first
+            assert growth < 200, f"RSS grew {growth:.0f} MB across {waves} waves"
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
