@@ -184,6 +184,11 @@ class EngineArgs:
     # Admission groups same-bucket suffixes; padding rows to pow2 keeps the
     # compile matrix small. 1 = r3's one-at-a-time behaviour.
     prefill_batch_max: int = 8
+    # Alternative-logprob width: requests asking for top_logprobs get up
+    # to this many ranked alternatives; ONE static width keeps the
+    # compile matrix at 2x (with/without) instead of per-N variants.
+    # OpenAI caps chat top_logprobs at 20.
+    top_logprobs_max: int = 8
     # KV tier stack (block_manager/tiers.py): G2 host-RAM blocks (0 = off)
     # and optional G3 disk spill directory.
     host_kv_blocks: int = 0

@@ -104,14 +104,16 @@ class _Seq:
 class _Window:
     """One dispatched multi-step decode window (results not yet fetched)."""
 
-    __slots__ = ("rows", "pos0", "K", "ref", "row_of")
+    __slots__ = ("rows", "pos0", "K", "ref", "row_of", "top_n")
 
-    def __init__(self, rows: list[_Seq], pos0: list[int], K: int, ref):
+    def __init__(self, rows: list[_Seq], pos0: list[int], K: int, ref, top_n: int = 0):
         self.rows = rows
         self.pos0 = pos0
         self.K = K
-        self.ref = ref      # StepRef: arrs = (toks [K,B], logps [K,B])
+        # StepRef: arrs = (toks [K,B], logps [K,B], tvals [K,B,top_n], tids)
+        self.ref = ref
         self.row_of = {s: i for i, s in enumerate(rows)}
+        self.top_n = top_n
 
 
 class TpuEngine:
@@ -155,8 +157,8 @@ class TpuEngine:
         # into per-sequence chain slots; the host fetches them AFTER
         # dispatching the next decode window, so admission never stalls
         # the pipeline (r4 bench: first-token syncs were 68% of wall
-        # time). Entries: (seq, toks_dev, lps_dev, row).
-        self._pending_first: list[tuple[_Seq, Any, Any, int]] = []
+        # time). Entries: (seq, toks_dev, lps_dev, top_ref|None, row).
+        self._pending_first: list[tuple[_Seq, Any, Any, Any, int]] = []
         self._free_slots: list[int] = list(range(args.max_num_seqs))
         # (tokens, future, loop) embedding jobs; served between scheduler
         # steps on the engine thread (device dispatch affinity).
@@ -257,6 +259,12 @@ class TpuEngine:
                 error=f"token id out of range [0, {vocab})",
             ).to_dict()
             return
+        # One static alternative-logprob width (compile-matrix bound);
+        # requests beyond it are clamped, not rejected.
+        if req.sampling.top_logprobs:
+            req.sampling.top_logprobs = min(
+                req.sampling.top_logprobs, self.args.top_logprobs_max
+            )
         queue: asyncio.Queue = asyncio.Queue()
         seq = _Seq(context.id, req, queue)
         with self._wakeup:
@@ -410,6 +418,10 @@ class TpuEngine:
                 slots = np.full((B,), self.args.max_num_seqs, np.int32)
                 slots[: len(seqs)] = [s.slot for s in seqs]
                 out_d, lps_d = self._sample_rows_device(srcs, seqs, slots)
+                top_ref = (
+                    self._runner.top_rows(srcs, self.args.top_logprobs_max)
+                    if any(s.sampling.top_logprobs for s in seqs) else None
+                )
             except Exception as e:  # noqa: BLE001 — admitted seqs are in no
                 # collection yet; orphaning them would hang their streams.
                 log.exception("first-token sampling failed")
@@ -422,7 +434,7 @@ class TpuEngine:
             for i, seq in enumerate(seqs):
                 seq.first_pend = True
                 self._running.append(seq)
-                self._pending_first.append((seq, out_d, lps_d, i))
+                self._pending_first.append((seq, out_d, lps_d, top_ref, i))
             # Prefill-only requests (disagg export, max_tokens=1) finish at
             # the first token — resolve now so they never ride a decode
             # window as instant zombies.
@@ -814,16 +826,26 @@ class TpuEngine:
         pend, self._pending_first = self._pending_first, []
         t0 = time.perf_counter()
         fetched: dict[int, tuple[np.ndarray, np.ndarray]] = {}
-        for seq, out_d, lps_d, _row in pend:
+        fetched_top: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        for seq, out_d, lps_d, top_ref, _row in pend:
             seq.first_pend = False
             if id(out_d) not in fetched:
                 fetched[id(out_d)] = (np.asarray(out_d), np.asarray(lps_d))
+            if top_ref is not None and id(top_ref) not in fetched_top:
+                fetched_top[id(top_ref)] = (
+                    np.asarray(top_ref.arrs[0]), np.asarray(top_ref.arrs[1])
+                )
         t0 = self._phase("first_sample", t0)
-        for seq, out_d, _lps_d, row in pend:
+        for seq, out_d, _lps_d, top_ref, row in pend:
             if seq.dead:
                 continue  # cancelled while the sample was in flight
             toks, lps = fetched[id(out_d)]
-            self._emit_tokens(seq, [int(toks[row])], [float(lps[row])])
+            tops = None
+            if top_ref is not None and seq.sampling.top_logprobs:
+                tvals, tids = fetched_top[id(top_ref)]
+                n = seq.sampling.top_logprobs
+                tops = [[[int(tids[row, r]), float(tvals[row, r])] for r in range(n)]]
+            self._emit_tokens(seq, [int(toks[row])], [float(lps[row])], tops)
         self._phase("emit", t0)
 
     def _plan_window(self) -> tuple[int, bool]:
@@ -954,29 +976,44 @@ class TpuEngine:
         wchain = None
         if chain:
             wchain = ([d for d, _ in chain], [s for _, s in chain])
+        top_n = (
+            self.args.top_logprobs_max
+            if any(s.sampling.top_logprobs for s in batch) else 0
+        )
         t0 = time.perf_counter()
         ref = self._runner.multi_decode(
             K, mode, tokens, wchain, positions, tables, active,
             temps, seeds, steps0, tks, tps, freqs, press, pen, fold_slots,
+            top_n,
         )
         self._phase("decode_dispatch", t0)
-        return _Window(batch, pos0, K, ref)
+        return _Window(batch, pos0, K, ref, top_n)
 
     def _drain_window(self, w: "_Window") -> None:
         self.total_decode_steps += w.K
         t0 = time.perf_counter()
         toks_np = np.asarray(w.ref.arrs[0])  # [K, B] — the one host sync
         logps_np = np.asarray(w.ref.arrs[1])
+        tvals = np.asarray(w.ref.arrs[2]) if w.top_n else None
+        tids = np.asarray(w.ref.arrs[3]) if w.top_n else None
         t0 = self._phase("drain_sync", t0)
         for i, seq in enumerate(w.rows):
             if seq.dead:
                 continue  # finished/cancelled while this window was in flight
             seq.kv_written = w.pos0[i] + w.K
             self._register_written_blocks(seq)
+            tops = None
+            if w.top_n and seq.sampling.top_logprobs:
+                n = seq.sampling.top_logprobs
+                tops = [
+                    [[int(tids[j, i, r]), float(tvals[j, i, r])] for r in range(n)]
+                    for j in range(w.K)
+                ]
             self._emit_tokens(
                 seq,
                 [int(toks_np[j, i]) for j in range(w.K)],
                 [float(logps_np[j, i]) for j in range(w.K)],
+                tops,
             )
         self._phase("emit", t0)
 
@@ -1011,8 +1048,16 @@ class TpuEngine:
         srcs = [(ref, i) for i in range(len(batch))]
         srcs += [(ref, 0)] * (B - len(batch))
         sampled, logps = self._sample_rows(srcs, batch)
+        tvals = tids = None
+        if any(s.sampling.top_logprobs for s in batch):
+            tref = self._runner.top_rows(srcs, self.args.top_logprobs_max)
+            tvals, tids = np.asarray(tref.arrs[0]), np.asarray(tref.arrs[1])
         for i, seq in enumerate(batch):
-            self._emit_tokens(seq, [int(sampled[i])], [float(logps[i])])
+            tops = None
+            if tvals is not None and seq.sampling.top_logprobs:
+                n = seq.sampling.top_logprobs
+                tops = [[[int(tids[i, r]), float(tvals[i, r])] for r in range(n)]]
+            self._emit_tokens(seq, [int(sampled[i])], [float(logps[i])], tops)
         self._phase("single_step", t_start)
 
     @staticmethod
@@ -1073,7 +1118,8 @@ class TpuEngine:
 
     # -- token emission / finish ------------------------------------------
 
-    def _emit_tokens(self, seq: _Seq, toks: list[int], logps: list[float] | None = None) -> None:
+    def _emit_tokens(self, seq: _Seq, toks: list[int], logps: list[float] | None = None,
+                     tops: list | None = None) -> None:
         """Append sampled tokens (a multi-step window or a single token),
         truncating at the first stop condition. Posts ONE output delta with
         the kept tokens — tokens past a mid-window stop are wasted device
@@ -1108,6 +1154,11 @@ class TpuEngine:
                 token_ids=kept,
                 finish_reason=finish,
                 log_probs=logps[: len(kept)] if logps and seq.sampling.logprobs else None,
+                top_log_probs=(
+                    tops[: len(kept)]
+                    if tops and seq.sampling.logprobs and seq.sampling.top_logprobs
+                    else None
+                ),
                 kv_transfer_params=seq.export_meta if finish is not None else None,
             ).to_dict(),
         )

@@ -410,6 +410,7 @@ def multi_decode_impl(
     cfg: ModelConfig,
     num_steps: int,           # static — fused substep count
     mode: str,                # static — "greedy" | "simple" | "full"
+    top_n: int,               # static — top-n alternative logprobs (0 = off)
     params: Params,
     cache: KVCache,
     tokens: jax.Array,        # [B] int32 — current token per sequence
@@ -449,15 +450,18 @@ def multi_decode_impl(
     Rows that hit a stop condition mid-window keep generating; the host
     truncates after the sync (wasted work is bounded by num_steps).
 
-    Returns (tokens [num_steps, B], logprobs [num_steps, B] fp32, cache):
+    Returns (tokens [num_steps, B], logprobs [num_steps, B] fp32,
+    top_vals [num_steps, B, top_n], top_ids [num_steps, B, top_n], cache):
     logprobs are the chosen-token log-softmax values (pre-penalty, raw
     model distribution — OpenAI reports model logprobs, not sampler-
-    modified ones)."""
+    modified ones); top_* are the raw-distribution ranked alternatives
+    (zero-sized when top_n == 0)."""
     from dynamo_tpu.engine.sampler import (
         apply_penalties,
         sample_step,
         token_counts,
         token_logprobs,
+        top_k_logprobs,
     )
 
     B = tokens.shape[0]
@@ -498,12 +502,17 @@ def multi_decode_impl(
             nxt = sample_step(penalized, temperature, top_k, top_p, row_gumbel(i))
             counts = counts.at[jnp.arange(B), nxt].add(1.0)
         logp = token_logprobs(logits, nxt)
-        return (cache, nxt, pos + 1, counts), (nxt, logp)
+        if top_n > 0:
+            tvals, tids = top_k_logprobs(logits, top_n)
+        else:
+            tvals = jnp.zeros((B, 0), jnp.float32)
+            tids = jnp.zeros((B, 0), jnp.int32)
+        return (cache, nxt, pos + 1, counts), (nxt, logp, tvals, tids)
 
-    (cache, _, _, _), (toks, logps) = lax.scan(
+    (cache, _, _, _), (toks, logps, top_vals, top_ids) = lax.scan(
         substep, (cache, tokens, positions, counts0), jnp.arange(num_steps, dtype=jnp.int32)
     )
-    return toks, logps, cache  # [num_steps, B] each
+    return toks, logps, top_vals, top_ids, cache  # [num_steps, B(, top_n)]
 
 
 def embed_impl(
@@ -556,6 +565,6 @@ decode_step = functools.partial(
     jax.jit, static_argnums=(0,), static_argnames=("attn_impl",), donate_argnums=(2,)
 )(decode_step_impl)
 multi_decode = functools.partial(
-    jax.jit, static_argnums=(0, 1, 2), static_argnames=("attn_impl",), donate_argnums=(4,)
+    jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("attn_impl",), donate_argnums=(5,)
 )(multi_decode_impl)
 embed = functools.partial(jax.jit, static_argnums=(0,))(embed_impl)

@@ -190,14 +190,15 @@ class LocalRunner:
 
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
-                     fold_slots=None, *, rid=None) -> StepRef:
+                     fold_slots=None, top_n=0, *, rid=None) -> StepRef:
         """chain: None | (dst rows, src slots) — rows of this window whose
         input token is the latest on-device sample for that sequence SLOT
         (previous window fold or admission first-token fold; no host
         sync). Shapes stay fixed per batch bucket: chaining is expressed
         as a [B] mask + slot map inside the jit. ``fold_slots`` [B] names
         each row's slot so the window's final tokens land back in the
-        buffer (padding rows → dummy tail slot)."""
+        buffer (padding rows → dummy tail slot). ``top_n`` (static) adds
+        ranked alternative logprobs to the ref."""
         B = len(tokens)
         self._ensure_last_toks()
         mask = np.zeros((B,), bool)
@@ -206,8 +207,8 @@ class LocalRunner:
             dst, src = chain
             mask[np.asarray(dst, np.int64)] = True
             srcmap[np.asarray(dst, np.int64)] = src
-        toks_d, logps_d, self.cache = M.multi_decode(
-            self.cfg, K, mode, self.params, self.cache,
+        toks_d, logps_d, tvals_d, tids_d, self.cache = M.multi_decode(
+            self.cfg, K, mode, int(top_n), self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(active),
             jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
@@ -221,7 +222,16 @@ class LocalRunner:
         self._last_toks = _fold_tokens(
             self._last_toks, toks_d[-1], jnp.asarray(fold_slots, jnp.int32)
         )
-        return self._new_ref((toks_d, logps_d), rid)
+        return self._new_ref((toks_d, logps_d, tvals_d, tids_d), rid)
+
+    def top_rows(self, srcs, n: int) -> StepRef:
+        """Ranked top-n alternative logprobs for sampled rows (first
+        tokens / single-step path) → ref of (vals [B, n], ids [B, n])."""
+        from dynamo_tpu.engine.sampler import top_k_logprobs
+
+        logits = self.stack_rows(srcs)
+        vals, ids = top_k_logprobs(logits, int(n))
+        return self._new_ref((vals, ids))
 
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
         logits, self.cache = M.decode_step(
@@ -368,7 +378,7 @@ class LeaderRunner(LocalRunner):
 
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
-                     fold_slots=None, *, rid=None) -> StepRef:
+                     fold_slots=None, top_n=0, *, rid=None) -> StepRef:
         rid = self._rid
         wire_chain = None
         if chain is not None:
@@ -381,11 +391,20 @@ class LeaderRunner(LocalRunner):
                     "seeds": _pack_np(seeds), "steps0": _pack_np(steps0),
                     "tks": _pack_np(tks), "tps": _pack_np(tps),
                     "freqs": _pack_np(freqs), "press": _pack_np(press),
-                    "pen": _pack_np(pen),
+                    "pen": _pack_np(pen), "top_n": int(top_n),
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().multi_decode(K, mode, tokens, chain, positions, tables,
                                     active, temps, seeds, steps0, tks, tps,
-                                    freqs, press, pen, fold_slots, rid=rid)
+                                    freqs, press, pen, fold_slots, top_n, rid=rid)
+
+    def top_rows(self, srcs, n: int) -> StepRef:
+        wire_srcs = [
+            [ref.rid if isinstance(ref, StepRef) else ref,
+             None if row is None else int(row)]
+            for ref, row in srcs
+        ]
+        self._cast({"op": "top_rows", "srcs": wire_srcs, "n": int(n)})
+        return super().top_rows(srcs, n)
 
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
         rid = self._rid
@@ -485,12 +504,15 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["tks"]), _unpack_np(desc["tps"]),
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
                 _unpack_np(desc["pen"]),
-                None if fold is None else _unpack_np(fold), rid=desc["rid"])
+                None if fold is None else _unpack_np(fold),
+                desc.get("top_n", 0), rid=desc["rid"])
         elif op == "decode_step":
             runner.decode_step(
                 _unpack_np(desc["tokens"]), _unpack_np(desc["positions"]),
                 _unpack_np(desc["tables"]), _unpack_np(desc["active"]),
                 rid=desc["rid"])
+        elif op == "top_rows":
+            runner.top_rows([(s[0], s[1]) for s in desc["srcs"]], desc["n"])
         elif op == "sample_rows":
             fold = desc.get("fold")
             runner.sample_rows(

@@ -23,6 +23,8 @@ for the reference.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -131,6 +133,16 @@ def token_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     logz = jax.nn.logsumexp(logits, axis=-1)
     chosen = jnp.take_along_axis(logits, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
     return chosen - logz
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def top_k_logprobs(logits: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
+    """Top-n alternative logprobs of the RAW model distribution (OpenAI
+    top_logprobs reports pre-sampler probabilities): [B, V] →
+    (logprobs [B, n], token ids [B, n]), most likely first."""
+    logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    vals, ids = jax.lax.top_k(logits, n)
+    return vals - logz, ids
 
 
 def row_needs_full(top_k, top_p, freq_penalty, pres_penalty) -> bool:

@@ -139,6 +139,7 @@ class Backend(Operator):
                 text="".join(text_parts) if text_parts else None,
                 finish_reason=finish,
                 log_probs=list(out.log_probs[:n_new]) if out.log_probs else None,
+                top_log_probs=out.top_log_probs[:n_new] if out.top_log_probs else None,
                 cum_log_probs=out.cum_log_probs,
                 kv_transfer_params=out.kv_transfer_params,
             )

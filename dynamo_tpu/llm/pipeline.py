@@ -221,7 +221,8 @@ class ModelPipeline:
                 raise RuntimeError(out.error or "engine error")
             finish = out.finish_reason.value if out.finish_reason else None
             chunks = gen.on_delta(out.text, len(out.token_ids), finish,
-                                  token_ids=out.token_ids, logprobs=out.log_probs)
+                                  token_ids=out.token_ids, logprobs=out.log_probs,
+                                  top_logprobs=out.top_log_probs)
             if not chunks:
                 yield gen, None
             for c in chunks:

@@ -114,6 +114,13 @@ class OpenAIPreprocessor:
                 if isinstance(req, CompletionRequest)
                 else bool(getattr(req, "logprobs", False))
             ),
+            # Chat: explicit top_logprobs (0-20). Completions: logprobs=N
+            # asks for N ranked alternatives per position.
+            top_logprobs=(
+                int(getattr(req, "logprobs", 0) or 0)
+                if isinstance(req, CompletionRequest)
+                else int(getattr(req, "top_logprobs", 0) or 0)
+            ),
         )
         # Budget: explicit max_tokens, else whatever fits in context.
         budget = self.card.context_length - len(token_ids)
@@ -243,28 +250,53 @@ class DeltaGenerator:
         self.want_tools = want_tools
         self.tool_names = tool_names or set()
         self._token_text = token_text_fn or (lambda tid: "")
-        # Accumulated (token_id, logprob) for the final response.
+        # Accumulated (token_id, logprob, alternatives) for the final
+        # response; alternatives entries are [[token_id, logprob], ...].
         self.lp_tokens: list[int] = []
         self.lp_values: list[float] = []
+        self.lp_tops: list[list | None] = []
 
-    def _lp_delta(self, token_ids, logprobs) -> dict | None:
-        """OpenAI logprobs payload for this delta (chosen token only; we
-        do not rank alternatives — top_logprobs stays empty)."""
+    def _top_entries(self, top: list | None) -> list[dict]:
+        """One token's ranked alternatives → OpenAI chat entries."""
+        if not top:
+            return []
+        return [
+            {"token": self._token_text(int(tid)), "logprob": float(lp),
+             "bytes": list(self._token_text(int(tid)).encode())}
+            for tid, lp in top
+        ]
+
+    def _top_map(self, top: list | None) -> dict | None:
+        """One token's alternatives → completions {token: logprob} map."""
+        if not top:
+            return None
+        return {self._token_text(int(tid)): float(lp) for tid, lp in top}
+
+    def _lp_delta(self, token_ids, logprobs, top_logprobs=None) -> dict | None:
+        """OpenAI logprobs payload for this delta: chosen token plus the
+        engine's ranked alternatives when top_logprobs was requested."""
         if not (self.want_logprobs and token_ids and logprobs):
             return None
         n = min(len(token_ids), len(logprobs))
+        tops = list(top_logprobs[:n]) if top_logprobs else [None] * n
+        tops += [None] * (n - len(tops))
         self.lp_tokens += list(token_ids[:n])
         self.lp_values += [float(x) for x in logprobs[:n]]
+        self.lp_tops += tops
         if self.kind == "chat":
             content = [
                 {"token": self._token_text(t), "logprob": float(lp),
-                 "bytes": list(self._token_text(t).encode()), "top_logprobs": []}
-                for t, lp in zip(token_ids[:n], logprobs[:n])
+                 "bytes": list(self._token_text(t).encode()),
+                 "top_logprobs": self._top_entries(top)}
+                for t, lp, top in zip(token_ids[:n], logprobs[:n], tops)
             ]
             return {"content": content}
         toks = [self._token_text(t) for t in token_ids[:n]]
         return {"tokens": toks, "token_logprobs": [float(x) for x in logprobs[:n]],
-                "top_logprobs": None, "text_offset": []}
+                "top_logprobs": (
+                    [self._top_map(t) for t in tops] if any(tops) else None
+                ),
+                "text_offset": []}
 
     def final_logprobs(self) -> dict | None:
         if not self.want_logprobs or not self.lp_tokens:
@@ -272,24 +304,29 @@ class DeltaGenerator:
         if self.kind == "chat":
             return {"content": [
                 {"token": self._token_text(t), "logprob": lp,
-                 "bytes": list(self._token_text(t).encode()), "top_logprobs": []}
-                for t, lp in zip(self.lp_tokens, self.lp_values)
+                 "bytes": list(self._token_text(t).encode()),
+                 "top_logprobs": self._top_entries(top)}
+                for t, lp, top in zip(self.lp_tokens, self.lp_values, self.lp_tops)
             ]}
         return {"tokens": [self._token_text(t) for t in self.lp_tokens],
-                "token_logprobs": self.lp_values, "top_logprobs": None,
+                "token_logprobs": self.lp_values,
+                "top_logprobs": (
+                    [self._top_map(t) for t in self.lp_tops]
+                    if any(self.lp_tops) else None
+                ),
                 "text_offset": []}
 
     def usage(self) -> dict[str, int]:
         return usage_dict(self.prompt_tokens, self.completion_tokens)
 
     def on_delta(self, text: str | None, n_tokens: int, finish_reason: str | None,
-                 token_ids=None, logprobs=None) -> list[dict]:
+                 token_ids=None, logprobs=None, top_logprobs=None) -> list[dict]:
         """→ list of SSE chunk payload dicts for this engine delta."""
         self.completion_tokens += n_tokens
         chunks: list[dict] = []
         if text:
             self.text_parts.append(text)
-        lp = self._lp_delta(token_ids, logprobs)
+        lp = self._lp_delta(token_ids, logprobs, top_logprobs)
         if self.kind == "chat":
             if self._first:
                 self._first = False

@@ -41,6 +41,7 @@ class SamplingOptions:
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
     logprobs: bool = False  # return chosen-token logprobs per delta
+    top_logprobs: int = 0   # alternatives per position (0 = chosen only)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -51,6 +52,7 @@ class SamplingOptions:
             "frequency_penalty": self.frequency_penalty,
             "presence_penalty": self.presence_penalty,
             "logprobs": self.logprobs,
+            "top_logprobs": self.top_logprobs,
         }
 
     @classmethod
@@ -63,6 +65,7 @@ class SamplingOptions:
             frequency_penalty=float(d.get("frequency_penalty", 0.0)),
             presence_penalty=float(d.get("presence_penalty", 0.0)),
             logprobs=bool(d.get("logprobs", False)),
+            top_logprobs=int(d.get("top_logprobs", 0)),
         )
 
 
@@ -165,6 +168,9 @@ class LLMEngineOutput:
     cum_log_probs: float | None = None
     # Per-token logprobs aligned with token_ids (when requested).
     log_probs: list[float] | None = None
+    # Per-token top alternatives aligned with token_ids (when requested):
+    # one [[token_id, logprob], ...] list per token, most likely first.
+    top_log_probs: list[list[list[float]]] | None = None
     # Disaggregation: prefill workers return KV block descriptors here.
     kv_transfer_params: dict[str, Any] | None = None
     # Error detail when finish_reason == ERROR.
@@ -184,6 +190,8 @@ class LLMEngineOutput:
             d["cum_log_probs"] = self.cum_log_probs
         if self.log_probs is not None:
             d["log_probs"] = list(self.log_probs)
+        if self.top_log_probs is not None:
+            d["top_log_probs"] = self.top_log_probs
         if self.kv_transfer_params is not None:
             d["kv_transfer_params"] = self.kv_transfer_params
         if self.error is not None:
@@ -198,6 +206,7 @@ class LLMEngineOutput:
             finish_reason=FinishReason.parse(d.get("finish_reason")),
             cum_log_probs=d.get("cum_log_probs"),
             log_probs=d.get("log_probs"),
+            top_log_probs=d.get("top_log_probs"),
             kv_transfer_params=d.get("kv_transfer_params"),
             error=d.get("error"),
         )
@@ -295,6 +304,7 @@ class ChatCompletionRequest:
     messages: list[ChatMessage]
     stream: bool = False
     logprobs: bool = False            # chosen-token logprobs per delta
+    top_logprobs: int = 0             # 0-20 ranked alternatives per position
     tools: list[dict] = field(default_factory=list)   # OpenAI function tools
     tool_choice: Any = None           # "auto" | "none" | {...}
     max_tokens: int | None = None
@@ -327,12 +337,19 @@ class ChatCompletionRequest:
         n = d.get("n", 1)
         if n != 1:
             raise OpenAIError("'n' != 1 is not supported")
+        top_lp = d.get("top_logprobs", 0)
+        if top_lp:
+            if not isinstance(top_lp, int) or not 0 <= top_lp <= 20:
+                raise OpenAIError("'top_logprobs' must be an integer in [0, 20]")
+            if not d.get("logprobs"):
+                raise OpenAIError("'top_logprobs' requires 'logprobs': true")
         ext = d.get("nvext") or d.get("ext") or {}
         return cls(
             model=model,
             messages=[ChatMessage.parse(m) for m in msgs],
             stream=bool(d.get("stream", False)),
             logprobs=bool(d.get("logprobs", False)),
+            top_logprobs=int(top_lp or 0),
             tools=list(d.get("tools") or []),
             tool_choice=d.get("tool_choice"),
             max_tokens=max_tokens,

@@ -391,8 +391,11 @@ def run_dp_spawner(args, argv) -> int:
 
     base = [a for a in (argv if argv is not None else sys.argv[1:])]
     procs: list[subprocess.Popen] = []
+    stopping = False
 
     def forward(signum, _frame):
+        nonlocal stopping
+        stopping = True  # mid-launch: abort spawning further ranks too
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signum)
@@ -403,6 +406,8 @@ def run_dp_spawner(args, argv) -> int:
     sig.signal(sig.SIGINT, forward)
     try:
         for r in range(args.dp_size):
+            if stopping:
+                break
             env = dict(os.environ)
             if args.dp_chips_per_rank > 0:
                 k = args.dp_chips_per_rank

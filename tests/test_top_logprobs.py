@@ -1,0 +1,184 @@
+"""top_logprobs: ranked alternatives end to end (engine → OpenAI API).
+
+Reference surface: OpenAI chat `top_logprobs` / completions `logprobs=N`
+(reference serves these via vLLM; analysis consumer is
+lib/llm/src/perf/logprobs.rs — our llm/logprobs.py)."""
+
+import asyncio
+import math
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+
+CFG = ModelConfig()
+
+
+def make_engine(**kw):
+    args = EngineArgs(
+        model=CFG, block_size=4, num_kv_blocks=64, max_num_seqs=4,
+        max_model_len=128, dtype="float32", decode_steps=4, **kw,
+    )
+    return TpuEngine(args)
+
+
+def make_request(n_top=3, max_tokens=6):
+    r = PreprocessedRequest(model="tiny", token_ids=[5, 9, 13, 17, 21])
+    r.sampling.temperature = 0.0
+    r.sampling.logprobs = True
+    r.sampling.top_logprobs = n_top
+    r.stop.max_tokens = max_tokens
+    r.stop.ignore_eos = True
+    return r
+
+
+def test_engine_emits_ranked_alternatives():
+    async def go():
+        engine = await make_engine().start()
+        try:
+            toks, lps, tops = [], [], []
+            async for item in engine.generate(make_request(), Context()):
+                toks += item.get("token_ids") or []
+                lps += item.get("log_probs") or []
+                tops += item.get("top_log_probs") or []
+            assert len(toks) == len(lps) == len(tops) == 6
+            for chosen, chosen_lp, top in zip(toks, lps, tops):
+                assert len(top) == 3
+                vals = [lp for _tid, lp in top]
+                assert vals == sorted(vals, reverse=True)  # ranked
+                # Greedy: the chosen token IS the top-1 alternative, with
+                # the same raw-distribution logprob.
+                assert top[0][0] == chosen
+                assert math.isclose(top[0][1], chosen_lp, rel_tol=1e-5, abs_tol=1e-5)
+                # Distribution sanity: probabilities <= 1 and descending.
+                assert all(lp <= 1e-6 for lp in vals)
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_engine_clamps_to_top_logprobs_max():
+    async def go():
+        engine = await make_engine(top_logprobs_max=4).start()
+        try:
+            tops = []
+            async for item in engine.generate(make_request(n_top=20, max_tokens=3), Context()):
+                tops += item.get("top_log_probs") or []
+            assert tops and all(len(t) == 4 for t in tops)
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_top_logprobs_mixed_batch_and_parity():
+    """A batch mixing top-requesting and plain requests: plain streams see
+    no alternatives, and tokens are unchanged by the extra outputs."""
+
+    async def go():
+        engine = await make_engine().start()
+        try:
+            async def run(req):
+                toks, tops = [], []
+                async for item in engine.generate(req, Context()):
+                    toks += item.get("token_ids") or []
+                    tops += item.get("top_log_probs") or []
+                return toks, tops
+
+            plain = make_request(n_top=0)
+            plain.sampling.top_logprobs = 0
+            (t1, p1), (t2, p2) = await asyncio.gather(
+                run(make_request()), run(plain)
+            )
+            assert p1 and not p2
+            # Same greedy continuation regardless of top emission.
+            solo = await run(make_request(n_top=0))
+            assert t2 == solo[0]
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_http_surface_top_logprobs():
+    """Chat with top_logprobs=2 and completions with logprobs=2 over a
+    REAL engine through the frontend."""
+    import httpx
+
+    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    from test_frontend_e2e import start_frontend
+
+    async def go():
+        url = "memory://toplp"
+        rt = await DistributedRuntime.create(store_url=url)
+        engine = await make_engine().start()
+        broadcaster = KvEventBroadcaster(engine.pool)
+        engine.pool.set_event_sink(broadcaster.publish)
+        comp = rt.namespace("e2e").component("backend")
+
+        async def gen_handler(payload, ctx):
+            async for item in engine.generate(payload, ctx):
+                yield item
+
+        await comp.endpoint("generate").serve(gen_handler)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        await register_model(rt, "e2e", ModelDeploymentCard(
+            name="tiny", kv_cache_block_size=4,
+            eos_token_ids=[ByteTokenizer.EOS], context_length=128,
+        ))
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                r = await client.post(f"{base}/v1/chat/completions", json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "abc"}],
+                    "max_tokens": 4, "logprobs": True, "top_logprobs": 2,
+                })
+                assert r.status_code == 200
+                content = r.json()["choices"][0]["logprobs"]["content"]
+                assert len(content) == 4
+                for entry in content:
+                    assert len(entry["top_logprobs"]) == 2
+                    assert isinstance(entry["top_logprobs"][0]["logprob"], float)
+
+                # top_logprobs without logprobs: OpenAI 400.
+                r = await client.post(f"{base}/v1/chat/completions", json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "abc"}],
+                    "max_tokens": 2, "top_logprobs": 2,
+                })
+                assert r.status_code == 400
+
+                # Completions: logprobs=2 → per-token {token: lp} maps.
+                r = await client.post(f"{base}/v1/completions", json={
+                    "model": "tiny", "prompt": "xy", "max_tokens": 3, "logprobs": 2,
+                })
+                assert r.status_code == 200
+                lp = r.json()["choices"][0]["logprobs"]
+                assert len(lp["token_logprobs"]) == 3
+                # Text-keyed maps may collapse below N when distinct token
+                # ids decode to the same text (byte-tokenizer "�"s) — the
+                # OpenAI completions format has no way to express that.
+                assert lp["top_logprobs"] and all(
+                    isinstance(m, dict) and 1 <= len(m) <= 2
+                    and all(isinstance(v, float) for v in m.values())
+                    for m in lp["top_logprobs"]
+                )
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await engine.stop()
+            await rt.shutdown()
+
+    asyncio.run(go())

@@ -96,13 +96,13 @@ def main():
     tps = jnp.ones((B,), jnp.float32)
     zeros = jnp.zeros((B,), jnp.float32)
     fused = jax.jit(
-        lambda c, w, t, p: M.multi_decode_impl(cfg, K, "greedy", w, c, t, p, tables, active,
+        lambda c, w, t, p: M.multi_decode_impl(cfg, K, "greedy", 0, w, c, t, p, tables, active,
                                                temps, seeds, steps0, tks, tps, zeros, zeros, pen),
         donate_argnums=(0,),
     )
 
     def fused_carry(c, *a):
-        toks, _logps, c2 = fused(c, *a)
+        toks, _logps, _tv, _ti, c2 = fused(c, *a)
         return toks, c2
 
     t = timed_carry(fused_carry, cache, params, tokens, positions, iters=args.iters)

@@ -83,7 +83,7 @@ def main():
     pr = jnp.zeros((B,), jnp.float32)
     pen = jnp.full((B, 1), -1, jnp.int32)
 
-    f = jax.jit(lambda c: M.multi_decode_impl(cfg, K, "greedy", params, c, tokens, positions, tables, active, temps, seeds, steps0, tks, tps, fr, pr, pen)[0])
+    f = jax.jit(lambda c: M.multi_decode_impl(cfg, K, "greedy", 0, params, c, tokens, positions, tables, active, temps, seeds, steps0, tks, tps, fr, pr, pen)[0])
     t = timeit(lambda: f(cache), n=3)
     print(f"multi_decode K={K} B={B} W={W}: {t*1e3:8.2f} ms/window  {K*B/t:9.0f} tok/s  ({t/K*1e3:.2f} ms/step)")
 

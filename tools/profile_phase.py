@@ -153,8 +153,8 @@ def main():
 
         def window(carry, prm):
             c, tok = carry
-            toks, _lp, c = M.multi_decode_impl(
-                cfg, K, "greedy", prm, c, tok, positions, tables, active,
+            toks, _lp, _tv, _ti, c = M.multi_decode_impl(
+                cfg, K, "greedy", 0, prm, c, tok, positions, tables, active,
                 ones, seeds, zi, zi, ones, zf, zf, pen,
                 attn_impl=args.attn_impl)
             return (c, toks[-1])
