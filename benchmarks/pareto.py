@@ -1,0 +1,240 @@
+"""Throughput/latency Pareto sweep over the serving slice.
+
+Reference analogue: the GenAI-Perf-driven sweep + Pareto plots
+(reference: benchmarks/llm/perf.sh, benchmarks/llm/plot_pareto.py) — the
+operating-point picker: sweep Poisson arrival rates, record per-rate
+throughput and TTFT/ITL percentiles, and mark the Pareto-efficient
+points (no other rate has both higher goodput and lower latency).
+
+Backends:
+- mocker fleet (default; CPU, deterministic-ish cost model) — CI-runnable
+  evidence of the methodology;
+- a LIVE frontend via --base-url (point it at any running deployment,
+  TPU workers included) — the production sweep.
+
+Output: one JSON object per rate on stdout + optional --output file;
+--plot writes a PNG when matplotlib is importable.
+
+Run: python benchmarks/pareto.py [--rates 2,4,8,16] [--num-requests 160]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+def pctl(xs, p):
+    return round(float(np.percentile(xs, p)) * 1000, 1) if xs else float("nan")
+
+
+async def drive_rate(base: str, model: str, rate: float, n: int, gen_len: int,
+                     prompt_len: int, seed: int) -> dict:
+    """Poisson arrivals at `rate` req/s against a live frontend → row."""
+    import httpx
+
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, n)
+    arrivals = np.cumsum(gaps) - gaps[0]
+    prompts = ["".join(chr(65 + int(c)) for c in rng.integers(0, 26, prompt_len))
+               for _ in range(n)]
+
+    ttfts: list[float] = []
+    itls: list[float] = []
+    total_toks = 0
+    errors = 0
+
+    async with httpx.AsyncClient(
+        timeout=300, limits=httpx.Limits(max_connections=512)
+    ) as client:
+
+        async def one(i: int):
+            nonlocal total_toks, errors
+            await asyncio.sleep(float(arrivals[i]))
+            t0 = time.perf_counter()
+            first = last = None
+            n_tok = 0
+            try:
+                async with client.stream(
+                    "POST", f"{base}/v1/completions",
+                    json={"model": model, "prompt": prompts[i],
+                          "max_tokens": gen_len, "stream": True,
+                          "ignore_eos": True},
+                ) as resp:
+                    if resp.status_code != 200:
+                        errors += 1
+                        return
+                    async for line in resp.aiter_lines():
+                        if line.startswith("data: ") and line != "data: [DONE]":
+                            now = time.perf_counter()
+                            if first is None:
+                                first = now
+                            last = now
+                            n_tok += 1
+            except Exception:  # noqa: BLE001 — overload shows as errors
+                errors += 1
+                return
+            if first is not None:
+                ttfts.append(first - t0)
+                if n_tok > 1:
+                    itls.append((last - first) / (n_tok - 1))
+                total_toks += gen_len  # deltas may batch; tokens are fixed
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(one(i) for i in range(n)))
+        dur = time.perf_counter() - t0
+
+    return {
+        "rate_rps": rate,
+        "tok_s": round(total_toks / dur, 1),
+        "ttft_p50_ms": pctl(ttfts, 50),
+        "ttft_p95_ms": pctl(ttfts, 95),
+        "ttft_p99_ms": pctl(ttfts, 99),
+        "itl_p50_ms": pctl(itls, 50),
+        "itl_p95_ms": pctl(itls, 95),
+        "errors": errors,
+        "num_requests": n,
+    }
+
+
+async def with_mocker_fleet(n_workers: int, mocker_kw: dict, fn):
+    """Stand up store + mocker fleet + frontend in-process, call
+    fn(base_url, model), tear down."""
+    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+    from dynamo_tpu.llm.pipeline import RouterSettings
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+    from dynamo_tpu.runtime.push_router import RouterMode
+
+    url = "memory://pareto"
+    rts = []
+    for _ in range(n_workers):
+        rt = await DistributedRuntime.create(store_url=url)
+        engine = MockerEngine(MockerArgs(**mocker_kw))
+        broadcaster = KvEventBroadcaster(engine.pool)
+        engine.pool.set_event_sink(broadcaster.publish)
+        comp = rt.namespace("pareto").component("backend")
+
+        async def handler(payload, ctx, engine=engine):
+            async for item in engine.generate(payload, ctx):
+                yield item
+
+        await comp.endpoint("generate").serve(handler)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        rts.append(rt)
+    await register_model(rts[0], "pareto", ModelDeploymentCard(
+        name="pareto-model", kv_cache_block_size=mocker_kw.get("block_size", 16),
+        eos_token_ids=[ByteTokenizer.EOS], context_length=16384,
+    ))
+    frt = await DistributedRuntime.create(store_url=url)
+    manager = ModelManager(frt, RouterSettings(mode=RouterMode.KV))
+    watcher = await ModelWatcher(frt, manager).start()
+    http = await HttpService(manager, MetricsRegistry(), host="127.0.0.1", port=0).start()
+    try:
+        return await fn(f"http://127.0.0.1:{http.port}", "pareto-model")
+    finally:
+        await http.close()
+        await watcher.close()
+        await manager.close()
+        await frt.shutdown()
+        for rt in rts:
+            await rt.shutdown()
+
+
+def mark_pareto(rows: list[dict], lat_key: str = "ttft_p95_ms") -> None:
+    """A row is Pareto-efficient when no other row has >= tok_s AND
+    <= latency (with one strict)."""
+    for r in rows:
+        r["pareto"] = not any(
+            o is not r
+            and o["tok_s"] >= r["tok_s"] and o[lat_key] <= r[lat_key]
+            and (o["tok_s"] > r["tok_s"] or o[lat_key] < r[lat_key])
+            for o in rows
+        )
+
+
+async def amain(args) -> list[dict]:
+    async def sweep(base: str, model: str) -> list[dict]:
+        rows = []
+        for i, rate in enumerate(args.rates):
+            row = await drive_rate(
+                base, model, rate, args.num_requests, args.gen_len,
+                args.prompt_len, seed=i,
+            )
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        return rows
+
+    if args.base_url:
+        rows = await sweep(args.base_url, args.model)
+    else:
+        rows = await with_mocker_fleet(
+            args.workers,
+            dict(block_size=16, num_kv_blocks=4096, max_num_seqs=64,
+                 ttft_ms=20.0, itl_ms=args.mocker_itl_ms),
+            sweep,
+        )
+    mark_pareto(rows)
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="benchmarks/pareto.py")
+    p.add_argument("--rates", default="2,4,8,16,32",
+                   help="comma-separated Poisson arrival rates (req/s)")
+    p.add_argument("--num-requests", type=int, default=160)
+    p.add_argument("--gen-len", type=int, default=64)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--workers", type=int, default=2, help="mocker fleet size")
+    p.add_argument("--mocker-itl-ms", type=float, default=5.0)
+    p.add_argument("--base-url", default=None,
+                   help="sweep a LIVE frontend instead of the mocker fleet")
+    p.add_argument("--model", default="pareto-model")
+    p.add_argument("--output", default=None, help="write rows JSON here")
+    p.add_argument("--plot", default=None, help="write a PNG here (needs matplotlib)")
+    args = p.parse_args(argv)
+    args.rates = [float(r) for r in str(args.rates).split(",")]
+
+    rows = asyncio.run(amain(args))
+    front = [r for r in rows if r["pareto"]]
+    print(json.dumps({"pareto_frontier": [
+        {"rate_rps": r["rate_rps"], "tok_s": r["tok_s"], "ttft_p95_ms": r["ttft_p95_ms"]}
+        for r in front
+    ]}))
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(rows, f, indent=1)
+    if args.plot:
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            xs = [r["tok_s"] for r in rows]
+            ys = [r["ttft_p95_ms"] for r in rows]
+            plt.figure(figsize=(6, 4))
+            plt.plot(xs, ys, "o", color="#999")
+            fx = sorted((r["tok_s"], r["ttft_p95_ms"]) for r in front)
+            plt.plot([x for x, _ in fx], [y for _, y in fx], "o-", color="#c00")
+            plt.xlabel("throughput (tok/s)")
+            plt.ylabel("TTFT p95 (ms)")
+            plt.title("throughput vs latency — Pareto frontier")
+            plt.tight_layout()
+            plt.savefig(args.plot, dpi=120)
+        except ImportError:
+            print(json.dumps({"plot_skipped": "matplotlib not available"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -210,11 +210,21 @@ class EngineArgs:
 
     @property
     def prefill_buckets(self) -> tuple[int, ...]:
-        # 4x stride: every (Bp x T x W) combination is a separate compile
-        # (~30s each over a remote-compile tunnel), so the lattice must
-        # stay small; padding short prefills 4x is cheap MXU time.
+        # 2x stride through the common range, 4x beyond 512: prefill is
+        # where the FLOPs are, and a 4x stride meant a median ShareGPT
+        # prompt (~130 tok) padded to 512 — measured as ~2/3 of the 8B
+        # bench's device time going to prefill padding (BENCH r5 phase
+        # breakdown). Each (Bp x T x W) combination is still a separate
+        # compile, so the stride widens again past 512 where real prompts
+        # thin out.
         lo = min(max(self.block_size * 2, 32), self.max_prefill_tokens)
-        return _pow2_buckets(lo, self.max_prefill_tokens, factor=4)
+        out = []
+        b = lo
+        while b < self.max_prefill_tokens:
+            out.append(b)
+            b *= 2 if b < 512 else 4
+        out.append(self.max_prefill_tokens)
+        return tuple(dict.fromkeys(out))
 
     @property
     def decode_buckets(self) -> tuple[int, ...]:
@@ -254,8 +264,13 @@ class EngineArgs:
         raise ValueError(f"prefill of {n} tokens exceeds max_prefill_tokens={self.max_prefill_tokens}")
 
     def bucket_prefill_rows(self, n: int) -> int:
-        # Two sizes (1 or max): each row-count is its own compile.
-        return 1 if n <= 1 else self.prefill_batch_max
+        # Pow2 row ladder: steady-state admission waves are small (1-3
+        # slots free per step), and padding a 2-seq wave to 8 rows cost
+        # 4x its prefill compute (each padded row runs the full model).
+        b = 1
+        while b < min(n, self.prefill_batch_max):
+            b *= 2
+        return min(b, self.prefill_batch_max)
 
     def bucket_decode(self, n: int) -> int:
         for b in self.decode_buckets:

@@ -260,10 +260,12 @@ class TpuEngine:
             ).to_dict()
             return
         # One static alternative-logprob width (compile-matrix bound);
-        # requests beyond it are clamped, not rejected.
+        # requests beyond it are clamped, not rejected. top_logprobs
+        # without logprobs would pay the top-k and emit nothing — zero it.
         if req.sampling.top_logprobs:
-            req.sampling.top_logprobs = min(
-                req.sampling.top_logprobs, self.args.top_logprobs_max
+            req.sampling.top_logprobs = (
+                min(req.sampling.top_logprobs, self.args.top_logprobs_max)
+                if req.sampling.logprobs else 0
             )
         queue: asyncio.Queue = asyncio.Queue()
         seq = _Seq(context.id, req, queue)
@@ -417,10 +419,10 @@ class TpuEngine:
                     s.slot = self._free_slots.pop()
                 slots = np.full((B,), self.args.max_num_seqs, np.int32)
                 slots[: len(seqs)] = [s.slot for s in seqs]
-                out_d, lps_d = self._sample_rows_device(srcs, seqs, slots)
-                top_ref = (
-                    self._runner.top_rows(srcs, self.args.top_logprobs_max)
-                    if any(s.sampling.top_logprobs for s in seqs) else None
+                out_d, lps_d, top_ref = self._sample_rows_device(
+                    srcs, seqs, slots,
+                    top_n=(self.args.top_logprobs_max
+                           if any(s.sampling.top_logprobs for s in seqs) else 0),
                 )
             except Exception as e:  # noqa: BLE001 — admitted seqs are in no
                 # collection yet; orphaning them would hang their streams.
@@ -603,8 +605,16 @@ class TpuEngine:
 
         bmax = max(1, self.args.prefill_batch_max)
         for t_pad, members in sorted(groups.items()):
-            for i in range(0, len(members), bmax):
-                sub = members[i : i + bmax]
+            # Greedy pow2 packs (5 → 4+1): every dispatch exactly fills
+            # its row bucket, so no padded row ever runs the model.
+            i = 0
+            while i < len(members):
+                take = min(bmax, len(members) - i)
+                p = 1
+                while p * 2 <= take:
+                    p *= 2
+                sub = members[i : i + p]
+                i += p
                 arr = self._prefill_packed(sub, t_pad)
                 for row, (seq, start) in enumerate(sub):
                     out.append((seq, arr, row))
@@ -1047,10 +1057,13 @@ class TpuEngine:
             self._register_written_blocks(seq)
         srcs = [(ref, i) for i in range(len(batch))]
         srcs += [(ref, 0)] * (B - len(batch))
-        sampled, logps = self._sample_rows(srcs, batch)
+        sampled, logps, tref = self._sample_rows(
+            srcs, batch,
+            top_n=(self.args.top_logprobs_max
+                   if any(s.sampling.top_logprobs for s in batch) else 0),
+        )
         tvals = tids = None
-        if any(s.sampling.top_logprobs for s in batch):
-            tref = self._runner.top_rows(srcs, self.args.top_logprobs_max)
+        if tref is not None:
             tvals, tids = np.asarray(tref.arrs[0]), np.asarray(tref.arrs[1])
         for i, seq in enumerate(batch):
             tops = None
@@ -1079,17 +1092,17 @@ class TpuEngine:
             pen[i, : len(gen)] = gen
         return pen
 
-    def _sample_rows(self, srcs, seqs: list[_Seq]) -> tuple[np.ndarray, np.ndarray]:
+    def _sample_rows(self, srcs, seqs: list[_Seq], top_n: int = 0):
         """Sample one token per row for the first len(seqs) rows, synced.
         ``srcs``: list of (StepRef, row|None) logits sources (padded to a
-        bucket). → (tokens [B], chosen-token logprobs [B])."""
-        out, logps = self._sample_rows_device(srcs, seqs, None)
-        return np.asarray(out), np.asarray(logps)  # the one host sync per step
+        bucket). → (tokens [B], chosen logprobs [B], top_ref|None)."""
+        out, logps, top_ref = self._sample_rows_device(srcs, seqs, None, top_n)
+        return np.asarray(out), np.asarray(logps), top_ref  # the one host sync
 
-    def _sample_rows_device(self, srcs, seqs: list[_Seq], fold_slots):
+    def _sample_rows_device(self, srcs, seqs: list[_Seq], fold_slots, top_n: int = 0):
         """Device-side sampling; with ``fold_slots`` the tokens also land
         in the chain buffer for the next window (async admission).
-        → (tokens [B], logprobs [B]) as unfetched device arrays."""
+        → (tokens [B], logprobs [B], top_ref|None) unfetched."""
         B = len(srcs)
         temps = np.ones((B,), np.float32)
         tks = np.zeros((B,), np.int32)
@@ -1113,7 +1126,7 @@ class TpuEngine:
         )
         return self._runner.sample_rows(
             srcs, temps, tks, tps, pen, freqs, press, seeds, steps, full,
-            fold_slots,
+            fold_slots, top_n,
         )
 
     # -- token emission / finish ------------------------------------------

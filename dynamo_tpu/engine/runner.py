@@ -224,15 +224,6 @@ class LocalRunner:
         )
         return self._new_ref((toks_d, logps_d, tvals_d, tids_d), rid)
 
-    def top_rows(self, srcs, n: int) -> StepRef:
-        """Ranked top-n alternative logprobs for sampled rows (first
-        tokens / single-step path) → ref of (vals [B, n], ids [B, n])."""
-        from dynamo_tpu.engine.sampler import top_k_logprobs
-
-        logits = self.stack_rows(srcs)
-        vals, ids = top_k_logprobs(logits, int(n))
-        return self._new_ref((vals, ids))
-
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
         logits, self.cache = M.decode_step(
             self.cfg, self.params, self.cache,
@@ -253,11 +244,15 @@ class LocalRunner:
         return jnp.stack(rows)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
-                    steps, full: bool, fold_slots=None):
-        """→ (tokens [B], logprobs [B]) as device arrays (leader fetches).
-        With ``fold_slots``, the sampled tokens also land in the per-slot
-        chain buffer so the next decode window can consume them without a
-        host sync (async admission)."""
+                    steps, full: bool, fold_slots=None, top_n: int = 0):
+        """→ (tokens [B], logprobs [B], top_ref|None) as device arrays
+        (leader fetches). With ``fold_slots``, the sampled tokens also
+        land in the per-slot chain buffer so the next decode window can
+        consume them without a host sync (async admission). ``top_n``
+        adds ranked alternatives computed from the SAME stacked logits
+        (one gather, one logsumexp — not a second pass)."""
+        from dynamo_tpu.engine.sampler import top_k_logprobs
+
         logits = self.stack_rows(srcs)
         if full:
             out = sample_full(
@@ -272,7 +267,11 @@ class LocalRunner:
             self._last_toks = _fold_tokens(
                 self._last_toks, out, jnp.asarray(fold_slots, jnp.int32)
             )
-        return out, token_logprobs(logits, out)
+        top_ref = None
+        if top_n > 0:
+            vals, ids = top_k_logprobs(logits, int(top_n))
+            top_ref = self._new_ref((vals, ids))
+        return out, token_logprobs(logits, out), top_ref
 
     def embed(self, toks, tlen, *, rid=None) -> StepRef:
         emb = M.embed(self.cfg, self.params, jnp.asarray(toks), jnp.int32(tlen))
@@ -397,15 +396,6 @@ class LeaderRunner(LocalRunner):
                                     active, temps, seeds, steps0, tks, tps,
                                     freqs, press, pen, fold_slots, top_n, rid=rid)
 
-    def top_rows(self, srcs, n: int) -> StepRef:
-        wire_srcs = [
-            [ref.rid if isinstance(ref, StepRef) else ref,
-             None if row is None else int(row)]
-            for ref, row in srcs
-        ]
-        self._cast({"op": "top_rows", "srcs": wire_srcs, "n": int(n)})
-        return super().top_rows(srcs, n)
-
     def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "decode_step", "rid": rid,
@@ -414,7 +404,7 @@ class LeaderRunner(LocalRunner):
         return super().decode_step(tokens, positions, tables, active, rid=rid)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
-                    steps, full: bool, fold_slots=None):
+                    steps, full: bool, fold_slots=None, top_n: int = 0):
         wire_srcs = [
             [ref.rid if isinstance(ref, StepRef) else ref,
              None if row is None else int(row)]
@@ -425,10 +415,10 @@ class LeaderRunner(LocalRunner):
                     "tps": _pack_np(tps), "pen": _pack_np(pen),
                     "freqs": _pack_np(freqs), "press": _pack_np(press),
                     "seeds": _pack_np(seeds), "steps": _pack_np(steps),
-                    "full": bool(full),
+                    "full": bool(full), "top_n": int(top_n),
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().sample_rows(srcs, temps, tks, tps, pen, freqs, press,
-                                   seeds, steps, full, fold_slots)
+                                   seeds, steps, full, fold_slots, top_n)
 
     def embed(self, toks, tlen, *, rid=None) -> StepRef:
         rid = self._rid
@@ -511,8 +501,6 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["tokens"]), _unpack_np(desc["positions"]),
                 _unpack_np(desc["tables"]), _unpack_np(desc["active"]),
                 rid=desc["rid"])
-        elif op == "top_rows":
-            runner.top_rows([(s[0], s[1]) for s in desc["srcs"]], desc["n"])
         elif op == "sample_rows":
             fold = desc.get("fold")
             runner.sample_rows(
@@ -521,7 +509,8 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["tps"]), _unpack_np(desc["pen"]),
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
                 _unpack_np(desc["seeds"]), _unpack_np(desc["steps"]),
-                desc["full"], None if fold is None else _unpack_np(fold))
+                desc["full"], None if fold is None else _unpack_np(fold),
+                desc.get("top_n", 0))
         elif op == "embed":
             runner.embed(_unpack_np(desc["toks"]), desc["tlen"], rid=desc["rid"])
         elif op == "extract_pages":

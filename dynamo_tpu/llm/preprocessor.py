@@ -266,11 +266,16 @@ class DeltaGenerator:
             for tid, lp in top
         ]
 
-    def _top_map(self, top: list | None) -> dict | None:
-        """One token's alternatives → completions {token: logprob} map."""
+    def _top_map(self, top: list | None, chosen_id=None, chosen_lp=None) -> dict | None:
+        """One token's alternatives → completions {token: logprob} map.
+        OpenAI includes the CHOSEN token as an extra entry when it fell
+        outside the top-N (maps may hold N+1 entries)."""
         if not top:
             return None
-        return {self._token_text(int(tid)): float(lp) for tid, lp in top}
+        out = {self._token_text(int(tid)): float(lp) for tid, lp in top}
+        if chosen_id is not None:
+            out.setdefault(self._token_text(int(chosen_id)), float(chosen_lp))
+        return out
 
     def _lp_delta(self, token_ids, logprobs, top_logprobs=None) -> dict | None:
         """OpenAI logprobs payload for this delta: chosen token plus the
@@ -294,7 +299,9 @@ class DeltaGenerator:
         toks = [self._token_text(t) for t in token_ids[:n]]
         return {"tokens": toks, "token_logprobs": [float(x) for x in logprobs[:n]],
                 "top_logprobs": (
-                    [self._top_map(t) for t in tops] if any(tops) else None
+                    [self._top_map(t, tid, lp)
+                     for t, tid, lp in zip(tops, token_ids[:n], logprobs[:n])]
+                    if any(tops) else None
                 ),
                 "text_offset": []}
 
@@ -311,7 +318,8 @@ class DeltaGenerator:
         return {"tokens": [self._token_text(t) for t in self.lp_tokens],
                 "token_logprobs": self.lp_values,
                 "top_logprobs": (
-                    [self._top_map(t) for t in self.lp_tops]
+                    [self._top_map(t, tid, lp) for t, tid, lp in
+                     zip(self.lp_tops, self.lp_tokens, self.lp_values)]
                     if any(self.lp_tops) else None
                 ),
                 "text_offset": []}

@@ -339,7 +339,9 @@ class ChatCompletionRequest:
             raise OpenAIError("'n' != 1 is not supported")
         top_lp = d.get("top_logprobs", 0)
         if top_lp:
-            if not isinstance(top_lp, int) or not 0 <= top_lp <= 20:
+            # bool is an int subclass; {"top_logprobs": true} is a type
+            # error (clients confusing it with the logprobs flag).
+            if isinstance(top_lp, bool) or not isinstance(top_lp, int) or not 0 <= top_lp <= 20:
                 raise OpenAIError("'top_logprobs' must be an integer in [0, 20]")
             if not d.get("logprobs"):
                 raise OpenAIError("'top_logprobs' requires 'logprobs': true")

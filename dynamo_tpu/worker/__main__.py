@@ -427,6 +427,11 @@ def run_dp_spawner(args, argv) -> int:
             if p.poll() is None:
                 p.terminate()
         raise
+    if stopping:
+        # A rank spawned while the handler ran may have missed the signal.
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
     print(f"dynamo_tpu dp spawner: {args.dp_size} ranks launched", flush=True)
     rcs = [p.wait() for p in procs]
     return max((abs(rc) for rc in rcs), default=0)
@@ -443,6 +448,15 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    # Persistent compile cache: restart MTTR drops from minutes of XLA
+    # compiles to seconds once the lattice has been warmed (AOT warm via
+    # `python bench.py --precompile-only` pointed at the same dir).
+    cache_dir = os.environ.get("DYNTPU_COMPILE_CACHE")
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     args = parse_args(argv)
     if args.dp_size > 1 and args.dp_rank is None:
         return run_dp_spawner(args, argv)

@@ -54,9 +54,12 @@ def test_routing_ab_smoke():
 
     from benchmarks.routing_ab import run_ab
 
+    # Arrivals spaced enough for KV events to propagate between requests:
+    # at 200 req/s under a loaded CI host the index lags arrivals and the
+    # kv-vs-rr separation gets noisy (observed flake at 0.56 vs 0.60).
     args = argparse.Namespace(
         workers=2, num_requests=60, groups=12, prefix_len=128,
-        suffix_len=16, gen_len=4, arrival_rate=200.0, zipf=0.0,
+        suffix_len=16, gen_len=4, arrival_rate=80.0, zipf=0.0,
         block_size=16, kv_blocks=96, speedup=20.0, seed=0,
     )
     summary = asyncio.run(run_ab(args))
@@ -64,3 +67,35 @@ def test_routing_ab_smoke():
     assert kv["requests"] == rr["requests"] == 60
     assert kv["prefix_hit_rate_mean"] > rr["prefix_hit_rate_mean"]
     assert summary["hit_rate_delta"] > 0.0
+
+
+def test_pareto_sweep_over_mocker_fleet():
+    """benchmarks/pareto.py (reference: benchmarks/llm/perf.sh +
+    plot_pareto.py): rates sweep yields monotone throughput, sane
+    latencies, and a non-empty Pareto frontier."""
+    from benchmarks.pareto import amain, mark_pareto
+
+    class A:
+        rates = [8.0, 64.0]
+        num_requests = 40
+        gen_len = 16
+        prompt_len = 64
+        workers = 2
+        mocker_itl_ms = 2.0
+        base_url = None
+        model = "pareto-model"
+
+    rows = asyncio.run(amain(A()))
+    assert len(rows) == 2
+    assert rows[1]["tok_s"] > rows[0]["tok_s"]  # higher rate → more goodput
+    assert all(r["errors"] == 0 for r in rows)
+    assert all(r["ttft_p95_ms"] > 0 for r in rows)
+    assert any(r["pareto"] for r in rows)
+    # mark_pareto semantics: a strictly-dominated point is not efficient.
+    fake = [
+        {"tok_s": 100, "ttft_p95_ms": 10},
+        {"tok_s": 90, "ttft_p95_ms": 20},   # dominated
+        {"tok_s": 200, "ttft_p95_ms": 30},
+    ]
+    mark_pareto(fake)
+    assert [r["pareto"] for r in fake] == [True, False, True]
