@@ -1,0 +1,61 @@
+"""Shared mocker-fleet + frontend standup for the benchmark harnesses
+(routing_ab.py, pareto.py) — one place for the wiring and the teardown
+ordering."""
+
+from __future__ import annotations
+
+from contextlib import asynccontextmanager
+
+
+@asynccontextmanager
+async def mocker_fleet(url: str, n_workers: int, mocker_kw: dict,
+                       router_mode: str = "kv", model_name: str = "fleet-model",
+                       namespace: str = "fleet"):
+    """Store + N mocker workers + KV-event endpoints + frontend HTTP, all
+    in-process. Yields (base_url, model_name, engines)."""
+    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+    from dynamo_tpu.llm.pipeline import RouterSettings
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+    from dynamo_tpu.runtime.push_router import RouterMode
+
+    engines = []
+    rts = []
+    for _ in range(n_workers):
+        rt = await DistributedRuntime.create(store_url=url)
+        engine = MockerEngine(MockerArgs(**mocker_kw))
+        broadcaster = KvEventBroadcaster(engine.pool)
+        engine.pool.set_event_sink(broadcaster.publish)
+        comp = rt.namespace(namespace).component("backend")
+
+        async def handler(payload, ctx, engine=engine):
+            async for item in engine.generate(payload, ctx):
+                yield item
+
+        await comp.endpoint("generate").serve(handler)
+        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+        engines.append(engine)
+        rts.append(rt)
+    await register_model(rts[0], namespace, ModelDeploymentCard(
+        name=model_name, kv_cache_block_size=mocker_kw.get("block_size", 16),
+        eos_token_ids=[ByteTokenizer.EOS], context_length=16384,
+    ))
+    frt = await DistributedRuntime.create(store_url=url)
+    rmode = RouterMode.KV if router_mode == "kv" else RouterMode.ROUND_ROBIN
+    manager = ModelManager(frt, RouterSettings(mode=rmode))
+    watcher = await ModelWatcher(frt, manager).start()
+    http = await HttpService(manager, MetricsRegistry(), host="127.0.0.1", port=0).start()
+    try:
+        yield f"http://127.0.0.1:{http.port}", model_name, engines
+    finally:
+        await http.close()
+        await watcher.close()
+        await manager.close()
+        await frt.shutdown()
+        for rt in rts:
+            await rt.shutdown()

@@ -102,60 +102,29 @@ async def drive_rate(base: str, model: str, rate: float, n: int, gen_len: int,
 
 
 async def with_mocker_fleet(n_workers: int, mocker_kw: dict, fn):
-    """Stand up store + mocker fleet + frontend in-process, call
-    fn(base_url, model), tear down."""
-    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
-    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
-    from dynamo_tpu.llm.http_service import HttpService
-    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
-    from dynamo_tpu.llm.pipeline import RouterSettings
-    from dynamo_tpu.llm.tokenizer import ByteTokenizer
-    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
-    from dynamo_tpu.runtime.distributed import DistributedRuntime
-    from dynamo_tpu.runtime.metrics import MetricsRegistry
-    from dynamo_tpu.runtime.push_router import RouterMode
+    """Stand up store + mocker fleet + frontend in-process (shared
+    harness, benchmarks/_fleet.py), call fn(base_url, model), tear
+    down."""
+    from benchmarks._fleet import mocker_fleet
 
-    url = "memory://pareto"
-    rts = []
-    for _ in range(n_workers):
-        rt = await DistributedRuntime.create(store_url=url)
-        engine = MockerEngine(MockerArgs(**mocker_kw))
-        broadcaster = KvEventBroadcaster(engine.pool)
-        engine.pool.set_event_sink(broadcaster.publish)
-        comp = rt.namespace("pareto").component("backend")
-
-        async def handler(payload, ctx, engine=engine):
-            async for item in engine.generate(payload, ctx):
-                yield item
-
-        await comp.endpoint("generate").serve(handler)
-        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
-        rts.append(rt)
-    await register_model(rts[0], "pareto", ModelDeploymentCard(
-        name="pareto-model", kv_cache_block_size=mocker_kw.get("block_size", 16),
-        eos_token_ids=[ByteTokenizer.EOS], context_length=16384,
-    ))
-    frt = await DistributedRuntime.create(store_url=url)
-    manager = ModelManager(frt, RouterSettings(mode=RouterMode.KV))
-    watcher = await ModelWatcher(frt, manager).start()
-    http = await HttpService(manager, MetricsRegistry(), host="127.0.0.1", port=0).start()
-    try:
-        return await fn(f"http://127.0.0.1:{http.port}", "pareto-model")
-    finally:
-        await http.close()
-        await watcher.close()
-        await manager.close()
-        await frt.shutdown()
-        for rt in rts:
-            await rt.shutdown()
+    async with mocker_fleet(
+        "memory://pareto", n_workers, mocker_kw,
+        router_mode="kv", model_name="pareto-model", namespace="pareto",
+    ) as (base, model, _engines):
+        return await fn(base, model)
 
 
 def mark_pareto(rows: list[dict], lat_key: str = "ttft_p95_ms") -> None:
     """A row is Pareto-efficient when no other row has >= tok_s AND
-    <= latency (with one strict)."""
+    <= latency (with one strict). All-error rows (NaN latency) are never
+    efficient — NaN compares false against everything, which would
+    otherwise crown a 0-throughput overload point."""
     for r in rows:
+        if r[lat_key] != r[lat_key]:  # NaN
+            r["pareto"] = False
+            continue
         r["pareto"] = not any(
-            o is not r
+            o is not r and o[lat_key] == o[lat_key]
             and o["tok_s"] >= r["tok_s"] and o[lat_key] <= r[lat_key]
             and (o["tok_s"] > r["tok_s"] or o[lat_key] < r[lat_key])
             for o in rows

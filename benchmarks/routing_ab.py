@@ -30,49 +30,12 @@ async def run_mode(mode: str, trace: list[dict], n_workers: int,
                    mocker_kw: dict) -> dict:
     import httpx
 
-    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
-    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
-    from dynamo_tpu.llm.http_service import HttpService
-    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
-    from dynamo_tpu.llm.pipeline import RouterSettings
-    from dynamo_tpu.llm.tokenizer import ByteTokenizer
-    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
-    from dynamo_tpu.runtime.distributed import DistributedRuntime
-    from dynamo_tpu.runtime.metrics import MetricsRegistry
-    from dynamo_tpu.runtime.push_router import RouterMode
+    from benchmarks._fleet import mocker_fleet
 
-    url = f"memory://ab-{mode}"
-    engines = []
-    rts = []
-    for _ in range(n_workers):
-        rt = await DistributedRuntime.create(store_url=url)
-        engine = MockerEngine(MockerArgs(**mocker_kw))
-        broadcaster = KvEventBroadcaster(engine.pool)
-        engine.pool.set_event_sink(broadcaster.publish)
-        comp = rt.namespace("ab").component("backend")
-
-        async def handler(payload, ctx, engine=engine):
-            async for item in engine.generate(payload, ctx):
-                yield item
-
-        await comp.endpoint("generate").serve(handler)
-        await serve_kv_endpoints(comp, broadcaster, engine.metrics)
-        engines.append(engine)
-        rts.append(rt)
-    card = ModelDeploymentCard(
-        name="ab-model", kv_cache_block_size=mocker_kw.get("block_size", 16),
-        eos_token_ids=[ByteTokenizer.EOS], context_length=16384,
-    )
-    await register_model(rts[0], "ab", card)
-
-    frt = await DistributedRuntime.create(store_url=url)
-    rmode = RouterMode.KV if mode == "kv" else RouterMode.ROUND_ROBIN
-    manager = ModelManager(frt, RouterSettings(mode=rmode))
-    watcher = await ModelWatcher(frt, manager).start()
-    http = await HttpService(manager, MetricsRegistry(), host="127.0.0.1", port=0).start()
-    base = f"http://127.0.0.1:{http.port}"
-
-    try:
+    async with mocker_fleet(
+        f"memory://ab-{mode}", n_workers, mocker_kw,
+        router_mode=mode, model_name="ab-model", namespace="ab",
+    ) as (base, _model, engines):
         async with httpx.AsyncClient(
             timeout=120, limits=httpx.Limits(max_connections=512)
         ) as client:
@@ -101,20 +64,13 @@ async def run_mode(mode: str, trace: list[dict], n_workers: int,
             t0 = time.perf_counter()
             ttfts = await asyncio.gather(*(one(r) for r in trace))
             dur = time.perf_counter() - t0
-    finally:
-        await http.close()
-        await watcher.close()
-        await manager.close()
-        await frt.shutdown()
-        for rt in rts:
-            await rt.shutdown()
+        hit_rates = [e.pool.hit_rate for e in engines]
 
     ttfts = [t for t in ttfts if t == t]
 
     def q(p: float) -> float:
         return round(float(np.percentile(ttfts, p)) * 1000, 1) if ttfts else float("nan")
 
-    hit_rates = [e.pool.hit_rate for e in engines]
     return {
         "mode": mode,
         "errors": errors[0],

@@ -29,6 +29,9 @@ class ModelConfig:
     max_position: int = 8192
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
+    # QKV projection bias (Qwen2-family). o_proj stays bias-free, as in
+    # the architecture.
+    attn_bias: bool = False
     # Mixture-of-experts (0 = dense FFN). Experts shard over the ``ep``
     # mesh axis (parallel/mesh.py) — the reference reaches wide-EP only
     # through engine flags (trtllm_utils.py:140-143, sglang wide-EP docs);
@@ -57,6 +60,8 @@ class ModelConfig:
             + ffn
             + 2 * d                                                   # norms
         )
+        if self.attn_bias:
+            per_layer += self.q_size + 2 * self.kv_size
         head = 0 if self.tie_embeddings else d * v
         return v * d + self.num_layers * per_layer + d + head
 
@@ -99,6 +104,13 @@ class ModelConfig:
                 intermediate_size=14336, num_layers=32, num_heads=32,
                 num_kv_heads=8, head_dim=128, rope_theta=500000.0,
                 max_position=131072, tie_embeddings=False,
+            ),
+            # Qwen2.5-7B-class (QKV bias; fits one v5e with int8)
+            "qwen2-7b": ModelConfig(
+                name="qwen2-7b", vocab_size=152064, hidden_size=3584,
+                intermediate_size=18944, num_layers=28, num_heads=28,
+                num_kv_heads=4, head_dim=128, rope_theta=1000000.0,
+                max_position=32768, tie_embeddings=False, attn_bias=True,
             ),
             # Mixtral-style MoE (test/dev scale; EP over the ep mesh axis)
             "moe-tiny": ModelConfig(
@@ -181,9 +193,13 @@ class EngineArgs:
     # finished sequence). Full-sampler batches always run unpipelined.
     pipeline_windows: bool = True
     # Max sequences packed into one prefill dispatch (model.prefill_batch).
-    # Admission groups same-bucket suffixes; padding rows to pow2 keeps the
-    # compile matrix small. 1 = r3's one-at-a-time behaviour.
-    prefill_batch_max: int = 8
+    # Default 1 (singles): packing existed because r3 paid a host sync per
+    # admission, but async admission pipelines single-row prefills with no
+    # sync — and every extra row bucket multiplies the compile lattice
+    # that warmup must cover (a cold variant hit mid-run costs a ~30s
+    # tunnel compile, measured as a 609-vs-890 tok/s bench regression).
+    # Raise it only with a warmed cache covering the (T x Bp x W) matrix.
+    prefill_batch_max: int = 1
     # Alternative-logprob width: requests asking for top_logprobs get up
     # to this many ranked alternatives; ONE static width keeps the
     # compile matrix at 2x (with/without) instead of per-N variants.

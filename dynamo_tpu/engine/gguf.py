@@ -173,6 +173,9 @@ class GGUFFile:
             if not vocab:
                 raise ValueError("GGUF missing vocab_size and tokenizer tokens")
         tied = "output.weight" not in self.tensors
+        # Qwen2 GGUFs carry QKV bias tensors; detect from the tensor list
+        # (no metadata flag exists).
+        attn_bias = "blk.0.attn_q.bias" in self.tensors
         return ModelConfig(
             name=name or md.get("general.name") or "gguf-model",
             vocab_size=int(vocab),
@@ -186,6 +189,7 @@ class GGUFFile:
             rms_norm_eps=float(k("attention.layer_norm_rms_epsilon", 1e-5)),
             max_position=int(k("context_length", 8192)),
             tie_embeddings=tied,
+            attn_bias=attn_bias,
         )
 
     def eos_token_ids(self) -> list[int]:
@@ -245,15 +249,19 @@ def load_gguf_params(
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = take("output.weight").T
+    if cfg.attn_bias:
+        params["layers"]["bq"] = stack("blk.{i}.attn_q.bias", False)
+        params["layers"]["bk"] = stack("blk.{i}.attn_k.bias", False)
+        params["layers"]["bv"] = stack("blk.{i}.attn_v.bias", False)
 
     leftovers = sorted(set(g.tensors) - consumed)
     biases = [n for n in leftovers if n.endswith(".bias")]
     if biases:
-        # Silently dropping projection biases (qwen2 has them) would
-        # serve garbage logits with no diagnostic.
+        # Silently dropping OTHER projection biases would serve garbage
+        # logits with no diagnostic (QKV bias is handled above).
         raise NotImplementedError(
-            f"GGUF has {len(biases)} bias tensors (e.g. {biases[0]}) — "
-            f"bias-bearing architectures are not supported yet"
+            f"GGUF has {len(biases)} unsupported bias tensors (e.g. "
+            f"{biases[0]})"
         )
     if leftovers:
         log.warning("ignoring %d unexpected GGUF tensors (e.g. %s)",

@@ -54,6 +54,9 @@ def config_from_hf(model_path: str) -> ModelConfig:
     known = {"LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM"}
     if archs and not (set(archs) & known):
         log.warning("untested architecture %s — loading with llama layout", archs)
+    # Qwen2 hardcodes QKV bias in its modeling code (no config field);
+    # llama-family checkpoints carry an explicit attention_bias flag.
+    attn_bias = bool(hf.get("attention_bias", False)) or "Qwen2ForCausalLM" in archs
     hidden = int(hf["hidden_size"])
     heads = int(hf["num_attention_heads"])
     head_dim = int(hf.get("head_dim") or hidden // heads)
@@ -70,6 +73,7 @@ def config_from_hf(model_path: str) -> ModelConfig:
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         max_position=int(hf.get("max_position_embeddings", 8192)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        attn_bias=attn_bias,
         dtype=str(hf.get("torch_dtype", "bfloat16")).replace("torch.", ""),
     )
 
@@ -151,11 +155,23 @@ def load_params(
         },
         "final_norm": take("model.norm.weight"),
     }
+    if cfg.attn_bias:
+        params["layers"]["bq"] = stack(f"{L}.self_attn.q_proj.bias", False)
+        params["layers"]["bk"] = stack(f"{L}.self_attn.k_proj.bias", False)
+        params["layers"]["bv"] = stack(f"{L}.self_attn.v_proj.bias", False)
     if not cfg.tie_embeddings:
         params["lm_head"] = take("lm_head.weight").T
     else:
         raw.pop("lm_head.weight", None)  # some tied checkpoints still store it
     leftovers = [k for k in raw if not k.endswith("rotary_emb.inv_freq")]
+    biases = [k for k in leftovers if k.endswith(".bias")]
+    if biases:
+        # Same policy as the GGUF loader: silently dropping projection
+        # biases serves wrong logits with no diagnostic.
+        raise NotImplementedError(
+            f"checkpoint has {len(biases)} unsupported bias tensors (e.g. "
+            f"{biases[0]}) — only QKV bias (attn_bias architectures) is wired"
+        )
     if leftovers:
         log.warning("ignoring %d unexpected tensors (e.g. %s)", len(leftovers), leftovers[:3])
 

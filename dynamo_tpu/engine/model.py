@@ -71,6 +71,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "attn_norm": jnp.ones((L, d), dtype),
         "mlp_norm": jnp.ones((L, d), dtype),
     }
+    if cfg.attn_bias:
+        bkey = jax.random.fold_in(key, 31)
+        layers["bq"] = (jax.random.normal(bkey, (L, cfg.q_size), jnp.float32) * 0.02).astype(dtype)
+        layers["bk"] = (jax.random.normal(jax.random.fold_in(bkey, 1), (L, cfg.kv_size), jnp.float32) * 0.02).astype(dtype)
+        layers["bv"] = (jax.random.normal(jax.random.fold_in(bkey, 2), (L, cfg.kv_size), jnp.float32) * 0.02).astype(dtype)
     if cfg.num_experts:
         E = cfg.num_experts
         ie = cfg.moe_intermediate_size or i
@@ -132,6 +137,20 @@ def _embed_rows(params: Params, tokens: jax.Array, dtype) -> jax.Array:
         scale = params["embed_scale"][tokens].astype(dtype)
         return e.astype(dtype) * scale[..., None]
     return e
+
+
+def _qkv(h: jax.Array, lp: dict, cfg: ModelConfig):
+    """Fused-layout q/k/v projections with optional Qwen2-style bias
+    (o_proj is bias-free in that family). Shapes: h [..., D] →
+    ([..., q_size], [..., kv_size], [..., kv_size])."""
+    q = _dot_q(h, lp, "wq")
+    k = _dot_q(h, lp, "wk")
+    v = _dot_q(h, lp, "wv")
+    if cfg.attn_bias:
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    return q, k, v
 
 
 def _mlp(x, lp):
@@ -254,9 +273,10 @@ def prefill_batch_impl(
         x, k_cache, v_cache = carry
         lp, layer_idx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = _dot_q(h, lp, "wq").reshape(Bp, T, cfg.num_heads, hd)
-        k = _dot_q(h, lp, "wk").reshape(Bp, T, KVH, hd)
-        v = _dot_q(h, lp, "wv").reshape(Bp, T, KVH, hd)
+        q, k, v = _qkv(h, lp, cfg)
+        q = q.reshape(Bp, T, cfg.num_heads, hd)
+        k = k.reshape(Bp, T, KVH, hd)
+        v = v.reshape(Bp, T, KVH, hd)
         q = _rope(q, suffix_positions, cfg.rope_theta)
         k = _rope(k, suffix_positions, cfg.rope_theta)
 
@@ -372,9 +392,10 @@ def decode_step_impl(
         x, k_cache, v_cache = carry
         lp, layer_idx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = _dot_q(h, lp, "wq").reshape(B, cfg.num_heads, cfg.head_dim)
-        k = _dot_q(h, lp, "wk").reshape(B, cfg.num_kv_heads, cfg.head_dim)
-        v = _dot_q(h, lp, "wv").reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(h, lp, cfg)
+        q = q.reshape(B, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(B, cfg.num_kv_heads, cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         qg = q.reshape(B, cfg.num_kv_heads, G, cfg.head_dim)
@@ -537,9 +558,10 @@ def embed_impl(
 
     def layer(x, lp):
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = _dot_q(h, lp, "wq").reshape(T, cfg.num_heads, cfg.head_dim)
-        k = _dot_q(h, lp, "wk").reshape(T, cfg.num_kv_heads, cfg.head_dim)
-        v = _dot_q(h, lp, "wv").reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(h, lp, cfg)
+        q = q.reshape(T, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
         q = _rope(q, pos, cfg.rope_theta)
         k = _rope(k, pos, cfg.rope_theta)
         qg = q.reshape(T, cfg.num_kv_heads, G, cfg.head_dim)

@@ -80,6 +80,7 @@ def random_int8_params(cfg, seed: int = 0, dtype: str = "bfloat16") -> dict[str,
     forward finite); decode timing is weight-value-independent."""
     if getattr(cfg, "num_experts", 0):
         raise NotImplementedError("int8 random init not wired for MoE configs")
+    attn_bias = getattr(cfg, "attn_bias", False)
     import ml_dtypes
 
     # Norms define the activation compute dtype (model._embed_rows keys
@@ -109,6 +110,11 @@ def random_int8_params(cfg, seed: int = 0, dtype: str = "bfloat16") -> dict[str,
         ).copy()
     layers["attn_norm"] = np.ones((L, d), ndt)
     layers["mlp_norm"] = np.ones((L, d), ndt)
+    if attn_bias:
+        # Biases stay float (never quantized), same as real checkpoints.
+        layers["bq"] = (rng.standard_normal((L, cfg.q_size)) * 0.02).astype(ndt)
+        layers["bk"] = (rng.standard_normal((L, cfg.kv_size)) * 0.02).astype(ndt)
+        layers["bv"] = (rng.standard_normal((L, cfg.kv_size)) * 0.02).astype(ndt)
     params: dict[str, Any] = {
         "embed": rng.integers(-127, 128, size=(cfg.vocab_size, d), dtype=np.int16).astype(np.int8),
         "embed_scale": np.full((cfg.vocab_size,), (d ** -0.5) / 64.0, np.float32),

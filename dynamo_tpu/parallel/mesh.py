@@ -123,6 +123,13 @@ class ModelSharding:
             "wq": col, "wk": kv_col, "wv": kv_col, "wo": row,
             "attn_norm": rep, "mlp_norm": rep,
         }
+        if self.cfg.attn_bias:
+            # Biases follow their weight's OUTPUT-dim sharding.
+            layer_shardings.update({
+                "bq": self._ns(None, TP_AXES),
+                "bk": self._ns(None, TP_KV_AXIS),
+                "bv": self._ns(None, TP_KV_AXIS),
+            })
         if self.cfg.num_experts:
             # Experts over ep, expert-FFN width over tp (wide-EP x TP):
             # the MoE einsums contract e locally and psum the combine.

@@ -140,6 +140,10 @@ def tiny_gguf(path: str, cfg: ModelConfig, params_np: dict, *,
             tensors[name] = (a.T if tr else a, quant_map.get(gname, 0))
     if not cfg.tie_embeddings:
         tensors["output.weight"] = (params_np["lm_head"].T, 0)
+    if cfg.attn_bias:
+        for i in range(cfg.num_layers):
+            for gname, ours in (("attn_q", "bq"), ("attn_k", "bk"), ("attn_v", "bv")):
+                tensors[f"blk.{i}.{gname}.bias"] = (params_np["layers"][ours][i], 0)
     write_gguf(path, meta, tensors)
 
 
@@ -281,3 +285,44 @@ def test_hub_cache_resolution(tmp_path, monkeypatch):
         resolve_model("acme/absent")
     with pytest.raises(FileNotFoundError, match="org/repo"):
         resolve_model("/no/such/path")
+
+
+def test_gguf_attn_bias_roundtrip(tmp_path):
+    """Qwen2-style GGUF with QKV bias tensors: config detects attn_bias,
+    biases load, and logits match the in-memory reference params."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import model as M
+    from dynamo_tpu.engine.gguf import GGUFFile, load_gguf_model
+
+    cfg = ModelConfig(
+        name="bias-gguf", vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=8, attn_bias=True,
+    )
+    key = jax.random.PRNGKey(5)
+    ref_params = M.init_params(cfg, key, jnp.float32)
+    params_np = jax.tree.map(np.asarray, ref_params)
+    path = str(tmp_path / "bias.gguf")
+    tiny_gguf(path, cfg, params_np)
+
+    gcfg = GGUFFile(path).model_config()
+    assert gcfg.attn_bias
+    lcfg, lparams = load_gguf_model(path, dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(lparams["layers"]["bq"]), params_np["layers"]["bq"], rtol=1e-6
+    )
+
+    toks = np.array([3, 9, 17, 4], np.int32)
+    cache = M.init_kv_cache(lcfg, num_blocks=8, block_size=4, dtype=jnp.float32)
+    table = np.array([1], np.int32)
+    ref_logits, _ = M.prefill(
+        cfg, ref_params, M.init_kv_cache(cfg, 8, 4, jnp.float32),
+        jnp.asarray(toks), jnp.asarray(table), jnp.int32(0), jnp.int32(4),
+    )
+    got_logits, _ = M.prefill(
+        lcfg, lparams, cache,
+        jnp.asarray(toks), jnp.asarray(table), jnp.int32(0), jnp.int32(4),
+    )
+    np.testing.assert_allclose(np.asarray(got_logits), np.asarray(ref_logits),
+                               rtol=1e-5, atol=1e-5)
