@@ -170,6 +170,10 @@ class TpuEngine:
         # Cumulative counters for metrics/bench.
         self.total_generated = 0
         self.total_prefilled = 0
+        # Token-rows actually DISPATCHED for prefill (bucket padding and
+        # padded rows included) — the denominator for padding-efficiency
+        # accounting (bench.py roofline breakdown).
+        self.total_prefill_padded = 0
         self.total_decode_steps = 0  # device substeps incl. padded/zombie work
         # Host-side phase accounting (bench.py --breakdown; VERDICT r4
         # weak #1: where the non-device half of the step time goes).
@@ -638,6 +642,7 @@ class TpuEngine:
             starts[r] = start
             tlens[r] = len(seq.tokens)
         ref = self._runner.prefill_batch(toks, tables, starts, tlens)
+        self.total_prefill_padded += Bp * t_pad
         for seq, start in members:
             self._finish_prefill_bookkeeping(seq, start)
         return ref
@@ -661,6 +666,7 @@ class TpuEngine:
             logits = self._runner.prefill_chunk(
                 toks, table, pos, min(pos + len(chunk), plen)
             )
+            self.total_prefill_padded += t_pad
             pos += len(chunk)
         self._finish_prefill_bookkeeping(seq, start)
         assert logits is not None  # plen >= 1 → at least one chunk ran

@@ -54,9 +54,12 @@ def config_from_hf(model_path: str) -> ModelConfig:
     known = {"LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM"}
     if archs and not (set(archs) & known):
         log.warning("untested architecture %s — loading with llama layout", archs)
-    # Qwen2 hardcodes QKV bias in its modeling code (no config field);
-    # llama-family checkpoints carry an explicit attention_bias flag.
-    attn_bias = bool(hf.get("attention_bias", False)) or "Qwen2ForCausalLM" in archs
+    # Qwen2 hardcodes QKV bias in its modeling code (no config field).
+    # NOTE: llama's attention_bias=true flag is deliberately NOT honored
+    # here — that layout also puts a bias on o_proj, which the model does
+    # not implement; such checkpoints fail loudly in load_params instead
+    # of half-loading.
+    attn_bias = "Qwen2ForCausalLM" in archs
     hidden = int(hf["hidden_size"])
     heads = int(hf["num_attention_heads"])
     head_dim = int(hf.get("head_dim") or hidden // heads)

@@ -35,7 +35,12 @@ Design notes (measured on v5e, see tools/profile_decode.py):
 - Dead steps (chunk beyond the row's length, padding rows) skip DMA and
   compute entirely — padding costs ~grid-iteration overhead only.
 - Per-DMA cost measured ~0.6us: pages should be >=32KB to approach
-  bandwidth, i.e. prefer ``block_size`` 64-256 on TPU (config.py).
+  bandwidth. Page bytes = block_size x KVH x hd x 2 (bf16), so for
+  8B-class geometries (KVH*hd = 1024) the default ``block_size=16``
+  already gives 32KB pages — r5 bench: decode substeps run AT the int8
+  weight-stream roofline (~9 ms vs the 9.8 ms floor) at bs=16, so
+  larger blocks buy nothing there. Prefer 64-256 only for SMALL kv
+  widths (e.g. KVH*hd <= 256) where bs=16 pages drop under 8KB.
 """
 
 from __future__ import annotations

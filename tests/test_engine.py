@@ -599,3 +599,30 @@ def test_engine_embed_chunk_pools_long_input():
             await engine.stop()
 
     asyncio.run(go())
+
+
+def test_engine_packed_prefill_matches_singles():
+    """prefill_batch_max>1 (the multi-row packed path, non-default since
+    async admission made singles the default) must produce the same
+    greedy tokens as the singles path."""
+
+    async def run_wave(batch_max):
+        engine = await TpuEngine(
+            make_args(prefill_batch_max=batch_max, max_num_seqs=8, num_kv_blocks=128)
+        ).start()
+        try:
+            prompts = [[(7 * j + i) % 500 + 1 for j in range(10 + i)] for i in range(5)]
+            outs = await asyncio.gather(
+                *(run_one(engine, greedy_request(p, 6)) for p in prompts)
+            )
+            return [collect_tokens(o) for o in outs]
+        finally:
+            await engine.stop()
+
+    async def go():
+        packed = await run_wave(8)
+        singles = await run_wave(1)
+        assert packed == singles
+        assert all(len(t) == 6 for t in packed)
+
+    asyncio.run(go())
