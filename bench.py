@@ -266,7 +266,7 @@ async def bench(args) -> dict:
         mean_gen = float(np.mean(gen_lens))
         max_rate = decode_tok_s / mean_gen      # saturation arrival rate
         n_sla = args.sla_requests or max(16, n // 2)
-        sla_targets = [float(x) for x in str(args.itl_sla_ms).split(",")]
+        sla_targets = [float(x) for x in str(args.itl_sla_ms).split(",") if x.strip()]
         # Per-substep weight-stream floor: the honest single-chip bound on
         # any ITL target (weights read once per fused substep).
         sla["itl_floor_ms"] = round(weight_bytes / (HBM_GBPS * 1e9) * 1000, 2)

@@ -45,6 +45,8 @@ def test_soak_lease_churn_leaves_no_orphans():
         try:
             base_keys = len(await anchor.store.get_prefix(""))
             cycles = 150 * SCALE
+            crash_times: list[float] = []
+            loop = asyncio.get_running_loop()
             for i in range(cycles):
                 rt = await DistributedRuntime.create(store_url=url)
                 rt.config.store.lease_ttl = ttl
@@ -62,13 +64,19 @@ def test_soak_lease_churn_leaves_no_orphans():
                     await rt.messaging.close()
                     if rt._server is not None:
                         await rt._server.close()
+                    crash_times.append(loop.time())
                 else:
                     await rt.shutdown()
                 if i % 50 == 49:
                     keys = len(await anchor.store.get_prefix(""))
-                    # Crashed leases from the last TTL window may linger;
-                    # this bound only catches unbounded growth.
-                    assert keys <= base_keys + 60, f"key leak at cycle {i}: {keys}"
+                    # A crashed worker's key legitimately lives ~one TTL;
+                    # the bound is the crash count inside that window (the
+                    # churn-rate-scaled expectation), only unbounded
+                    # growth beyond it is a leak.
+                    now = loop.time()
+                    live_crashed = sum(1 for t in crash_times if now - t < ttl + 1.5)
+                    assert keys <= base_keys + live_crashed + 10, \
+                        f"key leak at cycle {i}: {keys} (crashed in window: {live_crashed})"
             await asyncio.sleep(ttl + 1.5)  # let crashed leases expire
             assert len(await anchor.store.get_prefix("")) <= base_keys + 2
         finally:
