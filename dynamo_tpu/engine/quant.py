@@ -73,6 +73,17 @@ def quantize_params_np(params: dict[str, Any]) -> dict[str, Any]:
     return out
 
 
+def _int8_layer_specs(cfg) -> dict[str, tuple[tuple, int]]:
+    """name → (stacked shape, fan_in) for the quantized layer matmuls —
+    the single source both random-init variants build from."""
+    d, i, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    return {
+        "wq": ((L, d, cfg.q_size), d), "wk": ((L, d, cfg.kv_size), d),
+        "wv": ((L, d, cfg.kv_size), d), "wo": ((L, cfg.q_size, d), cfg.q_size),
+        "w_gate": ((L, d, i), d), "w_up": ((L, d, i), d), "w_down": ((L, i, d), i),
+    }
+
+
 def random_int8_params(cfg, seed: int = 0, dtype: str = "bfloat16") -> dict[str, Any]:
     """Random int8 params generated host-side layer by layer — the bench
     path for geometries whose bf16 random init would not fit HBM (8B on
@@ -97,12 +108,7 @@ def random_int8_params(cfg, seed: int = 0, dtype: str = "bfloat16") -> dict[str,
         )
 
     layers: dict[str, np.ndarray] = {}
-    specs = {
-        "wq": ((L, d, cfg.q_size), d), "wk": ((L, d, cfg.kv_size), d),
-        "wv": ((L, d, cfg.kv_size), d), "wo": ((L, cfg.q_size, d), cfg.q_size),
-        "w_gate": ((L, d, i), d), "w_up": ((L, d, i), d), "w_down": ((L, i, d), i),
-    }
-    for name, (shape, fan) in specs.items():
+    for name, (shape, fan) in _int8_layer_specs(cfg).items():
         w, s = q(shape, fan)
         layers[name] = w
         layers[name + "_scale"] = np.broadcast_to(
@@ -126,3 +132,60 @@ def random_int8_params(cfg, seed: int = 0, dtype: str = "bfloat16") -> dict[str,
         params["lm_head"] = w
         params["lm_head_scale"] = s
     return params
+
+
+def random_int8_params_device(cfg, seed: int = 0, dtype: str = "bfloat16") -> dict[str, Any]:
+    """Device-side variant of ``random_int8_params``: every leaf is
+    generated ON the accelerator, so an 8B bench engine start pays zero
+    weight upload (the 8 GB host→device transfer through an axon tunnel
+    measures ~25-30 MB/s ≈ 5 minutes — device threefry generates the
+    same bytes in under a second). Same pytree shapes/dtypes as the host
+    variant; single-device only (sharded multi-host init keeps the host
+    path so every process materializes identical addressable shards)."""
+    if getattr(cfg, "num_experts", 0):
+        raise NotImplementedError("int8 random init not wired for MoE configs")
+    import jax
+    import jax.numpy as jnp
+
+    ndt = jnp.bfloat16 if dtype == "bfloat16" else jnp.dtype(dtype)
+    d, i, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    attn_bias = getattr(cfg, "attn_bias", False)
+
+    @jax.jit
+    def build():
+        key = jax.random.PRNGKey(seed)
+
+        def q(idx, shape, fan_in):
+            w = jax.random.randint(
+                jax.random.fold_in(key, idx), shape, -127, 128, jnp.int8
+            )
+            s = jnp.full((L, shape[-1]), (fan_in ** -0.5) / 64.0, jnp.float32)
+            return w, s
+
+        layers: dict[str, Any] = {}
+        for idx, (name, (shape, fan)) in enumerate(_int8_layer_specs(cfg).items()):
+            w, s = q(idx, shape, fan)
+            layers[name] = w
+            layers[name + "_scale"] = s
+        layers["attn_norm"] = jnp.ones((L, d), ndt)
+        layers["mlp_norm"] = jnp.ones((L, d), ndt)
+        if attn_bias:
+            bkey = jax.random.fold_in(key, 31)
+            layers["bq"] = (jax.random.normal(bkey, (L, cfg.q_size)) * 0.02).astype(ndt)
+            layers["bk"] = (jax.random.normal(jax.random.fold_in(bkey, 1), (L, cfg.kv_size)) * 0.02).astype(ndt)
+            layers["bv"] = (jax.random.normal(jax.random.fold_in(bkey, 2), (L, cfg.kv_size)) * 0.02).astype(ndt)
+        params: dict[str, Any] = {
+            "embed": jax.random.randint(
+                jax.random.fold_in(key, 90), (cfg.vocab_size, d), -127, 128, jnp.int8
+            ),
+            "embed_scale": jnp.full((cfg.vocab_size,), (d ** -0.5) / 64.0, jnp.float32),
+            "layers": layers,
+            "final_norm": jnp.ones((d,), ndt),
+        }
+        if not cfg.tie_embeddings:
+            w, s = q(91, (d, cfg.vocab_size), d)
+            params["lm_head"] = w
+            params["lm_head_scale"] = s[0]
+        return params
+
+    return build()

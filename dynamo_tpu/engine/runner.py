@@ -104,11 +104,21 @@ class LocalRunner:
 
     def start(self) -> None:
         if self.args.quant == "int8" and self.params is None:
-            from dynamo_tpu.engine.quant import random_int8_params
+            if self.sharding is None and self.args.tp == 1:
+                from dynamo_tpu.engine.quant import random_int8_params_device
 
-            # Host-side layerwise generation: int8 from birth, so 8B-class
-            # geometries never materialize a bf16 copy.
-            self.params = random_int8_params(self.cfg, self._seed, self.args.dtype)
+                # Generated ON device: int8 from birth AND zero weight
+                # upload (an 8 GB host→device push through the axon
+                # tunnel costs ~5 minutes at the measured ~25 MB/s).
+                self.params = random_int8_params_device(
+                    self.cfg, self._seed, self.args.dtype
+                )
+            else:
+                from dynamo_tpu.engine.quant import random_int8_params
+
+                # Multi-device init stays host-side so each process
+                # materializes identical addressable shards.
+                self.params = random_int8_params(self.cfg, self._seed, self.args.dtype)
         elif self.args.quant == "int8" and not any(
             leaf.dtype == jnp.int8 for leaf in jax.tree.leaves(self.params)
         ):

@@ -110,7 +110,11 @@ def main():
     if args.cpu:
         params = M.init_params(cfg, jax.random.PRNGKey(0), dtype)
     else:
-        params = jax.tree.map(jnp.asarray, random_int8_params(cfg, 0))
+        # Device-side generation: zero weight upload (8 GB over the
+        # tunnel ≈ 5 min at ~25 MB/s; see quant.random_int8_params_device).
+        from dynamo_tpu.engine.quant import random_int8_params_device
+
+        params = random_int8_params_device(cfg, 0)
     weight_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
     print(f"param bytes={weight_bytes/1e9:.2f} GB  "
           f"weight roofline: {weight_bytes/819e9*1e3:.2f} ms/step")
