@@ -95,7 +95,6 @@ def main():
 
     from dynamo_tpu.engine import model as M
     from dynamo_tpu.engine.config import ModelConfig
-    from dynamo_tpu.engine.quant import random_int8_params
 
     cfg = ModelConfig.preset(args.model) if not args.cpu else ModelConfig.preset("test-tiny")
     bs = args.block_size
