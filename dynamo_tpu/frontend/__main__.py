@@ -10,10 +10,12 @@ from __future__ import annotations
 import argparse
 import asyncio
 import contextlib
+import os
 import signal
 
 from dynamo_tpu.kv_router.router import KvRouterConfig
 from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.runtime.admission import AdmissionController
 from dynamo_tpu.llm.http_service import HttpService
 from dynamo_tpu.llm.pipeline import RouterSettings
 from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -43,6 +45,16 @@ def parse_args(argv=None):
     p.add_argument("--record-dir", default=None,
                    help="record response streams + routing events to JSONL here "
                         "(replayable offline; llm/recorder.py)")
+    # Admission control / robustness (overrides for the [admission]/[runtime]
+    # config sections; see docs/robustness.md).
+    p.add_argument("--max-inflight", type=int, default=None,
+                   help="max concurrent inference requests before shedding "
+                        "429s (default: DYNTPU_ADMISSION_MAX_INFLIGHT; 0 = unlimited)")
+    p.add_argument("--max-queue-depth", type=int, default=None,
+                   help="extra requests allowed to wait for a slot before shedding")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   help="default end-to-end deadline (s) when the client "
+                        "sends no X-Request-Timeout (0 = none)")
     return p.parse_args(argv)
 
 
@@ -58,17 +70,47 @@ async def async_main(args) -> None:
         )
     manager = ModelManager(rt, settings)
     watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
+    acfg = rt.config.admission
+    admission = AdmissionController(
+        max_inflight=acfg.max_inflight if args.max_inflight is None else args.max_inflight,
+        max_queue_depth=acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth,
+        retry_after=acfg.retry_after,
+        queue_timeout=acfg.queue_timeout,
+    )
+    default_timeout = (
+        rt.config.runtime.default_request_timeout
+        if args.request_timeout is None
+        else args.request_timeout
+    )
     http = await HttpService(
-        manager, rt.metrics, health=rt.health, host=args.host, port=args.port
+        manager, rt.metrics, health=rt.health, host=args.host, port=args.port,
+        admission=admission, default_timeout=default_timeout,
     ).start()
     print(f"dynamo_tpu frontend: http://{args.host}:{http.port}", flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
+
+    def on_signal() -> None:
+        if stop.is_set():
+            # Second signal: the operator wants out NOW — skip the drain.
+            log.warning("second signal during drain: forcing exit")
+            os._exit(130)
+        stop.set()
+
     for sig in (signal.SIGINT, signal.SIGTERM):
         with contextlib.suppress(NotImplementedError):
-            loop.add_signal_handler(sig, stop.set)
+            loop.add_signal_handler(sig, on_signal)
     await stop.wait()
+    # Graceful drain: stop admitting (503 + Retry-After), let in-flight
+    # streams run to completion, then tear the planes down.
+    log.info("frontend draining (%d in flight)", admission.inflight)
+    http.start_draining()
+    drained = await http.wait_drained(rt.config.runtime.graceful_shutdown_timeout)
+    if not drained:
+        log.warning(
+            "drain timeout: %d streams still in flight at shutdown", admission.inflight
+        )
     log.info("frontend shutting down")
     await http.close()
     await watcher.close()

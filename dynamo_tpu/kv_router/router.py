@@ -28,7 +28,11 @@ from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig
 from dynamo_tpu.kv_router.sequence import ActiveSequences
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
-from dynamo_tpu.runtime.messaging import NoHandlerError, TruncatedStreamError
+from dynamo_tpu.runtime.messaging import (
+    NoHandlerError,
+    OverloadedError,
+    TruncatedStreamError,
+)
 from dynamo_tpu.runtime.push_router import NoInstancesError, PushRouter
 from dynamo_tpu.tokens import compute_block_hashes
 
@@ -259,6 +263,7 @@ class KvPushRouter:
                 NoInstancesError,  # worker vanished between placement and dispatch
                 TruncatedStreamError,
                 NoHandlerError,
+                OverloadedError,  # admission-gate refusal: place on next-best
                 ConnectionError,
                 OSError,
             ) as e:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import math
 import time
 
 from aiohttp import web
@@ -34,8 +35,10 @@ from dynamo_tpu.llm.protocols import (
     sse_event,
     sse_typed_event,
 )
-from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.admission import AdmissionController, AdmissionRejected
+from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
 from dynamo_tpu.runtime.logging import current_trace, get_logger
+from dynamo_tpu.runtime.messaging import OverloadedError
 from dynamo_tpu.runtime.metrics import InflightGuard, MetricsRegistry
 from dynamo_tpu.runtime.push_router import NoInstancesError
 
@@ -50,15 +53,23 @@ class HttpService:
         health=None,
         host: str = "0.0.0.0",
         port: int = 8080,
+        admission: AdmissionController | None = None,
+        default_timeout: float = 0.0,
     ):
         self.manager = manager
         self.health = health
         self.host = host
         self.port = port
+        # Admission gate for the inference surface; an unbounded controller
+        # still tracks in-flight count so graceful drain works.
+        self.admission = admission or AdmissionController()
+        # Applied when the client sends no X-Request-Timeout (0 = none).
+        self.default_timeout = default_timeout
         self._runner: web.AppRunner | None = None
         scope = metrics.child("http")
         self.m_requests = scope.counter("http_requests_total", "HTTP requests")
         self.m_inflight = scope.gauge("http_inflight", "In-flight requests")
+        self.m_shed = scope.counter("http_requests_shed_total", "Requests shed at the admission gate")
         self.m_duration = scope.histogram("http_request_duration_seconds", "Request duration")
         self.m_ttft = scope.histogram("http_time_to_first_token_seconds", "Time to first token")
         # Per-request mean inter-token latency — the planner's ITL input
@@ -94,6 +105,16 @@ class HttpService:
     async def close(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+
+    def start_draining(self) -> None:
+        """SIGTERM path step 1: refuse new inference requests (503 +
+        Retry-After) while in-flight streams keep running."""
+        self.admission.start_draining()
+
+    async def wait_drained(self, timeout: float | None = None) -> bool:
+        """SIGTERM path step 2: wait for in-flight streams to finish.
+        → True if fully drained within ``timeout``."""
+        return await self.admission.wait_idle(timeout)
 
     # -- system surface ----------------------------------------------------
 
@@ -206,10 +227,43 @@ class HttpService:
     }
     _ENDPOINT_LABEL = {"chat": "chat", "completion": "completions", "responses": "responses"}
 
+    def _parse_timeout(self, request: web.Request, body: dict) -> float | None:
+        """End-to-end deadline: ``X-Request-Timeout`` header (seconds) or
+        ``request_timeout`` body field, else the service default."""
+        raw = request.headers.get("X-Request-Timeout")
+        if raw is None:
+            raw = body.get("request_timeout") if isinstance(body, dict) else None
+        if raw is None:
+            return self.default_timeout if self.default_timeout > 0 else None
+        try:
+            timeout = float(raw)
+        except (TypeError, ValueError):
+            raise OpenAIError(f"invalid request timeout {raw!r}") from None
+        # NaN passes a naive <= 0 check and would poison asyncio timers.
+        if not math.isfinite(timeout) or timeout <= 0:
+            raise OpenAIError("request timeout must be a positive finite number")
+        return timeout
+
+    def _retry_after(self, seconds: float | None = None) -> dict[str, str]:
+        secs = seconds if seconds is not None else self.admission.retry_after
+        return {"Retry-After": str(max(1, math.ceil(secs)))}
+
     async def _handle_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
         endpoint = self._ENDPOINT_LABEL[kind]
         model = "unknown"
         t0 = time.perf_counter()
+        try:
+            await self.admission.acquire()
+        except AdmissionRejected as e:
+            # Shed, don't queue: 503 while draining (instance going away),
+            # 429 under overload — both tell the client when to come back.
+            status = 503 if e.draining else 429
+            self.m_shed.inc(endpoint=endpoint, status=str(status))
+            self.m_requests.inc(model=model, endpoint=endpoint, status=str(status))
+            err = OpenAIError(str(e), status=status, err_type="overloaded_error")
+            return web.json_response(
+                err.body(), status=status, headers=self._retry_after(e.retry_after)
+            )
         try:
             try:
                 body = await request.json()
@@ -221,7 +275,7 @@ class HttpService:
             if pipe is None:
                 raise OpenAIError(f"model {req.model!r} not found", status=404, err_type="not_found_error")
 
-            ctx = Context(trace=current_trace())
+            ctx = Context.with_timeout(self._parse_timeout(request, body), trace=current_trace())
             with InflightGuard(self.m_inflight, model=model):
                 try:
                     if kind == "responses":
@@ -237,10 +291,19 @@ class HttpService:
         except OpenAIError as e:
             self.m_requests.inc(model=model, endpoint=endpoint, status=str(e.status))
             return web.json_response(e.body(), status=e.status)
+        except DeadlineExceededError:
+            self.m_requests.inc(model=model, endpoint=endpoint, status="504")
+            err = OpenAIError("request exceeded its deadline", status=504, err_type="timeout_error")
+            return web.json_response(err.body(), status=504)
+        except OverloadedError:
+            # Every routing attempt was refused at a worker admission gate.
+            self.m_requests.inc(model=model, endpoint=endpoint, status="503")
+            err = OpenAIError("all workers at capacity", status=503, err_type="overloaded_error")
+            return web.json_response(err.body(), status=503, headers=self._retry_after())
         except NoInstancesError:
             self.m_requests.inc(model=model, endpoint=endpoint, status="503")
             err = OpenAIError("no workers available for this model", status=503, err_type="overloaded_error")
-            return web.json_response(err.body(), status=503)
+            return web.json_response(err.body(), status=503, headers=self._retry_after())
         except asyncio.CancelledError:
             raise
         except Exception:  # noqa: BLE001 — HTTP boundary
@@ -248,6 +311,8 @@ class HttpService:
             self.m_requests.inc(model=model, endpoint=endpoint, status="500")
             err = OpenAIError("internal error", status=500, err_type="internal_error")
             return web.json_response(err.body(), status=500)
+        finally:
+            self.admission.release()
 
     async def _stream(
         self, request: web.Request, pipe, req, ctx: Context, model: str, endpoint: str, t0: float
@@ -300,11 +365,9 @@ class HttpService:
             raise
         except Exception as e:  # noqa: BLE001 — mid-stream: SSE error, not a 2nd response
             failed = True
-            if not isinstance(e, OpenAIError):
+            if not isinstance(e, (OpenAIError, DeadlineExceededError)):
                 log.exception("stream failed mid-flight (%s)", ctx.id)
-            err = e if isinstance(e, OpenAIError) else OpenAIError(
-                "stream failed", status=500, err_type="internal_error"
-            )
+            err = self._stream_error(e)
             self.m_requests.inc(model=model, endpoint=endpoint, status=str(err.status))
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 await resp.write(sse_event(json.dumps(err.body())))
@@ -323,6 +386,19 @@ class HttpService:
                 await resp.write(SSE_DONE)
                 await resp.write_eof()
         return resp
+
+    @staticmethod
+    def _stream_error(e: Exception) -> OpenAIError:
+        """Typed mid-stream failure → the SSE error event's shape. Once the
+        200 is on the wire the status only lands in metrics, but the typed
+        body still tells the client *why* the stream ended."""
+        if isinstance(e, OpenAIError):
+            return e
+        if isinstance(e, DeadlineExceededError):
+            return OpenAIError("request exceeded its deadline", status=504, err_type="timeout_error")
+        if isinstance(e, OverloadedError):
+            return OpenAIError("all workers at capacity", status=503, err_type="overloaded_error")
+        return OpenAIError("stream failed", status=500, err_type="internal_error")
 
     # -- /v1/responses (OpenAI Responses API) ------------------------------
     #
@@ -445,10 +521,9 @@ class HttpService:
             raise
         except Exception as e:  # noqa: BLE001 — mid-stream failure → error event
             failed = True
-            if not isinstance(e, OpenAIError):
+            if not isinstance(e, (OpenAIError, DeadlineExceededError)):
                 log.exception("responses stream failed mid-flight (%s)", ctx.id)
-            err = e if isinstance(e, OpenAIError) else OpenAIError(
-                "stream failed", status=500, err_type="internal_error")
+            err = self._stream_error(e)
             self.m_requests.inc(model=model, endpoint="responses", status=str(err.status))
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 # Responses typed-event error shape (emit injects

@@ -39,16 +39,30 @@ class Migration(Operator):
         request = dict(request)
         migrations = 0
         emitted: list[int] = []
+        finished = False
         while True:
             try:
                 async for raw in self.inner.generate(request, context.child()):
                     if isinstance(raw, dict) and raw.get("token_ids"):
                         emitted.extend(raw["token_ids"])
+                    if isinstance(raw, dict) and raw.get("finish_reason"):
+                        finished = True
                     yield raw
                 return
             except TruncatedStreamError:
+                if finished:
+                    # The worker died between the last payload (which carried
+                    # a finish_reason) and the final bookkeeping frame: the
+                    # generation is semantically complete. Re-dispatching
+                    # would append tokens past the client's budget.
+                    return
                 if migrations >= self.migration_limit or context.cancelled:
                     raise
+                # A request that can't finish shouldn't migrate: re-dispatch
+                # means re-prefilling prompt+carried tokens on a new worker,
+                # pure waste if the deadline already passed (and the typed
+                # deadline error beats a truncation error for the client).
+                context.check_deadline()
                 migrations += 1
                 log.warning(
                     "stream died mid-flight for %s; migrating (%d/%d, %d tokens carried)",

@@ -22,6 +22,7 @@ from typing import Any, AsyncIterator
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.chaos import ChaosInjector
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
 
@@ -43,6 +44,8 @@ class MockerArgs:
     # bursts (engine decode_steps), not single tokens — mirror that shape
     # so frontend-path costs are modeled per delta, not per token.
     delta_tokens: int = 1
+    # Seeded fault injection (runtime/chaos.py): per-step worker-kill draws.
+    chaos: ChaosInjector | None = None
 
     def scaled(self, ms: float) -> float:
         return ms / (1000.0 * self.speedup)
@@ -147,6 +150,12 @@ class MockerEngine:
                         token_ids=burst, finish_reason=FinishReason.CANCELLED
                     ).to_dict()
                     return
+                # Out of budget mid-generation: raise the typed error (the
+                # messaging layer sends it as a "deadline" err frame) — the
+                # worker stops burning slots on a request nobody can use.
+                context.check_deadline()
+                if a.chaos is not None:
+                    a.chaos.maybe_kill()
                 token = prompt[emitted % plen]  # deterministic echo
                 if block_seq.total_tokens + 1 > len(block_ids) * bs:
                     try:

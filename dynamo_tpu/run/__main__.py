@@ -194,6 +194,12 @@ async def run_http(args, pipe: LocalPipeline) -> None:
         with contextlib.suppress(NotImplementedError):
             loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    # Same SIGTERM contract as the distributed frontend: shed new work,
+    # finish in-flight streams, then close.
+    from dynamo_tpu.runtime.config import global_config
+
+    http.start_draining()
+    await http.wait_drained(global_config().runtime.graceful_shutdown_timeout)
     await http.close()
 
 

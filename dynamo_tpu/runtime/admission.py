@@ -1,0 +1,166 @@
+"""Admission control: bounded in-flight gate with load shedding and drain.
+
+Under overload the reference stack's HTTP ingress keeps accepting work and
+queues it into the routers, so latency grows without bound; a production
+frontend must shed instead (429/503 + ``Retry-After``) and must stop
+admitting — while finishing in-flight streams — on SIGTERM.
+
+One :class:`AdmissionController` fronts the HTTP service; the worker-side
+analogue is the per-subject ``max_inflight`` gate in
+:class:`~dynamo_tpu.runtime.messaging.EndpointServer`, which refuses with a
+typed ``overloaded`` error the router retries on another instance.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from collections import deque
+
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("admission")
+
+
+class AdmissionRejected(Exception):
+    """Request shed at the admission gate."""
+
+    def __init__(self, message: str, retry_after: float, draining: bool = False):
+        super().__init__(message)
+        self.retry_after = retry_after
+        # Draining maps to 503 (instance going away); overload maps to 429
+        # (client should slow down and retry the same fleet).
+        self.draining = draining
+
+
+class AdmissionController:
+    """Counting gate: at most ``max_inflight`` admitted, at most
+    ``max_queue_depth`` more waiting for a slot; everything beyond that is
+    rejected immediately. ``max_inflight=0`` disables the bound but still
+    tracks in-flight count so draining works.
+
+    Freed slots are handed to queued waiters in strict FIFO order by
+    ``release()`` itself (the waiter's future is resolved with the slot
+    already assigned) — new arrivals can neither barge past the queue via
+    the fast path nor race a wakeup, so no waiter can be starved."""
+
+    def __init__(
+        self,
+        max_inflight: int = 0,
+        max_queue_depth: int = 0,
+        retry_after: float = 1.0,
+        queue_timeout: float = 5.0,
+    ):
+        self.max_inflight = max_inflight
+        self.max_queue_depth = max_queue_depth
+        self.retry_after = retry_after
+        # Bound on how long a queued request waits for a slot before being
+        # shed anyway — a queued wait must never become a hang.
+        self.queue_timeout = queue_timeout
+        self._inflight = 0
+        self._draining = False
+        self._idle = asyncio.Event()
+        self._idle.set()
+        self._waiters: deque[asyncio.Future] = deque()
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    @property
+    def queued(self) -> int:
+        return sum(1 for f in self._waiters if not f.done())
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    async def acquire(self) -> None:
+        """Admit one request or raise :class:`AdmissionRejected`.
+
+        Over-limit requests wait for a slot only while queue headroom
+        exists; the queue bound is what keeps shedding O(1) — a shed
+        response costs nothing, a queued one holds memory and latency.
+        """
+        if self._draining:
+            raise AdmissionRejected(
+                "service is draining", self.retry_after, draining=True
+            )
+        if self.max_inflight <= 0 or (
+            self._inflight < self.max_inflight and not self._waiters
+        ):
+            self._admit()
+            return
+        if self.queued >= self.max_queue_depth:
+            raise AdmissionRejected(
+                f"at capacity ({self._inflight} in flight, {self.queued} queued)",
+                self.retry_after,
+            )
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            # Resolution ⇒ the slot was already assigned by release()/
+            # _hand_off (or a draining rejection was set) — nothing to do.
+            await asyncio.wait_for(fut, self.queue_timeout)
+        except asyncio.TimeoutError:
+            # Queued past the bound: shed — a wait must never become a hang.
+            # (wait_for only times out if the future is still unresolved, so
+            # no slot was assigned.)
+            with contextlib.suppress(ValueError):
+                self._waiters.remove(fut)
+            raise AdmissionRejected(
+                f"queued {self.queue_timeout:.0f}s without a slot", self.retry_after
+            ) from None
+        except asyncio.CancelledError:
+            # The waiter's own task was cancelled (client disconnected while
+            # queued). If _hand_off already assigned us the slot, give it
+            # back — otherwise inflight leaks one unit per occurrence and
+            # capacity shrinks until everything is shed (semaphore-style
+            # cancellation safety).
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                self.release()
+            else:
+                with contextlib.suppress(ValueError):
+                    self._waiters.remove(fut)
+            raise
+
+    def _admit(self) -> None:
+        self._inflight += 1
+        self._idle.clear()
+
+    def release(self) -> None:
+        self._inflight -= 1
+        self._hand_off()
+        if self._inflight == 0:
+            self._idle.set()
+
+    def _hand_off(self) -> None:
+        """Assign freed capacity to queued waiters, oldest first."""
+        while self._waiters and self._inflight < self.max_inflight:
+            fut = self._waiters.popleft()
+            if fut.done():  # timed out / cancelled while queued
+                continue
+            self._admit()  # on the waiter's behalf, before it even wakes
+            fut.set_result(None)
+
+    def start_draining(self) -> None:
+        """Refuse all new admissions from now on (SIGTERM path); queued
+        waiters are rejected immediately."""
+        self._draining = True
+        while self._waiters:
+            fut = self._waiters.popleft()
+            if not fut.done():
+                fut.set_exception(
+                    AdmissionRejected("service is draining", self.retry_after, draining=True)
+                )
+
+    async def wait_idle(self, timeout: float | None = None) -> bool:
+        """Wait for in-flight requests to finish. → True if fully drained."""
+        if timeout is None:
+            await self._idle.wait()
+            return True
+        try:
+            await asyncio.wait_for(self._idle.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False

@@ -1,0 +1,106 @@
+"""Deterministic fault injection for the serving stack.
+
+The reference validates fault tolerance with process-kill integration tests
+(tests/fault_tolerance/test_request_migration.py); this module adds the
+complementary in-process harness: a seeded :class:`ChaosInjector` that the
+messaging layer and the mock engine consult at well-defined fault points —
+
+- **frame drop / stream truncation** — the server cuts the connection at a
+  frame boundary instead of delivering the frame. The client's pump sees
+  EOF before the ``final`` frame and raises ``TruncatedStreamError``, which
+  is exactly the signal a crashed worker produces. Faults are *detectable
+  by construction*: chaos never silently corrupts payloads, it only kills
+  transports, so any undetected data loss is a real protocol bug.
+- **worker kill** — the engine raises :class:`ChaosKillError` mid-
+  generation; the endpoint server translates it into a transport cut
+  (no error frame), indistinguishable on the wire from process death.
+- **latency injection** — bounded uniform delay before response frames,
+  for exercising deadline enforcement.
+
+Every draw comes from one ``random.Random(seed)``, so a failing chaos run
+replays bit-identically from its seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+from dataclasses import dataclass
+
+from dynamo_tpu.runtime.config import ChaosConfig
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("chaos")
+
+
+class ChaosKillError(Exception):
+    """Injected worker death. Must never escape to a client as an error
+    frame — the messaging layer converts it into a dropped connection so
+    recovery paths see a real truncation signal."""
+
+
+@dataclass
+class ChaosStats:
+    """Counters of injected faults (for test assertions/reporting)."""
+
+    frames_dropped: int = 0
+    streams_truncated: int = 0
+    kills: int = 0
+    latency_injections: int = 0
+
+    def total(self) -> int:
+        return self.frames_dropped + self.streams_truncated + self.kills
+
+
+class ChaosInjector:
+    """Seeded fault source consulted at the messaging/engine fault points.
+
+    Thread-unsafe by design: all consumers run on one event loop. The RNG
+    stream is shared across fault kinds so a single seed pins the whole
+    scenario.
+    """
+
+    def __init__(self, config: ChaosConfig | None = None, **overrides):
+        cfg = config or ChaosConfig(enabled=True)
+        if overrides:
+            # Never mutate the caller's (possibly shared) config object.
+            cfg = dataclasses.replace(cfg, **overrides)
+        self.config = cfg
+        self.rng = random.Random(cfg.seed)
+        self.stats = ChaosStats()
+
+    @classmethod
+    def from_config(cls, cfg: ChaosConfig) -> "ChaosInjector | None":
+        return cls(cfg) if cfg.enabled else None
+
+    # -- fault points -------------------------------------------------------
+
+    def should_drop_frame(self) -> bool:
+        """Consulted per response data frame: True ⇒ cut the connection
+        instead of sending this frame."""
+        if self.config.frame_drop_p > 0 and self.rng.random() < self.config.frame_drop_p:
+            self.stats.frames_dropped += 1
+            return True
+        return False
+
+    def should_truncate(self) -> bool:
+        """Consulted once per stream right before its final frame: True ⇒
+        cut the connection instead of completing the stream."""
+        if self.config.truncate_p > 0 and self.rng.random() < self.config.truncate_p:
+            self.stats.streams_truncated += 1
+            return True
+        return False
+
+    def maybe_kill(self) -> None:
+        """Consulted per generation step by the engine: raises
+        :class:`ChaosKillError` to simulate the worker dying mid-request."""
+        if self.config.kill_p > 0 and self.rng.random() < self.config.kill_p:
+            self.stats.kills += 1
+            raise ChaosKillError("injected worker death")
+
+    async def inject_latency(self) -> None:
+        """Sleep a seeded uniform delay in [0, latency_ms]."""
+        if self.config.latency_ms > 0:
+            self.stats.latency_injections += 1
+            await asyncio.sleep(self.rng.uniform(0, self.config.latency_ms) / 1000.0)

@@ -4,11 +4,21 @@ Reference analogue: ``Client::new_dynamic`` with an etcd prefix watcher
 feeding a ``tokio::sync::watch`` of instances, availability filtering, and
 ``report_instance_down`` (reference: lib/runtime/src/component/client.rs:
 66-84,134-143,204-258).
+
+Fault marking here is a per-instance *circuit breaker* rather than a
+permanent local blacklist: ``report_instance_down`` opens the circuit
+(instance excluded from routing), after ``circuit_cooldown`` seconds one
+probe request is let through (half-open), and ``report_instance_up``
+closes it again. Without the breaker a marked-down instance that never
+re-registers (e.g. transient network partition, lease kept alive) would
+be starved forever; with it, recovery is bounded by the cooldown.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
+from dataclasses import dataclass
 
 from dynamo_tpu.runtime.component import Instance, instance_prefix
 from dynamo_tpu.runtime.logging import get_logger
@@ -17,15 +27,32 @@ from dynamo_tpu.runtime.store import EventKind, KeyValueStore
 log = get_logger("client")
 
 
+@dataclass
+class _Breaker:
+    """Per-instance circuit state. ``state`` is "open" (excluded) or
+    "half-open" (one probe window granted); closed == no breaker entry."""
+
+    state: str
+    since: float  # monotonic instant of the last state transition
+
+
 class DiscoveryClient:
-    def __init__(self, store: KeyValueStore, namespace: str, component: str, endpoint: str):
+    def __init__(
+        self,
+        store: KeyValueStore,
+        namespace: str,
+        component: str,
+        endpoint: str,
+        circuit_cooldown: float = 5.0,
+    ):
         self.namespace = namespace
         self.component = component
         self.endpoint = endpoint
+        self.circuit_cooldown = circuit_cooldown
         self._store = store
         self._prefix = instance_prefix(namespace, component, endpoint)
         self._instances: dict[str, Instance] = {}
-        self._down: set[int] = set()
+        self._breakers: dict[int, _Breaker] = {}
         self._changed = asyncio.Event()
         self._version = 0
         self._watch = None
@@ -50,11 +77,11 @@ class DiscoveryClient:
                     inst = Instance.from_bytes(ev.value)
                     self._instances[ev.key] = inst
                     # A re-registered instance id is alive again.
-                    self._down.discard(inst.instance_id)
+                    self._breakers.pop(inst.instance_id, None)
                 else:
                     inst = self._instances.pop(ev.key, None)
                     if inst is not None:
-                        self._down.discard(inst.instance_id)
+                        self._breakers.pop(inst.instance_id, None)
                 self._notify_changed()
         except asyncio.CancelledError:
             pass
@@ -64,8 +91,32 @@ class DiscoveryClient:
         return list(self._instances.values())
 
     def available(self) -> list[Instance]:
-        """Instances not locally marked down — the routing set."""
-        return [i for i in self._instances.values() if i.instance_id not in self._down]
+        """Instances routable right now: circuit closed, or open past the
+        cooldown (transitions to half-open and admits probe traffic)."""
+        now = time.monotonic()
+        return [
+            i for i in self._instances.values() if self._circuit_allows(i.instance_id, now)
+        ]
+
+    def _circuit_allows(self, instance_id: int, now: float) -> bool:
+        b = self._breakers.get(instance_id)
+        if b is None:
+            return True
+        if now - b.since >= self.circuit_cooldown:
+            # open → half-open: grant one probe *window* per cooldown. The
+            # probe's outcome resolves the state: report_instance_up closes
+            # the circuit, report_instance_down re-opens it (timer reset).
+            if b.state != "half-open":
+                log.info("instance %x half-open: allowing probe", instance_id)
+            b.state = "half-open"
+            b.since = now
+            return True
+        return b.state == "half-open"
+
+    def breaker_state(self, instance_id: int) -> str:
+        """"closed" | "open" | "half-open" (observability/tests)."""
+        b = self._breakers.get(instance_id)
+        return "closed" if b is None else b.state
 
     def instance_ids(self) -> list[int]:
         return [i.instance_id for i in self.available()]
@@ -78,10 +129,18 @@ class DiscoveryClient:
 
     def report_instance_down(self, instance_id: int) -> None:
         """Fast-path fault marking before the lease expires
-        (reference: client.rs:134-143). Cleared when the watch shows the
-        instance re-register or vanish."""
-        self._down.add(instance_id)
+        (reference: client.rs:134-143): opens the circuit. Cleared when the
+        watch shows the instance re-register or vanish, when a half-open
+        probe succeeds, or — failing all that — probed again every
+        ``circuit_cooldown`` seconds."""
+        self._breakers[instance_id] = _Breaker("open", time.monotonic())
         self._notify_changed()
+
+    def report_instance_up(self, instance_id: int) -> None:
+        """A request to this instance succeeded — close its circuit."""
+        if self._breakers.pop(instance_id, None) is not None:
+            log.info("instance %x back up: circuit closed", instance_id)
+            self._notify_changed()
 
     def _notify_changed(self) -> None:
         self._version += 1

@@ -12,7 +12,14 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11 — TOML layer degrades to a no-op
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ModuleNotFoundError:
+        tomllib = None  # type: ignore[assignment]
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -37,8 +44,19 @@ class RuntimeConfig:
     num_worker_threads: int = 0
     # Grace period (s) for in-flight requests during shutdown.
     graceful_shutdown_timeout: float = 30.0
-    # Maximum concurrent in-flight requests an endpoint accepts.
+    # Maximum concurrent in-flight requests an endpoint accepts; excess
+    # requests are refused with a typed "overloaded" error the router
+    # retries elsewhere (worker-side admission gate).
     max_inflight: int = 4096
+    # Default end-to-end request deadline seconds (0 = unbounded); the
+    # ingress applies it when the client sends no X-Request-Timeout.
+    default_request_timeout: float = 0.0
+    # Router retry hygiene: jittered exponential backoff between attempts.
+    retry_backoff_base: float = 0.05
+    retry_backoff_max: float = 2.0
+    # Per-instance circuit breaker: seconds an instance marked down stays
+    # excluded before a half-open probe is allowed.
+    circuit_cooldown: float = 5.0
 
     @classmethod
     def section(cls) -> str:
@@ -79,10 +97,58 @@ class SystemConfig:
 
 
 @dataclass
+class AdmissionConfig:
+    """Frontend admission control (section ``[admission]``, env
+    ``DYNTPU_ADMISSION_*``): bound what the ingress accepts instead of
+    queueing unboundedly under overload."""
+
+    # Maximum concurrent inference requests admitted (0 = unlimited).
+    max_inflight: int = 0
+    # Additional requests allowed to queue for a slot before shedding
+    # (only meaningful with max_inflight > 0).
+    max_queue_depth: int = 0
+    # Retry-After seconds advertised on 429/503 shed responses.
+    retry_after: float = 1.0
+    # Max seconds a queued request waits for a slot before it is shed
+    # anyway (a queued wait must never become a hang).
+    queue_timeout: float = 5.0
+
+    @classmethod
+    def section(cls) -> str:
+        return "admission"
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic fault injection (section ``[chaos]``, env
+    ``DYNTPU_CHAOS_*``). Off by default; when enabled, the messaging layer
+    and mock engine draw faults from a seeded RNG so failure scenarios are
+    reproducible (see runtime/chaos.py)."""
+
+    enabled: bool = False
+    seed: int = 0
+    # Probability a response data frame is "dropped": the connection is cut
+    # at a frame boundary (detectable truncation, never silent corruption).
+    frame_drop_p: float = 0.0
+    # Probability a stream is truncated right before its final frame.
+    truncate_p: float = 0.0
+    # Probability the (mock) engine dies mid-generation.
+    kill_p: float = 0.0
+    # Injected per-frame latency: uniform in [0, latency_ms].
+    latency_ms: float = 0.0
+
+    @classmethod
+    def section(cls) -> str:
+        return "chaos"
+
+
+@dataclass
 class Config:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     store: StoreConfig = field(default_factory=StoreConfig)
     system: SystemConfig = field(default_factory=SystemConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     @classmethod
     def from_env(cls, env: dict[str, str] | None = None) -> "Config":
@@ -91,11 +157,16 @@ class Config:
         layers: dict[str, dict[str, Any]] = {}
         toml_path = env.get(f"{_ENV_PREFIX}_CONFIG")
         if toml_path and os.path.exists(toml_path):
+            if tomllib is None:
+                raise RuntimeError(
+                    f"{_ENV_PREFIX}_CONFIG={toml_path!r} set but no TOML parser "
+                    "available (Python < 3.11 without tomli)"
+                )
             with open(toml_path, "rb") as f:
                 layers = tomllib.load(f)
 
         cfg = cls()
-        for section_obj in (cfg.runtime, cfg.store, cfg.system):
+        for section_obj in (cfg.runtime, cfg.store, cfg.system, cfg.admission, cfg.chaos):
             section = section_obj.section()
             toml_section = layers.get(section, {})
             for f_ in dataclasses.fields(section_obj):

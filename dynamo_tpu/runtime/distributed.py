@@ -118,7 +118,14 @@ class Endpoint:
     async def router(self, mode: RouterMode = RouterMode.ROUND_ROBIN) -> PushRouter:
         rt = self.component.namespace.runtime
         discovery = await self.client()
-        return PushRouter(discovery, rt.messaging, mode)
+        rcfg = rt.config.runtime
+        return PushRouter(
+            discovery,
+            rt.messaging,
+            mode,
+            backoff_base=rcfg.retry_backoff_base,
+            backoff_max=rcfg.retry_backoff_max,
+        )
 
 
 class Component:
@@ -204,8 +211,12 @@ class DistributedRuntime:
 
     async def _ensure_server(self) -> EndpointServer:
         if self._server is None:
+            from dynamo_tpu.runtime.chaos import ChaosInjector
+
             self._server = await EndpointServer(
-                advertise_host=self._advertise_host
+                advertise_host=self._advertise_host,
+                max_inflight=self.config.runtime.max_inflight,
+                chaos=ChaosInjector.from_config(self.config.chaos),
             ).start()
         return self._server
 
@@ -235,7 +246,10 @@ class DistributedRuntime:
         key = (ns, comp, ep)
         client = self._discoveries.get(key)
         if client is None:
-            client = DiscoveryClient(self.store, ns, comp, ep)
+            client = DiscoveryClient(
+                self.store, ns, comp, ep,
+                circuit_cooldown=self.config.runtime.circuit_cooldown,
+            )
             await client.start()
             self._discoveries[key] = client
         return client

@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import math
 import uuid
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from dynamo_tpu.runtime import framing
-from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.chaos import ChaosInjector, ChaosKillError
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, DeadlineExceededError
 from dynamo_tpu.runtime.logging import (
     TraceContext,
     get_logger,
@@ -54,13 +56,30 @@ class NoHandlerError(Exception):
     """Subject not served at the target (analogue of NATS NoResponders)."""
 
 
+class OverloadedError(Exception):
+    """Target refused the request at its admission gate (at capacity).
+
+    The instance is alive — routers retry elsewhere with backoff instead of
+    circuit-breaking it; the ingress maps exhaustion to 503 + Retry-After."""
+
+
 class EndpointServer:
     """Per-process ingress: serves all endpoints this process registered."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0, advertise_host: str | None = None):
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        advertise_host: str | None = None,
+        max_inflight: int = 0,
+        chaos: ChaosInjector | None = None,
+    ):
         self.host = host
         self.port = port
         self.advertise_host = advertise_host or ("127.0.0.1" if host in ("0.0.0.0", "") else host)
+        # Worker-side admission gate: per-subject in-flight bound (0 = off).
+        self.max_inflight = max_inflight
+        self.chaos = chaos
         self._handlers: dict[str, Handler] = {}
         self._server: asyncio.Server | None = None
         self._inflight: dict[str, int] = {}
@@ -137,6 +156,11 @@ class EndpointServer:
             async with write_lock:
                 await framing.write_frame(writer, obj)
 
+        def abort() -> None:
+            """Cut the transport without a final/err frame — the client sees
+            exactly what a worker crash produces (TruncatedStreamError)."""
+            writer.close()
+
         try:
             while True:
                 msg = await framing.read_frame(reader)
@@ -148,7 +172,7 @@ class EndpointServer:
                     ctx = self._make_context(rid, msg.get("headers") or {})
                     contexts[rid] = ctx
                     task = asyncio.get_running_loop().create_task(
-                        self._run_request(msg, ctx, send)
+                        self._run_request(msg, ctx, send, abort)
                     )
                     tasks[rid] = task
                     task.add_done_callback(lambda _t, r=rid: (tasks.pop(r, None), contexts.pop(r, None)))
@@ -169,32 +193,73 @@ class EndpointServer:
         tp = headers.get("traceparent")
         if tp:
             trace = TraceContext.parse(tp, headers.get("tracestate"))
-        return Context(
+        ctx = Context(
             request_id=headers.get("context_id") or rid,
             trace=trace,
             metadata=dict(headers.get("metadata") or {}),
         )
+        # Deadline travels as remaining seconds and is re-anchored on this
+        # process's monotonic clock (gRPC-style; immune to clock skew). A
+        # malformed value from a foreign client must not take down the
+        # whole multiplexed connection — treat it as "no deadline".
+        timeout_s = headers.get("timeout_s")
+        if timeout_s is not None:
+            try:
+                timeout_s = float(timeout_s)
+            except (TypeError, ValueError):
+                log.warning("ignoring malformed timeout_s header: %r", timeout_s)
+            else:
+                if math.isfinite(timeout_s):
+                    ctx.set_timeout(timeout_s)
+        return ctx
 
-    async def _run_request(self, msg: dict, ctx: Context, send) -> None:
+    async def _run_request(self, msg: dict, ctx: Context, send, abort) -> None:
         rid, subject = msg["id"], msg["subject"]
         handler = self._handlers.get(subject)
         if handler is None or subject in self._draining:
             await send({"t": "err", "id": rid, "error": f"no handler for {subject}", "kind": "no_handler"})
+            return
+        if 0 < self.max_inflight <= self._inflight.get(subject, 0):
+            # Worker-side admission gate: refuse before any work happens so
+            # the router can place the request on a less-loaded instance.
+            await send({
+                "t": "err", "id": rid, "kind": "overloaded",
+                "error": f"{subject} at capacity ({self.max_inflight} in flight)",
+            })
             return
         self._inflight[subject] += 1
         self._idle[subject].clear()
         self._subject_ctxs.setdefault(subject, set()).add(ctx)
         token = set_current_trace(ctx.trace)
         try:
+            ctx.check_deadline()  # expired in transit/queue: don't start work
             async for item in handler(msg.get("payload"), ctx):
                 if ctx.cancelled:
                     break
+                ctx.check_deadline()
+                if self.chaos is not None:
+                    await self.chaos.inject_latency()
+                    if self.chaos.should_drop_frame():
+                        abort()
+                        return
                 await send({"t": "data", "id": rid, "payload": item})
+            if self.chaos is not None and self.chaos.should_truncate():
+                abort()
+                return
             await send({"t": "final", "id": rid})
         except asyncio.CancelledError:
             raise
         except (ConnectionResetError, BrokenPipeError):
             pass
+        except ChaosKillError:
+            # Injected worker death: drop the transport, no error frame —
+            # on the wire this is exactly a crashed process.
+            abort()
+        except DeadlineExceededError as e:
+            try:
+                await send({"t": "err", "id": rid, "error": str(e), "kind": "deadline"})
+            except (ConnectionResetError, BrokenPipeError):
+                pass
         except Exception as e:  # noqa: BLE001 — protocol boundary
             log.exception("handler error for %s", subject)
             try:
@@ -278,9 +343,11 @@ class MessageClient:
     ) -> AsyncIterator[Any]:
         """Issue a streaming request; yields response payloads.
 
-        Raises NoHandlerError / StreamError / TruncatedStreamError — callers
-        (PushRouter, Migration) use these to distinguish dead-worker from
-        application failure."""
+        Raises NoHandlerError / StreamError / TruncatedStreamError /
+        OverloadedError / DeadlineExceededError — callers (PushRouter,
+        Migration) use these to distinguish dead-worker from application
+        failure from out-of-time."""
+        context.check_deadline()
         conn = await self._get_conn(addr)
         # Fresh wire id per call: two concurrent calls sharing a context lineage
         # (e.g. disagg prefill+decode fan-out) must not collide in conn.streams
@@ -290,6 +357,9 @@ class MessageClient:
         queue: asyncio.Queue = asyncio.Queue()
         conn.streams[rid] = queue
         headers: dict[str, Any] = {"metadata": context.metadata, "context_id": context.id}
+        remaining = context.time_remaining()
+        if remaining is not None:
+            headers["timeout_s"] = remaining
         if context.trace is not None:
             headers["traceparent"] = context.trace.traceparent()
             if context.trace.tracestate:
@@ -306,9 +376,20 @@ class MessageClient:
             try:
                 while True:
                     getter = asyncio.get_running_loop().create_task(queue.get())
+                    # The wait is bounded by the request deadline: a stalled
+                    # worker (or injected latency) can't hold the caller past
+                    # its budget — the finally-block cancel frame frees the
+                    # worker side.
                     done, _ = await asyncio.wait(
-                        {getter, cancel_waiter}, return_when=asyncio.FIRST_COMPLETED
+                        {getter, cancel_waiter},
+                        return_when=asyncio.FIRST_COMPLETED,
+                        timeout=context.time_remaining(),
                     )
+                    if not done:  # deadline hit while waiting
+                        getter.cancel()
+                        raise DeadlineExceededError(
+                            f"request {context.id} exceeded its deadline awaiting {addr}"
+                        )
                     if cancel_waiter in done and getter not in done:
                         getter.cancel()
                         return
@@ -323,8 +404,13 @@ class MessageClient:
                         return
                     elif t == "err":
                         finished = True
-                        if msg.get("kind") == "no_handler":
+                        kind = msg.get("kind")
+                        if kind == "no_handler":
                             raise NoHandlerError(msg.get("error", subject))
+                        if kind == "overloaded":
+                            raise OverloadedError(msg.get("error", subject))
+                        if kind == "deadline":
+                            raise DeadlineExceededError(msg.get("error", subject))
                         raise StreamError(msg.get("error", "remote error"))
             finally:
                 cancel_waiter.cancel()

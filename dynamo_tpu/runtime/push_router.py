@@ -1,10 +1,21 @@
-"""Instance-selecting router with fault detection.
+"""Instance-selecting router with fault detection and retry hygiene.
 
 Reference analogue: ``PushRouter`` with RoundRobin/Random/Direct modes and
 ``generate_with_fault_detection`` — a worker that answers "no responders" or
 truncates its stream before any payload is marked down and the request
 retried on another instance (reference: lib/runtime/src/pipeline/network/
 egress/push_router.rs:61-75,168-201).
+
+Retry hygiene on top of the reference behaviour:
+
+- attempts are separated by jittered exponential backoff (never a hot
+  loop into a dying fleet), bounded by the request deadline;
+- an empty instance set is not instant failure — discovery may be
+  mid-churn (rolling restart), so the router waits briefly for the watch
+  to repopulate and retries within the same attempt budget;
+- a worker that refuses at its admission gate (``OverloadedError``) is
+  retried elsewhere but NOT circuit-broken — it is alive, just busy;
+- a successful stream reports the instance up, closing its breaker.
 
 Once payload frames have flowed, mid-stream death is *not* retried here —
 that is the Migration operator's job (it owns accumulated-token re-dispatch;
@@ -13,6 +24,7 @@ see dynamo_tpu/llm/migration.py).
 
 from __future__ import annotations
 
+import asyncio
 import random
 from enum import Enum
 from typing import Any, AsyncIterator
@@ -23,6 +35,7 @@ from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.messaging import (
     MessageClient,
     NoHandlerError,
+    OverloadedError,
     TruncatedStreamError,
 )
 
@@ -47,12 +60,20 @@ class PushRouter:
         messaging: MessageClient,
         mode: RouterMode = RouterMode.ROUND_ROBIN,
         max_attempts: int = 3,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        no_instances_wait: float = 1.0,
     ):
         self.discovery = discovery
         self.messaging = messaging
         self.mode = mode
         self.max_attempts = max_attempts
-        self._rr_counter = 0
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        # How long one attempt waits for discovery to repopulate when the
+        # instance set is empty (watch-driven, returns early on change).
+        self.no_instances_wait = no_instances_wait
+        self._rr_last = -1
 
     def _pick(self, instance_id: int | None) -> Any:
         instances = self.discovery.available()
@@ -68,10 +89,44 @@ class PushRouter:
             return inst
         if self.mode == RouterMode.RANDOM:
             return random.choice(instances)
-        instances = sorted(instances, key=lambda i: i.instance_id)
-        inst = instances[self._rr_counter % len(instances)]
-        self._rr_counter += 1
+        # Stable round-robin: serve instance ids in sorted order, resuming
+        # after the last id actually served. A counter over a re-sorted
+        # list skews under membership churn (an id shifting position can
+        # be skipped forever); resuming by id guarantees every live
+        # instance is visited once per cycle regardless of joins/leaves.
+        by_id = sorted(instances, key=lambda i: i.instance_id)
+        for inst in by_id:
+            if inst.instance_id > self._rr_last:
+                break
+        else:  # wrapped past the highest id
+            inst = by_id[0]
+        self._rr_last = inst.instance_id
         return inst
+
+    def _backoff_delay(self, attempt: int) -> float:
+        """Jittered exponential backoff before retry ``attempt`` (2-based):
+        full jitter in [0.5, 1.5) of base * 2^(attempt-2), capped."""
+        delay = min(self.backoff_base * (2 ** (attempt - 2)), self.backoff_max)
+        return delay * (0.5 + random.random())
+
+    async def _sleep_backoff(self, attempt: int, context: Context) -> None:
+        delay = self._backoff_delay(attempt)
+        remaining = context.time_remaining()
+        if remaining is not None:
+            delay = min(delay, max(remaining, 0.0))
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    async def _wait_for_instances(self, context: Context) -> None:
+        """Block (bounded) until the discovery set changes — rolling
+        restarts leave sub-second windows with zero registered instances,
+        which should read as "wait", not "fail"."""
+        timeout = self.no_instances_wait
+        remaining = context.time_remaining()
+        if remaining is not None:
+            timeout = min(timeout, max(remaining, 0.0))
+        if timeout > 0:
+            await self.discovery.wait_changed(self.discovery.version, timeout)
 
     async def generate(
         self,
@@ -81,12 +136,31 @@ class PushRouter:
     ) -> AsyncIterator[Any]:
         """Route and stream. Yields (instance_id, payload) framing is NOT
         exposed — payloads only; the chosen instance id is recorded in
-        ``context.metadata['worker_instance_id']``."""
+        ``context.metadata['worker_instance_id']``.
+
+        Raises typed errors: NoInstancesError (fleet empty after retries),
+        OverloadedError (every attempt refused at the admission gate),
+        DeadlineExceededError (budget ran out — never retried),
+        TruncatedStreamError (mid-stream death, Migration's to handle)."""
         attempts = 0
         last_err: Exception | None = None
         while attempts < self.max_attempts:
             attempts += 1
-            inst = self._pick(instance_id)
+            context.check_deadline()
+            if attempts > 1:
+                await self._sleep_backoff(attempts, context)
+                context.check_deadline()
+            try:
+                inst = self._pick(instance_id)
+            except NoInstancesError as e:
+                # Satellite fix: an empty set on ANY attempt used to escape
+                # the retry loop immediately; now it consumes an attempt
+                # waiting for the watch to repopulate.
+                last_err = e
+                if instance_id is not None:
+                    raise
+                await self._wait_for_instances(context)
+                continue
             context.metadata["worker_instance_id"] = inst.instance_id
             try:
                 stream = await self.messaging.call(
@@ -103,12 +177,24 @@ class PushRouter:
             first = True
             try:
                 async for item in stream:
-                    first = False
+                    if first:
+                        first = False
+                        # Payload flowed — the instance serves traffic;
+                        # close its breaker (half-open probe success).
+                        self.discovery.report_instance_up(inst.instance_id)
                     yield item
                 return
             except NoHandlerError as e:
                 # Worker registered but not serving (draining) — mark + retry.
                 self.discovery.report_instance_down(inst.instance_id)
+                last_err = e
+                if instance_id is not None or not first:
+                    raise
+                continue
+            except OverloadedError as e:
+                # Admission-gate refusal: the instance is healthy, so no
+                # down-marking — back off and try another instance.
+                log.debug("instance %x at capacity", inst.instance_id)
                 last_err = e
                 if instance_id is not None or not first:
                     raise

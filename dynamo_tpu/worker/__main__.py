@@ -132,7 +132,7 @@ def dp_rank_ports(base_port: int, dp_rank: int, stride: int = 4) -> dict:
 from dynamo_tpu.llm.tokenizer import parse_tokenizer_spec as tokenizer_spec
 
 
-async def build_engine(args):
+async def build_engine(args, config=None):
     """→ (engine, model_card). Engine exposes .generate/.metrics/.pool."""
     if args.model_path:
         # Hub names (`org/repo`) and .gguf files resolve to local paths
@@ -149,7 +149,10 @@ async def build_engine(args):
     eos_ids = list(tokenizer.eos_token_ids)
     if args.engine == "mocker":
         from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+        from dynamo_tpu.runtime.chaos import ChaosInjector
+        from dynamo_tpu.runtime.config import Config
 
+        cfg = config or Config.from_env()
         engine = MockerEngine(
             MockerArgs(
                 block_size=args.block_size,
@@ -159,6 +162,9 @@ async def build_engine(args):
                 itl_ms=args.mocker_itl_ms,
                 speedup=args.mocker_speedup,
                 delta_tokens=args.mocker_delta_tokens,
+                # Env-driven fault injection (DYNTPU_CHAOS_*): engine-level
+                # kill draws; the messaging layer reads the same section.
+                chaos=ChaosInjector.from_config(cfg.chaos),
             )
         )
         name = args.model_name or "mock-model"
@@ -215,7 +221,7 @@ async def build_engine(args):
 
 async def async_main(args) -> None:
     rt = await DistributedRuntime.create(store_url=args.store_url)
-    engine, card = await build_engine(args)
+    engine, card = await build_engine(args, config=rt.config)
 
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)

@@ -1,0 +1,736 @@
+"""Chaos suite: the full frontend→router→migration path under injected
+faults (seeded frame drops, stream truncations, worker kills, latency).
+
+Invariant under every scenario: a request either streams to completion
+(exactly the requested number of tokens, finish_reason set) or fails with
+a *typed* error (DeadlineExceededError / OverloadedError / 429 / 503 /
+TruncatedStreamError once migration is exhausted) within its deadline —
+no hangs, no silent truncation.
+
+Run reproducibly: tools/run_chaos.sh (fixed seed via DYNTPU_CHAOS_SEED).
+"""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.pipeline import _RouterEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.admission import AdmissionController, AdmissionRejected
+from dynamo_tpu.runtime.chaos import ChaosInjector
+from dynamo_tpu.runtime.config import ChaosConfig, Config
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
+from dynamo_tpu.runtime.messaging import OverloadedError, TruncatedStreamError
+from dynamo_tpu.runtime.push_router import NoInstancesError, RouterMode
+
+SEED = int(os.environ.get("DYNTPU_CHAOS_SEED", "1234"))
+
+pytestmark = pytest.mark.chaos
+
+
+def chaos_config(seed: int, **kw) -> Config:
+    cfg = Config.from_env({})
+    cfg.chaos = ChaosConfig(enabled=True, seed=seed, **kw)
+    # Fast retries so fault-heavy runs stay quick.
+    cfg.runtime.retry_backoff_base = 0.005
+    cfg.runtime.retry_backoff_max = 0.05
+    cfg.runtime.circuit_cooldown = 0.2
+    return cfg
+
+
+def plain_config(**runtime_kw) -> Config:
+    cfg = Config.from_env({})
+    cfg.runtime.retry_backoff_base = 0.005
+    cfg.runtime.retry_backoff_max = 0.05
+    cfg.runtime.circuit_cooldown = 0.2
+    for k, v in runtime_kw.items():
+        setattr(cfg.runtime, k, v)
+    return cfg
+
+
+async def start_chaos_worker(
+    store_url, config: Config, mocker: MockerArgs | None = None, namespace="chaos"
+):
+    """One in-process 'worker': its own runtime (own EndpointServer, so
+    chaos config and connection cuts are per-worker, like real processes)."""
+    rt = await DistributedRuntime.create(store_url=store_url, config=config)
+    engine = MockerEngine(mocker or MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0))
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    handle = await rt.namespace(namespace).component("backend").endpoint("generate").serve(gen_handler)
+    return rt, engine, handle
+
+
+async def make_router(store_url, n_instances, namespace="chaos", max_attempts=8):
+    rt = await DistributedRuntime.create(store_url=store_url, config=plain_config())
+    ep = rt.namespace(namespace).component("backend").endpoint("generate")
+    push = await ep.router(RouterMode.ROUND_ROBIN)
+    push.max_attempts = max_attempts
+    push.no_instances_wait = 0.2
+    await push.discovery.wait_for_instances(n_instances, timeout=10)
+    return rt, push
+
+
+def request(max_tokens=32, prompt=(1, 2, 3, 4, 5)) -> dict:
+    req = PreprocessedRequest(model="chaos-model", token_ids=list(prompt))
+    req.stop.max_tokens = max_tokens
+    return req.to_dict()
+
+
+async def drive_one(migration: Migration, ctx: Context, max_tokens=32):
+    """→ ("ok", n_tokens) or ("<ErrorType>", n_tokens). Any non-typed
+    outcome (hang, wrong error) surfaces as a test failure upstream."""
+    tokens = []
+    try:
+        async for item in migration.generate(request(max_tokens), ctx):
+            tokens.extend(item.get("token_ids") or [])
+        assert len(tokens) == max_tokens, f"silent truncation: {len(tokens)}/{max_tokens}"
+        return ("ok", len(tokens))
+    except (TruncatedStreamError, DeadlineExceededError, OverloadedError, NoInstancesError) as e:
+        return (type(e).__name__, len(tokens))
+
+
+def test_chaos_truncation_and_frame_drops_migrate_to_completion():
+    """Workers that cut connections at frame boundaries (drops + truncation)
+    must not lose requests: migration re-dispatches and every request
+    completes with exactly the requested token count, within a deadline."""
+
+    async def go():
+        url = "memory://chaos_trunc"
+        w1 = await start_chaos_worker(url, chaos_config(SEED, frame_drop_p=0.02, truncate_p=0.2))
+        w2 = await start_chaos_worker(url, chaos_config(SEED + 1, frame_drop_p=0.02, truncate_p=0.2))
+        rt, push = await make_router(url, 2)
+        migration = Migration(_RouterEngine(push), migration_limit=20)
+        try:
+            outcomes = []
+            for _ in range(20):
+                ctx = Context.with_timeout(30.0)
+                outcomes.append(await drive_one(migration, ctx))
+            # The chaos probabilities make some faults statistically certain
+            # across 20 streams; every single request must still finish.
+            injected = w1[0]._server.chaos.stats.total() + w2[0]._server.chaos.stats.total()
+            assert injected > 0, "chaos injected nothing — probabilities too low"
+            assert outcomes == [("ok", 32)] * 20, outcomes
+        finally:
+            await rt.shutdown()
+            await w1[0].shutdown()
+            await w2[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=120))
+
+
+def test_chaos_engine_kills_without_migration_surface_typed_errors():
+    """migration_limit=0: injected worker deaths must surface as
+    TruncatedStreamError (typed), never a hang or silent short stream."""
+
+    async def go():
+        url = "memory://chaos_kill0"
+        # Engine-level kill draws (ChaosKillError → transport cut).
+        cfg = plain_config()
+        mocker = MockerArgs(
+            block_size=4, num_kv_blocks=256, speedup=1000.0,
+            chaos=ChaosInjector(ChaosConfig(enabled=True, seed=SEED, kill_p=0.08)),
+        )
+        w = await start_chaos_worker(url, cfg, mocker)
+        rt, push = await make_router(url, 1, max_attempts=3)
+        migration = Migration(_RouterEngine(push), migration_limit=0)
+        try:
+            kinds = set()
+            for _ in range(12):
+                ctx = Context.with_timeout(10.0)
+                kind, n = await drive_one(migration, ctx, max_tokens=24)
+                kinds.add(kind)
+                assert kind in ("ok", "TruncatedStreamError", "NoInstancesError"), kind
+            assert "TruncatedStreamError" in kinds or "NoInstancesError" in kinds, (
+                f"kill_p never fired across 12 requests: {kinds}"
+            )
+        finally:
+            await rt.shutdown()
+            await w[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+def test_chaos_engine_kills_with_migration_complete():
+    """Same kill scenario, migration on, second healthy worker: everything
+    completes."""
+
+    async def go():
+        url = "memory://chaos_kill1"
+        mocker = MockerArgs(
+            block_size=4, num_kv_blocks=512, speedup=1000.0,
+            chaos=ChaosInjector(ChaosConfig(enabled=True, seed=SEED, kill_p=0.05)),
+        )
+        w1 = await start_chaos_worker(url, plain_config(), mocker)
+        w2 = await start_chaos_worker(url, plain_config())  # healthy
+        rt, push = await make_router(url, 2)
+        migration = Migration(_RouterEngine(push), migration_limit=20)
+        try:
+            for _ in range(12):
+                ctx = Context.with_timeout(30.0)
+                assert await drive_one(migration, ctx, max_tokens=24) == ("ok", 24)
+            assert mocker.chaos.stats.kills > 0, "kill_p never fired"
+        finally:
+            await rt.shutdown()
+            await w1[0].shutdown()
+            await w2[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+def test_chaos_latency_bounded_by_deadline():
+    """A slow/stalling worker cannot hold a request past its deadline: the
+    client gets DeadlineExceededError within deadline + small slack."""
+
+    async def go():
+        url = "memory://chaos_lat"
+        # ~40ms per token: a 64-token stream wants ~2.5s; deadline 0.4s.
+        mocker = MockerArgs(block_size=4, num_kv_blocks=256, itl_ms=40.0, speedup=1.0)
+        w = await start_chaos_worker(url, chaos_config(SEED, latency_ms=30.0), mocker)
+        rt, push = await make_router(url, 1)
+        migration = Migration(_RouterEngine(push), migration_limit=3)
+        try:
+            ctx = Context.with_timeout(0.4)
+            t0 = time.monotonic()
+            kind, n = await drive_one(migration, ctx, max_tokens=64)
+            elapsed = time.monotonic() - t0
+            assert kind == "DeadlineExceededError", (kind, n)
+            assert elapsed < 2.0, f"deadline enforcement too lax: {elapsed:.2f}s"
+            # The worker-side context must carry the deadline too (wire
+            # propagation): its engine stops instead of burning the slot.
+            await asyncio.sleep(0.3)
+            assert w[1]._active == 0
+        finally:
+            await rt.shutdown()
+            await w[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+
+def test_chaos_deterministic_under_fixed_seed():
+    """Identical seeds ⇒ identical fault draws and identical outcomes
+    (sequential driving keeps scheduling out of the picture)."""
+
+    async def run_once(tag: str):
+        url = f"memory://chaos_det_{tag}"
+        w1 = await start_chaos_worker(url, chaos_config(7, truncate_p=0.4))
+        w2 = await start_chaos_worker(url, chaos_config(8, truncate_p=0.4))
+        rt, push = await make_router(url, 2)
+        migration = Migration(_RouterEngine(push), migration_limit=10)
+        try:
+            outcomes = []
+            for _ in range(10):
+                outcomes.append(await drive_one(migration, Context.with_timeout(30.0)))
+            stats = (
+                w1[0]._server.chaos.stats.streams_truncated,
+                w2[0]._server.chaos.stats.streams_truncated,
+            )
+            return outcomes, stats
+        finally:
+            await rt.shutdown()
+            await w1[0].shutdown()
+            await w2[0].shutdown()
+
+    async def go():
+        return await run_once("a"), await run_once("b")
+
+    (out_a, stats_a), (out_b, stats_b) = asyncio.run(asyncio.wait_for(go(), timeout=120))
+    assert out_a == out_b
+    assert stats_a == stats_b
+    assert sum(stats_a) > 0, "seeded truncations never fired"
+
+
+def test_worker_admission_gate_refuses_typed_overload():
+    """A worker at max_inflight refuses with OverloadedError (typed), and
+    the router does NOT circuit-break the busy instance."""
+
+    async def go():
+        url = "memory://chaos_adm"
+        cfg = plain_config(max_inflight=1)
+        mocker = MockerArgs(block_size=4, num_kv_blocks=256, itl_ms=20.0, speedup=1.0)
+        w = await start_chaos_worker(url, cfg, mocker)
+        rt, push = await make_router(url, 1, max_attempts=2)
+        try:
+            ctx1 = Context.with_timeout(30.0)
+            stream1 = push.generate(request(max_tokens=48), ctx1)
+            first = await stream1.__anext__()  # occupy the only slot
+            assert first is not None
+            with pytest.raises(OverloadedError):
+                async for _ in push.generate(request(max_tokens=4), Context.with_timeout(5.0)):
+                    pass
+            # Busy ≠ dead: the instance must still be routable.
+            assert len(push.discovery.available()) == 1
+            ctx1.cancel()
+            async for _ in stream1:
+                pass
+        finally:
+            await rt.shutdown()
+            await w[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+
+def test_router_waits_out_empty_discovery_window():
+    """Satellite: an empty instance set mid-churn consumes retry attempts
+    waiting on the watch instead of failing the request instantly."""
+
+    async def go():
+        url = "memory://chaos_empty"
+        rt = await DistributedRuntime.create(store_url=url, config=plain_config())
+        ep = rt.namespace("chaos").component("backend").endpoint("generate")
+        push = await ep.router(RouterMode.ROUND_ROBIN)
+        push.max_attempts = 10
+        push.no_instances_wait = 0.3
+
+        async def late_worker():
+            await asyncio.sleep(0.4)  # a couple of empty-set attempts first
+            return await start_chaos_worker(url, plain_config())
+
+        spawn = asyncio.ensure_future(late_worker())
+        try:
+            out = [i async for i in push.generate(request(max_tokens=4), Context.with_timeout(20.0))]
+            assert sum(len(o.get("token_ids") or []) for o in out) == 4
+        finally:
+            w = await spawn
+            await rt.shutdown()
+            await w[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+
+def test_round_robin_stable_under_membership_churn():
+    """Satellite: the RR cursor resumes by instance id, so a membership
+    change never starves an instance."""
+    from dynamo_tpu.runtime.push_router import PushRouter
+
+    class FakeInst:
+        def __init__(self, iid):
+            self.instance_id = iid
+
+    class FakeDiscovery:
+        def __init__(self, ids):
+            self.ids = ids
+            self.namespace = self.component = self.endpoint = "x"
+
+        def available(self):
+            return [FakeInst(i) for i in self.ids]
+
+    disc = FakeDiscovery([10, 20, 30])
+    router = PushRouter(disc, messaging=None)
+    picks = [router._pick(None).instance_id for _ in range(3)]
+    assert picks == [10, 20, 30]
+    # Instance 15 joins mid-cycle: it is served on the next wrap, nobody
+    # is skipped, and the cycle covers every live id exactly once.
+    disc.ids = [10, 15, 20, 30]
+    picks = [router._pick(None).instance_id for _ in range(4)]
+    assert picks == [10, 15, 20, 30]
+    # Churn: the previously-served id vanishes; the cursor still advances.
+    disc.ids = [15, 20]
+    assert router._pick(None).instance_id == 15
+    assert router._pick(None).instance_id == 20
+    assert router._pick(None).instance_id == 15
+
+
+def test_circuit_breaker_half_open_probe_cycle():
+    """Satellite/tentpole: down → (cooldown) → half-open probe → up, and a
+    failed probe re-opens the circuit."""
+
+    async def go():
+        url = "memory://chaos_cb"
+        rt = await DistributedRuntime.create(store_url=url, config=plain_config())
+        w = await start_chaos_worker(url, plain_config())
+        ep = rt.namespace("chaos").component("backend").endpoint("generate")
+        disc = await ep.client()
+        await disc.wait_for_instances(1, timeout=5)
+        iid = disc.available()[0].instance_id
+        try:
+            disc.report_instance_down(iid)
+            assert disc.breaker_state(iid) == "open"
+            assert disc.available() == []  # excluded while open
+            await asyncio.sleep(disc.circuit_cooldown + 0.05)
+            assert len(disc.available()) == 1  # half-open: probe allowed
+            assert disc.breaker_state(iid) == "half-open"
+            disc.report_instance_down(iid)  # probe failed → re-open
+            assert disc.breaker_state(iid) == "open"
+            assert disc.available() == []
+            await asyncio.sleep(disc.circuit_cooldown + 0.05)
+            assert len(disc.available()) == 1
+            disc.report_instance_up(iid)  # probe succeeded → closed
+            assert disc.breaker_state(iid) == "closed"
+            assert len(disc.available()) == 1
+        finally:
+            await rt.shutdown()
+            await w[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=30))
+
+
+# -- HTTP ingress: overload shedding, deadlines, graceful drain ---------------
+
+
+async def start_http_worker(store_url, itl_ms=0.0, namespace="chaos"):
+    """Mocker worker publishing a model card (HTTP path needs discovery)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    rt = await DistributedRuntime.create(store_url=store_url, config=plain_config())
+    speedup = 1.0 if itl_ms else 1000.0
+    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=256, itl_ms=itl_ms or 5.0, speedup=speedup))
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await rt.namespace(namespace).component("backend").endpoint("generate").serve(gen_handler)
+    card = ModelDeploymentCard(
+        name="chaos-model", kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS], context_length=512,
+    )
+    await register_model(rt, namespace, card)
+    return rt, engine
+
+
+async def start_http_frontend(store_url, max_inflight=0, retry_after=2.0, default_timeout=0.0):
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.pipeline import RouterSettings
+
+    rt = await DistributedRuntime.create(store_url=store_url, config=plain_config())
+    manager = ModelManager(rt, RouterSettings(mode=RouterMode.ROUND_ROBIN))
+    watcher = await ModelWatcher(rt, manager).start()
+    http = await HttpService(
+        manager, rt.metrics, health=rt.health, host="127.0.0.1", port=0,
+        admission=AdmissionController(max_inflight=max_inflight, retry_after=retry_after),
+        default_timeout=default_timeout,
+    ).start()
+    return rt, manager, watcher, http
+
+
+def chat_body(max_tokens=40, **kw):
+    body = {
+        "model": "chaos-model",
+        "messages": [{"role": "user", "content": "overload me please"}],
+        "max_tokens": max_tokens,
+    }
+    body.update(kw)
+    return body
+
+
+def test_http_overload_sheds_429_with_retry_after():
+    """Synthetic overload: a 1-slot frontend returns 429 + Retry-After for
+    excess traffic instead of queueing it (acceptance criterion)."""
+    import httpx
+
+    async def go():
+        url = "memory://chaos_http_shed"
+        wrt, _ = await start_http_worker(url, itl_ms=25.0)
+        frt, manager, watcher, http = await start_http_frontend(url, max_inflight=1, retry_after=2.0)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                for _ in range(100):
+                    r = await client.get(f"{base}/v1/models")
+                    if r.json()["data"]:
+                        break
+                    await asyncio.sleep(0.05)
+
+                async def post():
+                    return await client.post(f"{base}/v1/chat/completions", json=chat_body())
+
+                rs = await asyncio.gather(post(), post(), post())
+                statuses = sorted(r.status_code for r in rs)
+                assert statuses == [200, 429, 429], statuses
+                shed = [r for r in rs if r.status_code == 429]
+                for r in shed:
+                    assert r.headers.get("Retry-After") == "2"
+                    assert r.json()["error"]["type"] == "overloaded_error"
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+def test_http_deadline_returns_504():
+    """X-Request-Timeout that can't be met → typed 504, bounded latency."""
+    import httpx
+
+    async def go():
+        url = "memory://chaos_http_ddl"
+        wrt, _ = await start_http_worker(url, itl_ms=50.0)
+        frt, manager, watcher, http = await start_http_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                for _ in range(100):
+                    r = await client.get(f"{base}/v1/models")
+                    if r.json()["data"]:
+                        break
+                    await asyncio.sleep(0.05)
+                t0 = time.monotonic()
+                r = await client.post(
+                    f"{base}/v1/chat/completions",
+                    json=chat_body(max_tokens=200),
+                    headers={"X-Request-Timeout": "0.4"},
+                )
+                elapsed = time.monotonic() - t0
+                assert r.status_code == 504, r.text
+                assert r.json()["error"]["type"] == "timeout_error"
+                assert elapsed < 3.0, f"504 took {elapsed:.2f}s — deadline not enforced"
+                # Malformed timeout is the client's error.
+                r = await client.post(
+                    f"{base}/v1/chat/completions", json=chat_body(),
+                    headers={"X-Request-Timeout": "-3"},
+                )
+                assert r.status_code == 400
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+def test_http_drain_finishes_inflight_then_refuses():
+    """Drain: in-flight streams run to completion; new requests get 503 +
+    Retry-After; wait_drained observes the idle transition."""
+    import json as _json
+
+    import httpx
+
+    from dynamo_tpu.llm.protocols import parse_sse_lines
+
+    async def go():
+        url = "memory://chaos_http_drain"
+        wrt, _ = await start_http_worker(url, itl_ms=25.0)
+        frt, manager, watcher, http = await start_http_frontend(url, retry_after=1.0)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                for _ in range(100):
+                    r = await client.get(f"{base}/v1/models")
+                    if r.json()["data"]:
+                        break
+                    await asyncio.sleep(0.05)
+
+                async def stream():
+                    raw = []
+                    async with client.stream(
+                        "POST", f"{base}/v1/chat/completions",
+                        json=chat_body(max_tokens=30, stream=True),
+                    ) as resp:
+                        assert resp.status_code == 200
+                        async for c in resp.aiter_bytes():
+                            raw.append(c)
+                    return list(parse_sse_lines(raw))
+
+                task = asyncio.ensure_future(stream())
+                while http.admission.inflight == 0:  # stream admitted
+                    await asyncio.sleep(0.01)
+                http.start_draining()
+                r = await client.post(f"{base}/v1/chat/completions", json=chat_body(max_tokens=2))
+                assert r.status_code == 503
+                assert r.headers.get("Retry-After") == "1"
+                events = await task  # in-flight stream ran to completion
+                assert events[-1] == "[DONE]"
+                payloads = [_json.loads(e) for e in events[:-1]]
+                assert payloads[-1]["usage"]["completion_tokens"] == 30
+                assert await http.wait_drained(timeout=5.0)
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+@pytest.mark.e2e
+def test_sigterm_drains_inflight_streams_before_exit():
+    """Acceptance: SIGTERM to the frontend CLI mid-stream — the stream
+    completes, concurrent new requests are shed 503, the process exits 0."""
+    import signal
+    import socket
+
+    import httpx
+
+    from procutil import ManagedProcess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        store_port = s.getsockname()[1]
+    store_url = f"tcp://127.0.0.1:{store_port}"
+
+    with ManagedProcess(
+        ["-m", "dynamo_tpu.runtime.store_server", "--host", "127.0.0.1", "--port", str(store_port)],
+        name="store",
+    ) as store:
+        store.wait_for(r"store server: tcp://")
+        with ManagedProcess(
+            ["-m", "dynamo_tpu.mocker", "--store-url", store_url,
+             "--mocker-itl-ms", "50", "--model-name", "chaos-model"],
+            name="worker",
+        ):
+            with ManagedProcess(
+                ["-m", "dynamo_tpu.frontend", "--store-url", store_url,
+                 "--host", "127.0.0.1", "--port", "0"],
+                name="frontend",
+            ) as frontend:
+                m = frontend.wait_for(r"frontend: http://127\.0\.0\.1:(\d+)")
+                base = f"http://127.0.0.1:{int(m.group(1))}"
+
+                async def drive():
+                    from dynamo_tpu.llm.protocols import parse_sse_lines
+
+                    async with httpx.AsyncClient(timeout=60) as client:
+                        for _ in range(150):
+                            r = await client.get(f"{base}/v1/models")
+                            if r.json()["data"]:
+                                break
+                            await asyncio.sleep(0.1)
+
+                        async def stream():
+                            raw = []
+                            async with client.stream(
+                                "POST", f"{base}/v1/chat/completions",
+                                json=chat_body(max_tokens=40, stream=True),
+                            ) as resp:
+                                assert resp.status_code == 200
+                                async for c in resp.aiter_bytes():
+                                    raw.append(c)
+                            return list(parse_sse_lines(raw))
+
+                        task = asyncio.ensure_future(stream())
+                        await asyncio.sleep(0.5)  # stream is mid-flight (~2s total)
+                        frontend.kill(signal.SIGTERM)
+                        await asyncio.sleep(0.2)
+                        # While draining: new work is shed with Retry-After.
+                        r = await client.post(
+                            f"{base}/v1/chat/completions", json=chat_body(max_tokens=2)
+                        )
+                        assert r.status_code == 503, r.text
+                        assert "Retry-After" in r.headers
+                        events = await task
+                        assert events[-1] == "[DONE]"
+                        import json as _json
+
+                        payloads = [_json.loads(e) for e in events[:-1]]
+                        assert payloads[-1]["usage"]["completion_tokens"] == 40
+
+                asyncio.run(drive())
+                assert frontend.proc.wait(15) == 0
+
+
+def test_admission_controller_sheds_and_drains():
+    """Unit: bounded gate rejects over-capacity, drains idle, refuses
+    during drain."""
+
+    async def go():
+        adm = AdmissionController(max_inflight=2, max_queue_depth=0, retry_after=3.0)
+        await adm.acquire()
+        await adm.acquire()
+        with pytest.raises(AdmissionRejected) as exc:
+            await adm.acquire()
+        assert exc.value.retry_after == 3.0 and not exc.value.draining
+        adm.release()
+        await adm.acquire()  # slot freed → admissible again
+        adm.start_draining()
+        with pytest.raises(AdmissionRejected) as exc:
+            await adm.acquire()
+        assert exc.value.draining
+        assert not await adm.wait_idle(timeout=0.05)  # still 2 in flight
+        adm.release()
+        adm.release()
+        assert await adm.wait_idle(timeout=1.0)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+
+
+def test_admission_cancelled_queued_waiter_returns_its_slot():
+    """A queued waiter cancelled right after release() hands it a slot must
+    give the slot back — otherwise every such disconnect permanently shrinks
+    capacity and drains never finish."""
+
+    async def go():
+        adm = AdmissionController(max_inflight=1, max_queue_depth=2, queue_timeout=5.0)
+        await adm.acquire()
+        waiter = asyncio.ensure_future(adm.acquire())
+        await asyncio.sleep(0.01)  # queued
+        adm.release()          # hands the slot to the waiter's future...
+        waiter.cancel()        # ...but the waiter dies before resuming
+        # Two legal outcomes, version-dependent: 3.10's wait_for swallows
+        # the cancellation when the inner future already has a result (the
+        # waiter owns the slot and its caller must release, as the HTTP
+        # handler's finally does); newer semantics re-raise CancelledError,
+        # in which case acquire() must have returned the slot itself.
+        try:
+            await waiter
+            assert adm.inflight == 1
+            adm.release()
+        except asyncio.CancelledError:
+            pass
+        assert adm.inflight == 0, "cancelled waiter leaked its slot"
+        assert await adm.wait_idle(timeout=1.0)
+        # Gate still works end to end after the churn.
+        await adm.acquire()
+        assert adm.inflight == 1
+        adm.release()
+        # Cancellation BEFORE any slot was assigned just leaves the queue.
+        await adm.acquire()
+        w2 = asyncio.ensure_future(adm.acquire())
+        await asyncio.sleep(0.01)
+        w2.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await w2
+        assert adm.inflight == 1 and adm.queued == 0
+        adm.release()
+        assert await adm.wait_idle(timeout=1.0)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+
+
+def test_admission_bounded_queue_and_drain_rejects_waiters():
+    """Queue headroom admits FIFO-ish on release; over-depth sheds at once;
+    draining rejects queued waiters without corrupting the inflight count."""
+
+    async def go():
+        adm = AdmissionController(max_inflight=1, max_queue_depth=2, retry_after=1.0)
+        await adm.acquire()
+        t1 = asyncio.ensure_future(adm.acquire())
+        t2 = asyncio.ensure_future(adm.acquire())
+        await asyncio.sleep(0.05)
+        assert adm.queued == 2
+        with pytest.raises(AdmissionRejected):  # beyond queue depth
+            await adm.acquire()
+        adm.release()  # one waiter admitted
+        await asyncio.sleep(0.05)
+        assert sum(t.done() for t in (t1, t2)) == 1
+        assert adm.inflight == 1 and adm.queued == 1
+        adm.start_draining()  # remaining waiter rejected as draining
+        await asyncio.sleep(0.05)
+        rest = t1 if not t1.done() else t2
+        assert isinstance(rest.exception(), AdmissionRejected) and rest.exception().draining
+        assert adm.inflight == 1 and adm.queued == 0
+        adm.release()
+        assert await adm.wait_idle(timeout=1.0)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))

@@ -42,6 +42,123 @@ def request(max_tokens=40) -> dict:
     return req.to_dict()
 
 
+# -- re-dispatch arithmetic (unit; no processes) ------------------------------
+
+
+class FlakyInner:
+    """AsyncEngine that emits a scripted number of tokens per call, dying
+    (TruncatedStreamError) after every call except the last. Records each
+    request so re-dispatch arithmetic is observable."""
+
+    def __init__(self, emits_per_call: list[int], base_token: int = 100):
+        self.emits_per_call = emits_per_call
+        self.base_token = base_token
+        self.requests: list[dict] = []
+
+    async def generate(self, request, context):
+        call = len(self.requests)
+        self.requests.append(request)
+        n = self.emits_per_call[call]
+        start = self.base_token + sum(self.emits_per_call[:call])
+        for i in range(n):
+            yield {"token_ids": [start + i]}
+        if call < len(self.emits_per_call) - 1:
+            raise TruncatedStreamError("scripted death")
+        yield {"token_ids": [], "finish_reason": "length"}
+
+
+def mig_request(max_tokens=40, min_tokens=10, seed=123) -> dict:
+    return {
+        "token_ids": [1, 2, 3, 4, 5],
+        "stop": {"max_tokens": max_tokens, "min_tokens": min_tokens},
+        "sampling": {"seed": seed},
+    }
+
+
+def test_redispatch_shrinks_budgets_and_extends_prompt():
+    async def go():
+        inner = FlakyInner([7, 33])
+        mig = Migration(inner, migration_limit=3)
+        tokens = [
+            t async for item in mig.generate(mig_request(), Context())
+            for t in (item.get("token_ids") or [])
+        ]
+        assert len(tokens) == 40
+        assert len(inner.requests) == 2
+        re_req = inner.requests[1]
+        # Carried tokens became prompt; budgets shrank by what was emitted.
+        assert re_req["token_ids"] == [1, 2, 3, 4, 5] + list(range(100, 107))
+        assert re_req["stop"]["max_tokens"] == 40 - 7
+        assert re_req["stop"]["min_tokens"] == 10 - 7
+        # Seed folding: fresh deterministic draw, not a replay of the dead
+        # worker's gumbel indices.
+        expect = (123 + 0x9E3779B1 * 7) & 0x7FFFFFFF
+        assert re_req["sampling"]["seed"] == expect != 123
+        # The original request dict was not mutated in place.
+        assert inner.requests[0]["stop"]["max_tokens"] == 40
+
+    asyncio.run(go())
+
+
+def test_redispatch_budget_floors():
+    """max_tokens never drops below 1, min_tokens never below 0, and the
+    seed folds per-migration on the carried count of THAT leg."""
+
+    async def go():
+        inner = FlakyInner([12, 4, 40])
+        mig = Migration(inner, migration_limit=3)
+        [_ async for _ in mig.generate(mig_request(max_tokens=14, min_tokens=3), Context())]
+        second, third = inner.requests[1], inner.requests[2]
+        assert second["stop"]["max_tokens"] == 2   # 14 - 12
+        assert second["stop"]["min_tokens"] == 0   # max(0, 3 - 12)
+        assert third["stop"]["max_tokens"] == 1    # floor: max(1, 2 - 4)
+        assert len(third["token_ids"]) == 5 + 12 + 4
+        seed1 = (123 + 0x9E3779B1 * 12) & 0x7FFFFFFF
+        seed2 = (seed1 + 0x9E3779B1 * 4) & 0x7FFFFFFF
+        assert second["sampling"]["seed"] == seed1
+        assert third["sampling"]["seed"] == seed2
+
+    asyncio.run(go())
+
+
+def test_migration_limit_zero_reraises():
+    async def go():
+        inner = FlakyInner([5, 35])
+        mig = Migration(inner, migration_limit=0)
+        got = []
+        with pytest.raises(TruncatedStreamError):
+            async for item in mig.generate(mig_request(), Context()):
+                got.extend(item.get("token_ids") or [])
+        assert got == list(range(100, 105))
+        assert len(inner.requests) == 1  # never re-dispatched
+
+    asyncio.run(go())
+
+
+def test_truncation_after_finish_reason_is_completion():
+    """A connection cut between the finish_reason delta and the final frame
+    must NOT re-dispatch (the generation already completed) — found by the
+    chaos suite: re-dispatch here over-delivers tokens."""
+
+    class DiesAfterFinish:
+        def __init__(self):
+            self.calls = 0
+
+        async def generate(self, request, context):
+            self.calls += 1
+            yield {"token_ids": [1, 2, 3], "finish_reason": "length"}
+            raise TruncatedStreamError("died after finish delta")
+
+    async def go():
+        inner = DiesAfterFinish()
+        mig = Migration(inner, migration_limit=3)
+        out = [item async for item in mig.generate(mig_request(max_tokens=3), Context())]
+        assert inner.calls == 1
+        assert sum(len(i.get("token_ids") or []) for i in out) == 3
+
+    asyncio.run(go())
+
+
 @pytest.mark.e2e
 def test_migration_completes_stream_after_worker_kill():
     port = free_port()

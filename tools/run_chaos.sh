@@ -1,0 +1,8 @@
+#!/usr/bin/env sh
+# Run the chaos fault-injection suite with a fixed seed (deterministic
+# replay; see docs/robustness.md). Override: DYNTPU_CHAOS_SEED=42 tools/run_chaos.sh
+set -e
+cd "$(dirname "$0")/.."
+export DYNTPU_CHAOS_SEED="${DYNTPU_CHAOS_SEED:-1234}"
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m chaos \
+    -p no:cacheprovider "$@"
