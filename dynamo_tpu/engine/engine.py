@@ -36,6 +36,7 @@ from dynamo_tpu.engine.config import EngineArgs
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
@@ -55,7 +56,7 @@ class _Seq:
         "block_ids", "block_seq", "registered_blocks", "queue", "emitted",
         "cancelled", "preempted", "prefix_hit_blocks", "sample_seed",
         "kv_written", "export", "export_meta", "inject", "dead",
-        "slot", "first_pend",
+        "slot", "first_pend", "t_admit",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -82,6 +83,10 @@ class _Seq:
         # just-sampled token's KV lands on the NEXT step (it is that step's
         # input), so sealing a block lags writing it.
         self.kv_written = 0
+        # Tracing stamp (perf_counter, set by the scheduler thread when the
+        # request wins admission): splits queue-wait from prefill in the
+        # consumer coroutine's retroactive spans.
+        self.t_admit: float | None = None
         # Finished/cancelled (set by _finish). In-flight decode windows
         # drain after the fact; dead rows' outputs are discarded.
         self.dead = False
@@ -272,6 +277,7 @@ class TpuEngine:
                 if req.sampling.logprobs else 0
             )
         queue: asyncio.Queue = asyncio.Queue()
+        t_submit = time.perf_counter()
         seq = _Seq(context.id, req, queue)
         with self._wakeup:
             if self._stopping:
@@ -286,15 +292,40 @@ class TpuEngine:
                 self._wakeup.notify()
 
         watcher = asyncio.get_running_loop().create_task(watch_cancel())
+        dspan = tracing.NOOP_SPAN
+        first = True
         try:
             while True:
                 item = await queue.get()
                 if item is _SENTINEL_DONE:
                     return
+                if first:
+                    first = False
+                    if tracing.enabled() and context.trace is not None:
+                        # Queue/prefill phases from the scheduler thread's
+                        # admission stamp, recorded retroactively at first
+                        # delta; decode is live from here.
+                        now = time.perf_counter()
+                        t_admit = seq.t_admit or now
+                        tracing.record_interval(
+                            "engine.queue", context.trace,
+                            start=t_submit, end=t_admit,
+                        )
+                        tracing.record_interval(
+                            "engine.prefill", context.trace,
+                            start=t_admit, end=now,
+                            prompt_tokens=seq.prompt_len,
+                            cached_blocks=seq.prefix_hit_blocks,
+                        )
+                        dspan = tracing.start_span(
+                            "engine.decode", parent=context.trace
+                        )
                 yield item
                 if isinstance(item, dict) and item.get("finish_reason"):
                     return
         finally:
+            dspan.set_attrs(tokens=seq.emitted)
+            dspan.end(status="cancelled" if seq.cancelled else None)
             watcher.cancel()
             with self._wakeup:
                 seq.cancelled = True  # no-op if already finished
@@ -393,6 +424,7 @@ class TpuEngine:
                     seq.block_ids = []
                 self._finish(seq, FinishReason.ERROR, error=f"admission failed: {e}")
                 continue
+            seq.t_admit = time.perf_counter()
             allocated.append((seq, start))
         t0 = self._phase("admission", t0)
         admitted: list[tuple[_Seq, Any, int]] = []  # (seq, logits array, row)

@@ -252,8 +252,9 @@ class KvPushRouter:
             if isinstance(self.index, ApproxKvIndexer):
                 self.index.record_routing(wid, hashes)
             first = True
+            stream = self.push.generate(request, context, instance_id=wid)
             try:
-                async for item in self.push.generate(request, context, instance_id=wid):
+                async for item in stream:
                     if first:
                         first = False
                         self.active.mark_prefill_complete(context.id)
@@ -275,4 +276,7 @@ class KvPushRouter:
                 continue
             finally:
                 self.active.free(context.id)
+                # Deterministic close: an abandoned inner stream must run its
+                # finallys (span end, wire cancel) now, not at async-GC.
+                await stream.aclose()
         raise last_err or NoInstancesError("no available instances")

@@ -89,65 +89,73 @@ class Backend(Operator):
         finished = False
 
         wire_req = req.to_dict() if isinstance(request, PreprocessedRequest) else request
-        async for raw in self.inner.generate(wire_req, context.child()):
-            out = raw if isinstance(raw, LLMEngineOutput) else LLMEngineOutput.from_dict(raw)
-            if out.finish_reason == FinishReason.ERROR:
-                yield out.to_dict()
-                return
-            text_parts: list[str] = []
-            stop_kind: str | None = None  # "token" (eos/stop id) | "string"
-            n_new = 0
-            for tid in out.token_ids:
-                n_emitted += 1
-                n_new += 1
-                if not ignore_eos and tid in eos_ids and n_emitted >= min_tokens:
-                    # vLLM semantics: the eos token counts toward min_tokens.
-                    stop_kind = "token"
-                    break  # never detokenize the stop token itself
-                piece = stream.step(tid)
-                if piece is not None:
-                    released, matched = jail.push(piece)
-                    if released:
-                        text_parts.append(released)
-                    if matched:
-                        stop_kind = "string"
-                        break
-            finish = out.finish_reason
-            if stop_kind is not None:
-                finish = FinishReason.STOP
-            if finish is not None and stop_kind != "string":
-                # Natural end or eos stop: text still held in the decode
-                # window / jail is legitimate output — flush it. A stop
-                # string discovered only now still truncates and wins.
-                tail = stream.flush()
-                if tail:
-                    released, matched = jail.push(tail)
-                    if released:
-                        text_parts.append(released)
-                    if matched:
-                        finish = FinishReason.STOP
+        inner_stream = self.inner.generate(wire_req, context.child())
+        try:
+            async for raw in inner_stream:
+                out = raw if isinstance(raw, LLMEngineOutput) else LLMEngineOutput.from_dict(raw)
+                if out.finish_reason == FinishReason.ERROR:
+                    yield out.to_dict()
+                    return
+                text_parts: list[str] = []
+                stop_kind: str | None = None  # "token" (eos/stop id) | "string"
+                n_new = 0
+                for tid in out.token_ids:
+                    n_emitted += 1
+                    n_new += 1
+                    if not ignore_eos and tid in eos_ids and n_emitted >= min_tokens:
+                        # vLLM semantics: the eos token counts toward min_tokens.
+                        stop_kind = "token"
+                        break  # never detokenize the stop token itself
+                    piece = stream.step(tid)
+                    if piece is not None:
+                        released, matched = jail.push(piece)
+                        if released:
+                            text_parts.append(released)
+                        if matched:
+                            stop_kind = "string"
+                            break
+                finish = out.finish_reason
+                if stop_kind is not None:
+                    finish = FinishReason.STOP
+                if finish is not None and stop_kind != "string":
+                    # Natural end or eos stop: text still held in the decode
+                    # window / jail is legitimate output — flush it. A stop
+                    # string discovered only now still truncates and wins.
+                    tail = stream.flush()
+                    if tail:
+                        released, matched = jail.push(tail)
+                        if released:
+                            text_parts.append(released)
+                        if matched:
+                            finish = FinishReason.STOP
+                        else:
+                            rest = jail.flush()
+                            if rest:
+                                text_parts.append(rest)
                     else:
                         rest = jail.flush()
                         if rest:
                             text_parts.append(rest)
-                else:
-                    rest = jail.flush()
-                    if rest:
-                        text_parts.append(rest)
-            delta = LLMEngineOutput(
-                token_ids=list(out.token_ids[:n_new]),
-                text="".join(text_parts) if text_parts else None,
-                finish_reason=finish,
-                log_probs=list(out.log_probs[:n_new]) if out.log_probs else None,
-                top_log_probs=out.top_log_probs[:n_new] if out.top_log_probs else None,
-                cum_log_probs=out.cum_log_probs,
-                kv_transfer_params=out.kv_transfer_params,
-            )
-            if delta.token_ids or delta.text or delta.finished:
-                yield delta.to_dict()
-            if finish is not None:
-                finished = True
-                break
-        if not finished:
-            # Engine stream ended without a finish reason — surface as stop.
-            yield LLMEngineOutput(finish_reason=FinishReason.STOP).to_dict()
+                delta = LLMEngineOutput(
+                    token_ids=list(out.token_ids[:n_new]),
+                    text="".join(text_parts) if text_parts else None,
+                    finish_reason=finish,
+                    log_probs=list(out.log_probs[:n_new]) if out.log_probs else None,
+                    top_log_probs=out.top_log_probs[:n_new] if out.top_log_probs else None,
+                    cum_log_probs=out.cum_log_probs,
+                    kv_transfer_params=out.kv_transfer_params,
+                )
+                if delta.token_ids or delta.text or delta.finished:
+                    yield delta.to_dict()
+                if finish is not None:
+                    finished = True
+                    break
+            if not finished:
+                # Engine stream ended without a finish reason — surface as stop.
+                yield LLMEngineOutput(finish_reason=FinishReason.STOP).to_dict()
+        finally:
+            # A finish_reason delta ends this loop with the engine stream
+            # un-exhausted: close it NOW so the downstream finallys (router
+            # attempt span, wire span + cancel frame) run before the caller
+            # builds its ledger, instead of at async-generator GC.
+            await inner_stream.aclose()

@@ -35,14 +35,18 @@ from dynamo_tpu.llm.protocols import (
     sse_event,
     sse_typed_event,
 )
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.admission import AdmissionController, AdmissionRejected
 from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
-from dynamo_tpu.runtime.logging import current_trace, get_logger
+from dynamo_tpu.runtime.logging import TraceContext, current_trace, get_logger
 from dynamo_tpu.runtime.messaging import OverloadedError
 from dynamo_tpu.runtime.metrics import InflightGuard, MetricsRegistry
 from dynamo_tpu.runtime.push_router import NoInstancesError
 
 log = get_logger("http")
+# Lifecycle ledger records ride the logging layer as structured JSONL
+# (JsonlFormatter includes extra={} fields) in addition to /debug/requests.
+ledger_log = get_logger("ledger")
 
 
 class HttpService:
@@ -76,6 +80,16 @@ class HttpService:
         # (reference observes ITL from frontend metrics, planner_core.py:189-320).
         self.m_itl = scope.histogram("http_inter_token_latency_seconds", "Mean inter-token latency per request")
         self.m_output_tokens = scope.counter("http_output_tokens_total", "Output tokens")
+        self.m_admission_wait = scope.histogram(
+            "admission_wait_seconds", "Time spent waiting at the admission gate"
+        )
+        self.m_queue_depth = scope.gauge(
+            "admission_queue_depth", "Requests queued at the admission gate"
+        )
+        self.m_deadline = scope.counter(
+            "deadline_expired_total",
+            "Requests that ran out of budget, by enforcement point",
+        )
         self._metrics_registry = metrics
 
     def build_app(self) -> web.Application:
@@ -89,6 +103,8 @@ class HttpService:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_post("/v1/embeddings", self.handle_embeddings)
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
+        app.router.add_get("/debug/requests", self.handle_debug_requests)
+        app.router.add_get("/debug/traces/{trace_id}", self.handle_debug_trace)
         return app
 
     async def start(self) -> "HttpService":
@@ -209,6 +225,41 @@ class HttpService:
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response(model_list(self.manager.list_names()))
 
+    # -- debug surface (span recorder views) -------------------------------
+
+    async def handle_debug_requests(self, request: web.Request) -> web.Response:
+        """Lifecycle ledger: one record per finished request, newest first.
+        Filters: ``?trace_id=...``, ``?model=...``, ``?limit=N``."""
+        rec = tracing.recorder()
+        if rec is None:
+            return web.json_response({"enabled": False, "requests": []})
+        try:
+            limit = max(1, min(int(request.query.get("limit", "100")), 1000))
+        except ValueError:
+            return web.json_response({"error": "limit must be an integer"}, status=400)
+        model = request.query.get("model")
+        # Filter before truncating: a model whose records are older than the
+        # newest `limit` entries must still be findable.
+        records = rec.ledger(
+            request.query.get("trace_id"),
+            limit=rec.ledger_capacity if model else limit,
+        )
+        if model:
+            records = [r for r in records if r.get("model") == model][:limit]
+        return web.json_response({"enabled": True, "requests": records})
+
+    async def handle_debug_trace(self, request: web.Request) -> web.Response:
+        """One trace as Chrome-trace JSON (load in Perfetto/chrome://tracing,
+        or render with tools/trace_report.py)."""
+        rec = tracing.recorder()
+        if rec is None:
+            return web.json_response({"error": "tracing disabled"}, status=404)
+        trace_id = request.match_info["trace_id"]
+        spans = rec.spans(trace_id)
+        if not spans:
+            return web.json_response({"error": f"unknown trace {trace_id}"}, status=404)
+        return web.json_response(tracing.chrome_trace(trace_id, spans))
+
     # -- inference surface -------------------------------------------------
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
@@ -249,21 +300,99 @@ class HttpService:
         return {"Retry-After": str(max(1, math.ceil(secs)))}
 
     async def _handle_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
+        """Tracing shell around the real handler: opens the root span (from
+        the inbound ``traceparent`` when present, else a fresh trace), and
+        emits the lifecycle ledger record on every exit path."""
+        endpoint = self._ENDPOINT_LABEL[kind]
+        inbound = None
+        tp = request.headers.get("traceparent")
+        if tp:
+            inbound = TraceContext.parse(tp, request.headers.get("tracestate"))
+        root = tracing.start_span(
+            "http.request", parent=inbound or current_trace(), endpoint=endpoint
+        )
+        # Mutable scratch the inner handler + stream helpers fill in:
+        # model/status always; ttft_s/itl_s/tokens when generation ran.
+        info: dict = {"model": "unknown", "status": None}
+        t0 = time.perf_counter()
+        try:
+            resp = await self._handle_inference_inner(
+                request, kind, root, inbound, info, t0
+            )
+            if info["status"] is None:
+                info["status"] = str(resp.status)
+            return resp
+        except asyncio.CancelledError:
+            info["status"] = "499"  # client went away mid-handling
+            raise
+        finally:
+            self._emit_ledger(root, endpoint, info, time.perf_counter() - t0)
+
+    def _emit_ledger(self, root, endpoint: str, info: dict, duration_s: float) -> None:
+        if not root.recording:
+            return
+        status = info.get("status") or "500"
+        root.set_attrs(model=info.get("model"), status=status)
+        root.end(status="ok" if status.startswith("2") else f"http:{status}")
+        rec = tracing.recorder()
+        if rec is None:
+            return
+        record = tracing.build_ledger(
+            root.trace_id,
+            # Scope to THIS request's span subtree: one client trace id may
+            # carry several requests, which must not sum into each other.
+            root_span_id=root.span_id,
+            request_id=info.get("request_id", ""),
+            model=info.get("model", "unknown"),
+            endpoint=endpoint,
+            status=status,
+            duration_s=duration_s,
+            prompt_tokens=info.get("prompt_tokens", 0),
+            completion_tokens=info.get("completion_tokens", 0),
+            ttft_s=info.get("ttft_s"),
+            itl_s=info.get("itl_s"),
+        )
+        rec.record_ledger(record)
+        ledger_log.info(
+            "request %s %s %s in %.3fs", record["request_id"] or record["trace_id"],
+            record["model"], record["status"], record["duration_s"],
+            extra={"event": "request_ledger", **record},
+        )
+
+    async def _handle_inference_inner(
+        self, request: web.Request, kind: str, root, inbound, info: dict, t0: float
+    ) -> web.StreamResponse:
         endpoint = self._ENDPOINT_LABEL[kind]
         model = "unknown"
-        t0 = time.perf_counter()
+        adm_span = tracing.start_span(
+            "http.admission",
+            parent=root.trace_context() if root.recording else None,
+        )
+        t_adm = time.perf_counter()
         try:
             await self.admission.acquire()
         except AdmissionRejected as e:
             # Shed, don't queue: 503 while draining (instance going away),
             # 429 under overload — both tell the client when to come back.
+            adm_span.end(status="shed")
             status = 503 if e.draining else 429
+            info["status"] = str(status)
             self.m_shed.inc(endpoint=endpoint, status=str(status))
             self.m_requests.inc(model=model, endpoint=endpoint, status=str(status))
             err = OpenAIError(str(e), status=status, err_type="overloaded_error")
             return web.json_response(
                 err.body(), status=status, headers=self._retry_after(e.retry_after)
             )
+        except BaseException:
+            # Client gave up while queued: the LONGEST waits are exactly the
+            # ones that must not vanish from the wait histogram/span record.
+            adm_span.end(status="cancelled")
+            raise
+        else:
+            adm_span.end()
+        finally:
+            self.m_admission_wait.observe(time.perf_counter() - t_adm)
+            self.m_queue_depth.set(self.admission.queued)
         try:
             try:
                 body = await request.json()
@@ -271,36 +400,49 @@ class HttpService:
                 raise OpenAIError("request body must be valid JSON") from None
             req = self._PARSERS[kind](body)
             model = req.model
+            info["model"] = model
             pipe = self.manager.get(req.model)
             if pipe is None:
                 raise OpenAIError(f"model {req.model!r} not found", status=404, err_type="not_found_error")
 
-            ctx = Context.with_timeout(self._parse_timeout(request, body), trace=current_trace())
+            # Downstream hops parent on the root span, so worker-side spans
+            # and log lines share the inbound trace id end to end.
+            ctx_trace = (
+                root.trace_context() if root.recording
+                else (inbound or current_trace())
+            )
+            ctx = Context.with_timeout(self._parse_timeout(request, body), trace=ctx_trace)
+            info["request_id"] = ctx.id
             with InflightGuard(self.m_inflight, model=model):
                 try:
                     if kind == "responses":
                         if req.stream:
-                            return await self._responses_stream(request, pipe, req, ctx, model, t0)
-                        return await self._responses_aggregate(pipe, req, ctx, model, t0)
+                            return await self._responses_stream(request, pipe, req, ctx, model, t0, info)
+                        return await self._responses_aggregate(pipe, req, ctx, model, t0, info)
                     if req.stream:
-                        return await self._stream(request, pipe, req, ctx, model, endpoint, t0)
-                    return await self._aggregate(pipe, req, ctx, model, endpoint, t0)
+                        return await self._stream(request, pipe, req, ctx, model, endpoint, t0, info)
+                    return await self._aggregate(pipe, req, ctx, model, endpoint, t0, info)
                 finally:
                     ctx.cancel()  # no-op if finished; frees worker if abandoned
                     self.m_duration.observe(time.perf_counter() - t0, model=model)
         except OpenAIError as e:
+            info["status"] = str(e.status)
             self.m_requests.inc(model=model, endpoint=endpoint, status=str(e.status))
             return web.json_response(e.body(), status=e.status)
         except DeadlineExceededError:
+            info["status"] = "504"
+            self.m_deadline.inc(scope="http")
             self.m_requests.inc(model=model, endpoint=endpoint, status="504")
             err = OpenAIError("request exceeded its deadline", status=504, err_type="timeout_error")
             return web.json_response(err.body(), status=504)
         except OverloadedError:
             # Every routing attempt was refused at a worker admission gate.
+            info["status"] = "503"
             self.m_requests.inc(model=model, endpoint=endpoint, status="503")
             err = OpenAIError("all workers at capacity", status=503, err_type="overloaded_error")
             return web.json_response(err.body(), status=503, headers=self._retry_after())
         except NoInstancesError:
+            info["status"] = "503"
             self.m_requests.inc(model=model, endpoint=endpoint, status="503")
             err = OpenAIError("no workers available for this model", status=503, err_type="overloaded_error")
             return web.json_response(err.body(), status=503, headers=self._retry_after())
@@ -308,14 +450,17 @@ class HttpService:
             raise
         except Exception:  # noqa: BLE001 — HTTP boundary
             log.exception("inference request failed")
+            info["status"] = "500"
             self.m_requests.inc(model=model, endpoint=endpoint, status="500")
             err = OpenAIError("internal error", status=500, err_type="internal_error")
             return web.json_response(err.body(), status=500)
         finally:
             self.admission.release()
+            self.m_queue_depth.set(self.admission.queued)
 
     async def _stream(
-        self, request: web.Request, pipe, req, ctx: Context, model: str, endpoint: str, t0: float
+        self, request: web.Request, pipe, req, ctx: Context, model: str, endpoint: str,
+        t0: float, info: dict,
     ) -> web.StreamResponse:
         # Pull the FIRST pipeline item before opening the SSE stream: lazy
         # preprocessing (template render, context-length validation) raises
@@ -348,7 +493,8 @@ class HttpService:
                     if first:
                         first = False
                         t_first_tok = t_last_tok
-                        self.m_ttft.observe(time.perf_counter() - t0, model=model)
+                        info["ttft_s"] = time.perf_counter() - t0
+                        self.m_ttft.observe(info["ttft_s"], model=model)
                     try:
                         await resp.write(sse_event(json.dumps(chunk)))
                     except (ConnectionResetError, ConnectionError):
@@ -362,29 +508,41 @@ class HttpService:
                 except StopAsyncIteration:
                     head = None
         except asyncio.CancelledError:
+            # Client-disconnect cancellation: still close the operator chain
+            # now so span finallys run before the 499 ledger is built.
+            with contextlib.suppress(Exception):
+                await stream.aclose()
             raise
         except Exception as e:  # noqa: BLE001 — mid-stream: SSE error, not a 2nd response
             failed = True
             if not isinstance(e, (OpenAIError, DeadlineExceededError)):
                 log.exception("stream failed mid-flight (%s)", ctx.id)
+            if isinstance(e, DeadlineExceededError):
+                self.m_deadline.inc(scope="http")
             err = self._stream_error(e)
+            info["status"] = str(err.status)
             self.m_requests.inc(model=model, endpoint=endpoint, status=str(err.status))
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 await resp.write(sse_event(json.dumps(err.body())))
                 await resp.write(SSE_DONE)
                 await resp.write_eof()
+        with contextlib.suppress(Exception):
+            await stream.aclose()  # deterministic span/wire cleanup
         if last_gen is not None:
+            info["prompt_tokens"] = last_gen.prompt_tokens
+            info["completion_tokens"] = last_gen.completion_tokens
             self.m_output_tokens.inc(last_gen.completion_tokens, model=model)
             if last_gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
-                self.m_itl.observe(
-                    (t_last_tok - t_first_tok) / (last_gen.completion_tokens - 1),
-                    model=model,
-                )
+                info["itl_s"] = (t_last_tok - t_first_tok) / (last_gen.completion_tokens - 1)
+                self.m_itl.observe(info["itl_s"], model=model)
         if not ctx.cancelled and not failed:
+            info["status"] = "200"
             self.m_requests.inc(model=model, endpoint=endpoint, status="200")
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 await resp.write(SSE_DONE)
                 await resp.write_eof()
+        elif ctx.cancelled and not failed:
+            info["status"] = "499"  # client disconnected mid-stream
         return resp
 
     @staticmethod
@@ -414,7 +572,8 @@ class HttpService:
         return "completed", None
 
     async def _responses_aggregate(
-        self, pipe, req: ResponsesRequest, ctx: Context, model: str, t0: float
+        self, pipe, req: ResponsesRequest, ctx: Context, model: str, t0: float,
+        info: dict,
     ) -> web.Response:
         gen = None
         first = True
@@ -425,13 +584,15 @@ class HttpService:
             if first:
                 first = False
                 t_first_tok = t_last_tok
-                self.m_ttft.observe(time.perf_counter() - t0, model=model)
+                info["ttft_s"] = time.perf_counter() - t0
+                self.m_ttft.observe(info["ttft_s"], model=model)
         assert gen is not None
+        info["prompt_tokens"] = gen.prompt_tokens
+        info["completion_tokens"] = gen.completion_tokens
         self.m_output_tokens.inc(gen.completion_tokens, model=model)
         if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
-            self.m_itl.observe(
-                (t_last_tok - t_first_tok) / (gen.completion_tokens - 1), model=model
-            )
+            info["itl_s"] = (t_last_tok - t_first_tok) / (gen.completion_tokens - 1)
+            self.m_itl.observe(info["itl_s"], model=model)
         status, why = self._responses_status(gen.finish_reason)
         body = responses_body(
             gen_request_id("resp"), model, gen.created, status=status,
@@ -444,7 +605,7 @@ class HttpService:
 
     async def _responses_stream(
         self, request: web.Request, pipe, req: ResponsesRequest, ctx: Context,
-        model: str, t0: float
+        model: str, t0: float, info: dict,
     ) -> web.StreamResponse:
         """Typed Responses event stream: created → in_progress →
         output_item.added → content_part.added → output_text.delta* →
@@ -508,7 +669,8 @@ class HttpService:
                         if first:
                             first = False
                             t_first_tok = t_last_tok
-                            self.m_ttft.observe(time.perf_counter() - t0, model=model)
+                            info["ttft_s"] = time.perf_counter() - t0
+                            self.m_ttft.observe(info["ttft_s"], model=model)
                         ok = await emit("response.output_text.delta", {
                             "item_id": item_id, "output_index": 0,
                             "content_index": 0, "delta": delta,
@@ -518,12 +680,17 @@ class HttpService:
                 except StopAsyncIteration:
                     head = None
         except asyncio.CancelledError:
+            with contextlib.suppress(Exception):
+                await stream.aclose()
             raise
         except Exception as e:  # noqa: BLE001 — mid-stream failure → error event
             failed = True
             if not isinstance(e, (OpenAIError, DeadlineExceededError)):
                 log.exception("responses stream failed mid-flight (%s)", ctx.id)
+            if isinstance(e, DeadlineExceededError):
+                self.m_deadline.inc(scope="http")
             err = self._stream_error(e)
+            info["status"] = str(err.status)
             self.m_requests.inc(model=model, endpoint="responses", status=str(err.status))
             with contextlib.suppress(ConnectionResetError, ConnectionError):
                 # Responses typed-event error shape (emit injects
@@ -531,13 +698,15 @@ class HttpService:
                 await emit("error", {"code": err.err_type, "message": str(err),
                                      "param": None})
                 await resp.write_eof()
+        with contextlib.suppress(Exception):
+            await stream.aclose()  # deterministic span/wire cleanup
         if gen is not None:
+            info["prompt_tokens"] = gen.prompt_tokens
+            info["completion_tokens"] = gen.completion_tokens
             self.m_output_tokens.inc(gen.completion_tokens, model=model)
             if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
-                self.m_itl.observe(
-                    (t_last_tok - t_first_tok) / (gen.completion_tokens - 1),
-                    model=model,
-                )
+                info["itl_s"] = (t_last_tok - t_first_tok) / (gen.completion_tokens - 1)
+                self.m_itl.observe(info["itl_s"], model=model)
         if ok and not disconnected and not failed and gen is not None:
             text = "".join(gen.text_parts)
             status, why = self._responses_status(gen.finish_reason)
@@ -562,13 +731,17 @@ class HttpService:
             event = "response.completed" if status == "completed" else "response.incomplete"
             ok = ok and await emit(event, {"response": final})
             if ok and not disconnected:
+                info["status"] = "200"
                 self.m_requests.inc(model=model, endpoint="responses", status="200")
                 with contextlib.suppress(ConnectionResetError, ConnectionError):
                     await resp.write_eof()
+        if disconnected:
+            info["status"] = "499"
         return resp
 
     async def _aggregate(
-        self, pipe, req, ctx: Context, model: str, endpoint: str, t0: float
+        self, pipe, req, ctx: Context, model: str, endpoint: str, t0: float,
+        info: dict,
     ) -> web.Response:
         gen = None
         first = True
@@ -579,12 +752,15 @@ class HttpService:
             if first:
                 first = False
                 t_first_tok = t_last_tok
-                self.m_ttft.observe(time.perf_counter() - t0, model=model)
+                info["ttft_s"] = time.perf_counter() - t0
+                self.m_ttft.observe(info["ttft_s"], model=model)
         assert gen is not None
+        info["prompt_tokens"] = gen.prompt_tokens
+        info["completion_tokens"] = gen.completion_tokens
         self.m_output_tokens.inc(gen.completion_tokens, model=model)
         if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
-            self.m_itl.observe(
-                (t_last_tok - t_first_tok) / (gen.completion_tokens - 1), model=model
-            )
+            info["itl_s"] = (t_last_tok - t_first_tok) / (gen.completion_tokens - 1)
+            self.m_itl.observe(info["itl_s"], model=model)
+        info["status"] = "200"
         self.m_requests.inc(model=model, endpoint=endpoint, status="200")
         return web.json_response(gen.final_response())

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, Operator
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.messaging import TruncatedStreamError
@@ -32,17 +33,22 @@ class Migration(Operator):
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         if not isinstance(request, dict):
-            async for item in self.inner.generate(request, context.child()):
-                yield item
-            return
+            stream = self.inner.generate(request, context.child())
+            try:
+                async for item in stream:
+                    yield item
+                return
+            finally:
+                await stream.aclose()
 
         request = dict(request)
         migrations = 0
         emitted: list[int] = []
         finished = False
         while True:
+            stream = self.inner.generate(request, context.child())
             try:
-                async for raw in self.inner.generate(request, context.child()):
+                async for raw in stream:
                     if isinstance(raw, dict) and raw.get("token_ids"):
                         emitted.extend(raw["token_ids"])
                     if isinstance(raw, dict) and raw.get("finish_reason"):
@@ -64,6 +70,13 @@ class Migration(Operator):
                 # deadline error beats a truncation error for the client).
                 context.check_deadline()
                 migrations += 1
+                # Marker span: the ledger counts these; attrs carry the
+                # re-dispatch arithmetic for the flame timeline.
+                tracing.start_span_if(
+                    context.trace, "migration.redispatch",
+                    migration=migrations, limit=self.migration_limit,
+                    carried_tokens=len(emitted),
+                ).end()
                 log.warning(
                     "stream died mid-flight for %s; migrating (%d/%d, %d tokens carried)",
                     context.id, migrations, self.migration_limit, len(emitted),
@@ -91,3 +104,5 @@ class Migration(Operator):
                     request["sampling"] = sampling
                 emitted = []
                 continue
+            finally:
+                await stream.aclose()

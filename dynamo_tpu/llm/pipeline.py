@@ -18,6 +18,7 @@ from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
 from dynamo_tpu.llm.backend import Backend
 from dynamo_tpu.llm.migration import Migration
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
 from dynamo_tpu.llm.protocols import (
@@ -196,10 +197,14 @@ class ModelPipeline:
         payload dict, or None for pure bookkeeping deltas. The caller owns
         transport concerns (SSE vs aggregate)."""
         kind = "chat" if isinstance(req, ChatCompletionRequest) else "completion"
-        if kind == "chat":
-            pre = self.preprocessor.preprocess_chat(req)
-        else:
-            pre = self.preprocessor.preprocess_completion(req)
+        with tracing.start_span(
+            "http.preprocess", parent=context.trace, model=self.card.name, kind=kind
+        ) as pspan:
+            if kind == "chat":
+                pre = self.preprocessor.preprocess_chat(req)
+            else:
+                pre = self.preprocessor.preprocess_completion(req)
+            pspan.set_attr("prompt_tokens", len(pre.token_ids))
         gen = DeltaGenerator(
             self.card.name, kind=kind, prompt_tokens=len(pre.token_ids),
             want_logprobs=pre.sampling.logprobs,
@@ -215,17 +220,23 @@ class ModelPipeline:
             },
         )
         assert self.backend is not None, "pipeline not started"
-        async for raw in self.backend.generate(pre.to_dict(), context):
-            out = LLMEngineOutput.from_dict(raw)
-            if out.finish_reason == FinishReason.ERROR:
-                raise RuntimeError(out.error or "engine error")
-            finish = out.finish_reason.value if out.finish_reason else None
-            chunks = gen.on_delta(out.text, len(out.token_ids), finish,
-                                  token_ids=out.token_ids, logprobs=out.log_probs,
-                                  top_logprobs=out.top_log_probs)
-            if not chunks:
-                yield gen, None
-            for c in chunks:
-                yield gen, c
-            if finish is not None:
-                return
+        stream = self.backend.generate(pre.to_dict(), context)
+        try:
+            async for raw in stream:
+                out = LLMEngineOutput.from_dict(raw)
+                if out.finish_reason == FinishReason.ERROR:
+                    raise RuntimeError(out.error or "engine error")
+                finish = out.finish_reason.value if out.finish_reason else None
+                chunks = gen.on_delta(out.text, len(out.token_ids), finish,
+                                      token_ids=out.token_ids, logprobs=out.log_probs,
+                                      top_logprobs=out.top_log_probs)
+                if not chunks:
+                    yield gen, None
+                for c in chunks:
+                    yield gen, c
+                if finish is not None:
+                    return
+        finally:
+            # Close the operator chain deterministically (span ends, wire
+            # cancel) rather than at async-generator GC.
+            await stream.aclose()

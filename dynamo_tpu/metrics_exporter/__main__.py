@@ -32,12 +32,17 @@ class MetricsExporter:
 
     def __init__(self, runtime, namespace: str, component: str,
                  registry: MetricsRegistry | None = None,
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0, scrape_timeout_s: float = 3.0):
         self.runtime = runtime
         self.namespace = namespace
         self.component = component
         self.registry = registry or runtime.metrics
         self.interval_s = interval_s
+        # Per-worker scrape budget: workers are scraped concurrently and a
+        # hung one costs at most this, not the whole poll loop (satellite
+        # fix — sequential scraping let one dead worker stall the loop
+        # past interval_s × fleet size).
+        self.scrape_timeout_s = scrape_timeout_s
         self.g_active = self.registry.gauge("fleet_worker_active_slots", "Active request slots")
         self.g_total = self.registry.gauge("fleet_worker_total_slots", "Total request slots")
         self.g_waiting = self.registry.gauge("fleet_worker_waiting", "Queued requests")
@@ -67,8 +72,27 @@ class MetricsExporter:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
 
+    async def _scrape_one(self, inst) -> "ForwardPassMetrics | None":
+        """One worker's load metrics, bounded by scrape_timeout_s (the
+        deadline travels to the worker, so a hung one is abandoned there
+        too, not just here)."""
+        wid = f"{inst.instance_id:x}"
+        try:
+            snap = None
+            ctx = Context.with_timeout(self.scrape_timeout_s)
+            async for item in self._router.generate(
+                {}, ctx, instance_id=inst.instance_id
+            ):
+                snap = item
+            if snap is None:
+                return None
+            return ForwardPassMetrics.from_dict(snap)
+        except Exception as e:  # noqa: BLE001 — a dead worker must not kill the loop
+            log.warning("scrape of worker %s failed: %s", wid, e)
+            return None
+
     async def poll_once(self) -> int:
-        """Scrape every live worker once. → number scraped."""
+        """Scrape every live worker once, concurrently. → number scraped."""
         instances = list(self._router.discovery.available())
         self.g_workers.set(len(instances), component=self.component)
         live_ids = {f"{i.instance_id:x}" for i in instances}
@@ -78,22 +102,20 @@ class MetricsExporter:
                       self.g_kv_total, self.g_usage, self.g_hit):
                 g.remove(**lbl)
         self._seen = live_ids
+        # wait_for backstops the context deadline (covers a scrape stuck
+        # before the deadline is even consulted, e.g. in connect).
+        snaps = await asyncio.gather(*(
+            asyncio.wait_for(self._scrape_one(inst), self.scrape_timeout_s + 1.0)
+            for inst in instances
+        ), return_exceptions=True)
         n = 0
-        for inst in instances:
-            wid = f"{inst.instance_id:x}"
-            try:
-                snap = None
-                async for item in self._router.generate(
-                    {}, Context(), instance_id=inst.instance_id
-                ):
-                    snap = item
-                if snap is None:
-                    continue
-                m = ForwardPassMetrics.from_dict(snap)
-            except Exception as e:  # noqa: BLE001 — a dead worker must not kill the loop
-                log.warning("scrape of worker %s failed: %s", wid, e)
+        for inst, m in zip(instances, snaps):
+            if isinstance(m, BaseException):
+                log.warning("scrape of worker %x timed out", inst.instance_id)
                 continue
-            lbl = {"component": self.component, "worker": wid}
+            if m is None:
+                continue
+            lbl = {"component": self.component, "worker": f"{inst.instance_id:x}"}
             self.g_active.set(m.worker.request_active_slots, **lbl)
             self.g_total.set(m.worker.request_total_slots, **lbl)
             self.g_waiting.set(m.worker.num_requests_waiting, **lbl)
@@ -122,6 +144,8 @@ def parse_args(argv=None):
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9091)
     p.add_argument("--interval", type=float, default=5.0)
+    p.add_argument("--scrape-timeout", type=float, default=3.0,
+                   help="per-worker scrape budget (workers are scraped concurrently)")
     return p.parse_args(argv)
 
 
@@ -130,7 +154,8 @@ async def async_main(args) -> None:
 
     rt = await DistributedRuntime.create(store_url=args.store_url)
     exporter = await MetricsExporter(
-        rt, args.namespace, args.component, interval_s=args.interval
+        rt, args.namespace, args.component, interval_s=args.interval,
+        scrape_timeout_s=args.scrape_timeout,
     ).start()
 
     async def handle_metrics(request):

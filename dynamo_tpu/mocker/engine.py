@@ -22,6 +22,7 @@ from typing import Any, AsyncIterator
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.chaos import ChaosInjector
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
@@ -87,9 +88,15 @@ class MockerEngine:
             return
         self._waiting += 1
         acquired = False
+        # Worker engine phase spans parent on the hop's wire.serve span
+        # (messaging re-anchored context.trace on it).
+        qspan = tracing.start_span_if(
+            context.trace, "engine.queue", waiting=self._waiting
+        )
         try:
             await self._slots.acquire()
             acquired = True
+            qspan.end()
             self._waiting -= 1
             self._active += 1
             try:
@@ -98,6 +105,7 @@ class MockerEngine:
             finally:
                 self._active -= 1
         finally:
+            qspan.end(status="abandoned")  # no-op once the slot was acquired
             if acquired:
                 self._slots.release()
             else:
@@ -119,6 +127,8 @@ class MockerEngine:
             ).to_dict()
             return
         block_seq = TokenBlockSequence(prompt, bs)
+        dspan = tracing.NOOP_SPAN
+        emitted = 0
         try:
             # Simulated prefill: cached prefix blocks are free; concurrent
             # occupancy inflates it (contending prefills share the chip).
@@ -127,13 +137,17 @@ class MockerEngine:
             ttft = (a.ttft_ms + a.prefill_ms_per_token * uncached) * (
                 1.0 + a.prefill_contention * slot_frac
             )
-            await asyncio.sleep(a.scaled(ttft))
-            for i, blk in enumerate(block_seq.blocks):
-                self.pool.register_block(block_ids[i], blk.sequence_hash, blk.parent_sequence_hash)
+            with tracing.start_span_if(
+                context.trace, "engine.prefill",
+                prompt_tokens=plen, uncached_tokens=uncached, cached_blocks=n_hit,
+            ):
+                await asyncio.sleep(a.scaled(ttft))
+                for i, blk in enumerate(block_seq.blocks):
+                    self.pool.register_block(block_ids[i], blk.sequence_hash, blk.parent_sequence_hash)
+            dspan = tracing.start_span_if(context.trace, "engine.decode")
 
             max_tokens = req.stop.max_tokens or 64
             eos = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
-            emitted = 0
             burst: list[int] = []
             while emitted < max_tokens:
                 if emitted:
@@ -185,4 +199,6 @@ class MockerEngine:
                 if finish is not None:
                     return
         finally:
+            dspan.set_attrs(tokens=emitted)
+            dspan.end(status="cancelled" if context.cancelled else None)
             self.pool.free_sequence(block_ids)

@@ -69,6 +69,19 @@ class ChaosInjector:
         self.config = cfg
         self.rng = random.Random(cfg.seed)
         self.stats = ChaosStats()
+        self._m_injections = None
+
+    def bind_metrics(self, registry) -> "ChaosInjector":
+        """Expose injected-fault counts as ``chaos_injections_total{kind}``
+        on ``registry`` (the process's /metrics)."""
+        self._m_injections = registry.counter(
+            "chaos_injections_total", "Injected faults by kind"
+        )
+        return self
+
+    def _count(self, kind: str) -> None:
+        if self._m_injections is not None:
+            self._m_injections.inc(kind=kind)
 
     @classmethod
     def from_config(cls, cfg: ChaosConfig) -> "ChaosInjector | None":
@@ -81,6 +94,7 @@ class ChaosInjector:
         instead of sending this frame."""
         if self.config.frame_drop_p > 0 and self.rng.random() < self.config.frame_drop_p:
             self.stats.frames_dropped += 1
+            self._count("frame_drop")
             return True
         return False
 
@@ -89,6 +103,7 @@ class ChaosInjector:
         cut the connection instead of completing the stream."""
         if self.config.truncate_p > 0 and self.rng.random() < self.config.truncate_p:
             self.stats.streams_truncated += 1
+            self._count("truncate")
             return True
         return False
 
@@ -97,10 +112,12 @@ class ChaosInjector:
         :class:`ChaosKillError` to simulate the worker dying mid-request."""
         if self.config.kill_p > 0 and self.rng.random() < self.config.kill_p:
             self.stats.kills += 1
+            self._count("kill")
             raise ChaosKillError("injected worker death")
 
     async def inject_latency(self) -> None:
         """Sleep a seeded uniform delay in [0, latency_ms]."""
         if self.config.latency_ms > 0:
             self.stats.latency_injections += 1
+            self._count("latency")
             await asyncio.sleep(self.rng.uniform(0, self.config.latency_ms) / 1000.0)

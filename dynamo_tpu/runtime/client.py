@@ -44,11 +44,22 @@ class DiscoveryClient:
         component: str,
         endpoint: str,
         circuit_cooldown: float = 5.0,
+        metrics=None,
     ):
         self.namespace = namespace
         self.component = component
         self.endpoint = endpoint
         self.circuit_cooldown = circuit_cooldown
+        # Optional MetricsRegistry: per-instance breaker state as a gauge
+        # (0 closed / 1 open / 2 half-open), labeled by subject + instance.
+        self._m_breaker = (
+            metrics.gauge(
+                "circuit_breaker_state",
+                "Per-instance circuit breaker (0=closed, 1=open, 2=half-open)",
+            )
+            if metrics is not None
+            else None
+        )
         self._store = store
         self._prefix = instance_prefix(namespace, component, endpoint)
         self._instances: dict[str, Instance] = {}
@@ -77,11 +88,13 @@ class DiscoveryClient:
                     inst = Instance.from_bytes(ev.value)
                     self._instances[ev.key] = inst
                     # A re-registered instance id is alive again.
-                    self._breakers.pop(inst.instance_id, None)
+                    if self._breakers.pop(inst.instance_id, None) is not None:
+                        self._set_breaker_gauge(inst.instance_id, "closed")
                 else:
                     inst = self._instances.pop(ev.key, None)
                     if inst is not None:
                         self._breakers.pop(inst.instance_id, None)
+                        self._set_breaker_gauge(inst.instance_id, None)
                 self._notify_changed()
         except asyncio.CancelledError:
             pass
@@ -108,10 +121,25 @@ class DiscoveryClient:
             # the circuit, report_instance_down re-opens it (timer reset).
             if b.state != "half-open":
                 log.info("instance %x half-open: allowing probe", instance_id)
+                self._set_breaker_gauge(instance_id, "half-open")
             b.state = "half-open"
             b.since = now
             return True
         return b.state == "half-open"
+
+    _BREAKER_LEVELS = {"closed": 0.0, "open": 1.0, "half-open": 2.0}
+
+    def _set_breaker_gauge(self, instance_id: int, state: str | None) -> None:
+        if self._m_breaker is None:
+            return
+        labels = {
+            "subject": f"{self.namespace}/{self.component}/{self.endpoint}",
+            "instance": f"{instance_id:x}",
+        }
+        if state is None:  # instance gone: drop the series, not freeze it
+            self._m_breaker.remove(**labels)
+        else:
+            self._m_breaker.set(self._BREAKER_LEVELS[state], **labels)
 
     def breaker_state(self, instance_id: int) -> str:
         """"closed" | "open" | "half-open" (observability/tests)."""
@@ -134,12 +162,14 @@ class DiscoveryClient:
         probe succeeds, or — failing all that — probed again every
         ``circuit_cooldown`` seconds."""
         self._breakers[instance_id] = _Breaker("open", time.monotonic())
+        self._set_breaker_gauge(instance_id, "open")
         self._notify_changed()
 
     def report_instance_up(self, instance_id: int) -> None:
         """A request to this instance succeeded — close its circuit."""
         if self._breakers.pop(instance_id, None) is not None:
             log.info("instance %x back up: circuit closed", instance_id)
+            self._set_breaker_gauge(instance_id, "closed")
             self._notify_changed()
 
     def _notify_changed(self) -> None:

@@ -19,6 +19,7 @@ from dynamo_tpu.runtime.component import (
     instance_key,
     validate_name,
 )
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.config import Config
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.logging import get_logger, init_logging
@@ -125,6 +126,7 @@ class Endpoint:
             mode,
             backoff_base=rcfg.retry_backoff_base,
             backoff_max=rcfg.retry_backoff_max,
+            metrics=rt.metrics,
         )
 
 
@@ -155,6 +157,10 @@ class DistributedRuntime:
         self.store = store
         self.config = config
         self.metrics = MetricsRegistry()
+        # Span durations land in this registry as phase histograms (the
+        # recorder is process-global; the sink is removed on shutdown so
+        # short-lived runtimes don't accumulate).
+        self._tracing_sink = tracing.install_metrics_sink(self.metrics)
         self.health = SystemHealth()
         self.messaging = MessageClient(config.store.connect_timeout)
         self._advertise_host = advertise_host
@@ -213,10 +219,14 @@ class DistributedRuntime:
         if self._server is None:
             from dynamo_tpu.runtime.chaos import ChaosInjector
 
+            chaos = ChaosInjector.from_config(self.config.chaos)
+            if chaos is not None:
+                chaos.bind_metrics(self.metrics)
             self._server = await EndpointServer(
                 advertise_host=self._advertise_host,
                 max_inflight=self.config.runtime.max_inflight,
-                chaos=ChaosInjector.from_config(self.config.chaos),
+                chaos=chaos,
+                metrics=self.metrics,
             ).start()
         return self._server
 
@@ -249,6 +259,7 @@ class DistributedRuntime:
             client = DiscoveryClient(
                 self.store, ns, comp, ep,
                 circuit_cooldown=self.config.runtime.circuit_cooldown,
+                metrics=self.metrics,
             )
             await client.start()
             self._discoveries[key] = client
@@ -272,4 +283,5 @@ class DistributedRuntime:
         await self.messaging.close()
         if self._server is not None:
             await self._server.close()
+        tracing.remove_metrics_sink(self._tracing_sink)
         self.health.live = False

@@ -90,11 +90,13 @@ class Context:
         await self._cancelled.wait()
 
     def child(self) -> "Context":
-        """Context to forward downstream: same id/cancellation/deadline,
-        child span."""
+        """Context to forward downstream: same id/cancellation/deadline and
+        the same trace context. Span ids are minted by the tracing layer at
+        actual span boundaries (wire hops, router attempts); re-minting one
+        here would orphan downstream spans from their parents."""
         ctx = Context(
             self.id,
-            self.trace.child() if self.trace else None,
+            self.trace,
             dict(self.metadata),
             deadline=self.deadline,
         )

@@ -48,14 +48,9 @@ class TraceContext:
     def new_root(cls) -> "TraceContext":
         return cls(trace_id=secrets.token_hex(16), parent_span_id=secrets.token_hex(8))
 
-    def child(self) -> "TraceContext":
-        """New span within the same trace (for forwarding downstream)."""
-        return TraceContext(
-            trace_id=self.trace_id,
-            parent_span_id=secrets.token_hex(8),
-            flags=self.flags,
-            tracestate=self.tracestate,
-        )
+    # NOTE: span ids within a trace are minted by runtime/tracing.py at
+    # actual span boundaries (Span.trace_context()); re-minting one here
+    # would reference a span id no span owns and orphan downstream spans.
 
     def traceparent(self) -> str:
         return f"00-{self.trace_id}-{self.parent_span_id}-{self.flags}"
@@ -78,6 +73,13 @@ def reset_current_trace(token: contextvars.Token) -> None:
     _current_trace.reset(token)
 
 
+# LogRecord's own attributes — everything else on a record arrived via
+# ``extra={...}`` and belongs in the JSON output as structured fields.
+_RESERVED_RECORD_ATTRS = frozenset(
+    vars(logging.makeLogRecord({}))
+) | {"message", "asctime", "taskName"}
+
+
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {
@@ -91,9 +93,15 @@ class JsonlFormatter(logging.Formatter):
         if trace is not None:
             out["trace_id"] = trace.trace_id
             out["span_id"] = trace.parent_span_id
+        # Structured extra={...} fields (ledger records, subsystem key/values)
+        # ride along instead of being dropped; core keys are never shadowed.
+        for key, value in record.__dict__.items():
+            if key in _RESERVED_RECORD_ATTRS or key.startswith("_") or key in out:
+                continue
+            out[key] = value
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
-        return json.dumps(out, ensure_ascii=False)
+        return json.dumps(out, ensure_ascii=False, default=repr)
 
 
 class TextFormatter(logging.Formatter):

@@ -26,7 +26,7 @@ import math
 import uuid
 from typing import Any, AsyncIterator, Awaitable, Callable
 
-from dynamo_tpu.runtime import framing
+from dynamo_tpu.runtime import framing, tracing
 from dynamo_tpu.runtime.chaos import ChaosInjector, ChaosKillError
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, DeadlineExceededError
 from dynamo_tpu.runtime.logging import (
@@ -73,6 +73,7 @@ class EndpointServer:
         advertise_host: str | None = None,
         max_inflight: int = 0,
         chaos: ChaosInjector | None = None,
+        metrics=None,
     ):
         self.host = host
         self.port = port
@@ -80,6 +81,16 @@ class EndpointServer:
         # Worker-side admission gate: per-subject in-flight bound (0 = off).
         self.max_inflight = max_inflight
         self.chaos = chaos
+        # Optional MetricsRegistry: serving-plane counters every worker
+        # process exposes on its system /metrics.
+        self.m_deadline = (
+            metrics.counter(
+                "deadline_expired_total",
+                "Requests that ran out of budget, by enforcement point",
+            )
+            if metrics is not None
+            else None
+        )
         self._handlers: dict[str, Handler] = {}
         self._server: asyncio.Server | None = None
         self._inflight: dict[str, int] = {}
@@ -230,43 +241,67 @@ class EndpointServer:
         self._inflight[subject] += 1
         self._idle[subject].clear()
         self._subject_ctxs.setdefault(subject, set()).add(ctx)
+        # Worker-side wire span: covers handler execution + frame writes.
+        # Re-anchoring ctx.trace on the span nests every downstream span
+        # (engine phases, further hops) and log line under this hop. No
+        # inbound traceparent ⇒ untraced infra call ⇒ no span.
+        span = tracing.start_span_if(ctx.trace, "wire.serve", subject=subject)
+        if span.recording:
+            ctx.trace = span.trace_context()
         token = set_current_trace(ctx.trace)
+        n_frames = 0
+        gen = handler(msg.get("payload"), ctx)
         try:
             ctx.check_deadline()  # expired in transit/queue: don't start work
-            async for item in handler(msg.get("payload"), ctx):
+            async for item in gen:
                 if ctx.cancelled:
                     break
                 ctx.check_deadline()
                 if self.chaos is not None:
                     await self.chaos.inject_latency()
                     if self.chaos.should_drop_frame():
+                        span.end(status="chaos:frame_drop")
                         abort()
                         return
                 await send({"t": "data", "id": rid, "payload": item})
+                n_frames += 1
             if self.chaos is not None and self.chaos.should_truncate():
+                span.end(status="chaos:truncate")
                 abort()
                 return
             await send({"t": "final", "id": rid})
         except asyncio.CancelledError:
+            span.end(status="cancelled")
             raise
         except (ConnectionResetError, BrokenPipeError):
-            pass
+            span.end(status="error:connection_lost")
         except ChaosKillError:
             # Injected worker death: drop the transport, no error frame —
             # on the wire this is exactly a crashed process.
+            span.end(status="chaos:kill")
             abort()
         except DeadlineExceededError as e:
+            span.end(status="deadline")
+            if self.m_deadline is not None:
+                self.m_deadline.inc(scope="worker")
             try:
                 await send({"t": "err", "id": rid, "error": str(e), "kind": "deadline"})
             except (ConnectionResetError, BrokenPipeError):
                 pass
         except Exception as e:  # noqa: BLE001 — protocol boundary
+            span.end(status=f"error:{type(e).__name__}")
             log.exception("handler error for %s", subject)
             try:
                 await send({"t": "err", "id": rid, "error": f"{type(e).__name__}: {e}"})
             except (ConnectionResetError, BrokenPipeError):
                 pass
         finally:
+            # Close an abandoned handler generator (cancel/chaos paths) so
+            # its finallys — engine spans, slot releases — run now.
+            with contextlib.suppress(Exception):
+                await gen.aclose()
+            span.set_attr("frames", n_frames)
+            span.end(status="cancelled" if ctx.cancelled else None)
             reset_current_trace(token)
             self._subject_ctxs.get(subject, set()).discard(ctx)
             self._inflight[subject] -= 1
@@ -360,14 +395,25 @@ class MessageClient:
         remaining = context.time_remaining()
         if remaining is not None:
             headers["timeout_s"] = remaining
-        if context.trace is not None:
-            headers["traceparent"] = context.trace.traceparent()
-            if context.trace.tracestate:
+        # Client-side wire span: send → final frame. Its span id is what
+        # travels in ``traceparent``, so the server's wire.serve span (and
+        # everything under it) parents on this hop exactly. Untraced calls
+        # (exporter scrapes, infra subscriptions) stay span-free so they
+        # never pollute the phase histograms.
+        span = tracing.start_span_if(
+            context.trace, "wire.call",
+            subject=subject, addr=f"{addr[0]}:{addr[1]}",
+        )
+        wire_trace = span.trace_context() if span.recording else context.trace
+        if wire_trace is not None:
+            headers["traceparent"] = wire_trace.traceparent()
+            if context.trace is not None and context.trace.tracestate:
                 headers["tracestate"] = context.trace.tracestate
         try:
             await conn.send({"t": "req", "id": rid, "subject": subject, "payload": payload, "headers": headers})
         except (ConnectionResetError, BrokenPipeError) as e:
             conn.streams.pop(rid, None)
+            span.end(status="error:send_failed")
             raise TruncatedStreamError(f"connection to {addr} lost on send") from e
 
         async def _gen() -> AsyncIterator[Any]:
@@ -387,14 +433,17 @@ class MessageClient:
                     )
                     if not done:  # deadline hit while waiting
                         getter.cancel()
+                        span.end(status="deadline")
                         raise DeadlineExceededError(
                             f"request {context.id} exceeded its deadline awaiting {addr}"
                         )
                     if cancel_waiter in done and getter not in done:
                         getter.cancel()
+                        span.end(status="cancelled")
                         return
                     msg = getter.result()
                     if msg is None:
+                        span.end(status="error:truncated")
                         raise TruncatedStreamError(f"stream from {addr} truncated")
                     t = msg["t"]
                     if t == "data":
@@ -405,6 +454,7 @@ class MessageClient:
                     elif t == "err":
                         finished = True
                         kind = msg.get("kind")
+                        span.end(status=f"error:{kind or 'remote'}")
                         if kind == "no_handler":
                             raise NoHandlerError(msg.get("error", subject))
                         if kind == "overloaded":
@@ -413,6 +463,7 @@ class MessageClient:
                             raise DeadlineExceededError(msg.get("error", subject))
                         raise StreamError(msg.get("error", "remote error"))
             finally:
+                span.end()
                 cancel_waiter.cancel()
                 conn.streams.pop(rid, None)
                 # Abandoned before the final frame (explicit cancel OR the

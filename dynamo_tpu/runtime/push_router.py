@@ -29,6 +29,7 @@ import random
 from enum import Enum
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.client import DiscoveryClient
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
@@ -63,6 +64,7 @@ class PushRouter:
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
         no_instances_wait: float = 1.0,
+        metrics=None,
     ):
         self.discovery = discovery
         self.messaging = messaging
@@ -74,6 +76,14 @@ class PushRouter:
         # instance set is empty (watch-driven, returns early on change).
         self.no_instances_wait = no_instances_wait
         self._rr_last = -1
+        self.m_retries = (
+            metrics.counter(
+                "router_retries_total",
+                "Routing attempts beyond the first, by endpoint subject",
+            )
+            if metrics is not None
+            else None
+        )
 
     def _pick(self, instance_id: int | None) -> Any:
         instances = self.discovery.available()
@@ -109,13 +119,16 @@ class PushRouter:
         delay = min(self.backoff_base * (2 ** (attempt - 2)), self.backoff_max)
         return delay * (0.5 + random.random())
 
-    async def _sleep_backoff(self, attempt: int, context: Context) -> None:
-        delay = self._backoff_delay(attempt)
+    async def _sleep_backoff_delay(self, delay: float, context: Context) -> None:
         remaining = context.time_remaining()
         if remaining is not None:
             delay = min(delay, max(remaining, 0.0))
         if delay > 0:
             await asyncio.sleep(delay)
+
+    def _breaker_state(self, instance_id: int) -> str:
+        breaker_state = getattr(self.discovery, "breaker_state", None)
+        return breaker_state(instance_id) if breaker_state is not None else "unknown"
 
     async def _wait_for_instances(self, context: Context) -> None:
         """Block (bounded) until the discovery set changes — rolling
@@ -147,9 +160,26 @@ class PushRouter:
         while attempts < self.max_attempts:
             attempts += 1
             context.check_deadline()
+            backoff = 0.0
             if attempts > 1:
-                await self._sleep_backoff(attempts, context)
+                if self.m_retries is not None:
+                    self.m_retries.inc(subject=(
+                        f"{self.discovery.namespace}/{self.discovery.component}"
+                        f"/{self.discovery.endpoint}"
+                    ))
+                backoff = self._backoff_delay(attempts)
+                await self._sleep_backoff_delay(backoff, context)
                 context.check_deadline()
+            # Per-attempt span: covers backoff already slept (as attr), the
+            # pick, the wire call, and — for the winning attempt — the whole
+            # response stream. Retry cause lands in ``status``. Only traced
+            # requests record spans: infra calls without a trace context
+            # (exporter scrapes, KV event subscriptions) must not feed the
+            # phase histograms.
+            span = tracing.start_span_if(
+                context.trace, "router.attempt",
+                attempt=attempts, backoff_s=round(backoff, 6),
+            )
             try:
                 inst = self._pick(instance_id)
             except NoInstancesError as e:
@@ -157,22 +187,34 @@ class PushRouter:
                 # the retry loop immediately; now it consumes an attempt
                 # waiting for the watch to repopulate.
                 last_err = e
+                span.end(status="error:no_instances")
                 if instance_id is not None:
                     raise
                 await self._wait_for_instances(context)
                 continue
             context.metadata["worker_instance_id"] = inst.instance_id
+            span.set_attrs(
+                instance=f"{inst.instance_id:x}",
+                breaker=self._breaker_state(inst.instance_id),
+            )
+            sub = context.child()
+            if span.recording:
+                sub.trace = span.trace_context()
             try:
                 stream = await self.messaging.call(
-                    inst.address, inst.subject, request, context.child()
+                    inst.address, inst.subject, request, sub
                 )
             except (TruncatedStreamError, ConnectionError, OSError) as e:
                 log.warning("instance %x unreachable: %s", inst.instance_id, e)
                 self.discovery.report_instance_down(inst.instance_id)
                 last_err = e
+                span.end(status="error:unreachable")
                 if instance_id is not None:
                     raise
                 continue
+            except BaseException:
+                span.end(status="error:dispatch")
+                raise
 
             first = True
             try:
@@ -183,11 +225,13 @@ class PushRouter:
                         # close its breaker (half-open probe success).
                         self.discovery.report_instance_up(inst.instance_id)
                     yield item
+                span.end()
                 return
             except NoHandlerError as e:
                 # Worker registered but not serving (draining) — mark + retry.
                 self.discovery.report_instance_down(inst.instance_id)
                 last_err = e
+                span.end(status="error:no_handler")
                 if instance_id is not None or not first:
                     raise
                 continue
@@ -196,13 +240,31 @@ class PushRouter:
                 # down-marking — back off and try another instance.
                 log.debug("instance %x at capacity", inst.instance_id)
                 last_err = e
+                span.end(status="error:overloaded")
                 if instance_id is not None or not first:
                     raise
                 continue
             except TruncatedStreamError:
                 self.discovery.report_instance_down(inst.instance_id)
+                span.end(status="error:truncated")
                 if first and instance_id is None:
                     last_err = TruncatedStreamError(f"instance {inst.instance_id:x} died pre-stream")
                     continue
                 raise  # mid-stream death: Migration's responsibility
+            except asyncio.CancelledError:
+                span.end(status="cancelled")
+                raise
+            except GeneratorExit:
+                # Consumer closed the stream: payload flowed ⇒ the attempt
+                # served its request (normal post-finish close).
+                span.end(status="ok" if not first else "abandoned")
+                raise
+            except BaseException as e:
+                # Mid-stream deadline/StreamError/etc: a failed request must
+                # not leave an "ok" route span in its flame.
+                span.end(status=f"error:{type(e).__name__}")
+                raise
+            finally:
+                span.end(status="ok" if not first else "abandoned")  # no-op if ended above
+                await stream.aclose()
         raise last_err or NoInstancesError("exhausted retries")

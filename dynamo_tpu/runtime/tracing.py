@@ -1,0 +1,513 @@
+"""Distributed span tracing: lightweight spans + bounded in-process recorder.
+
+Reference analogue: tracing spans with ``traceparent`` propagation
+(reference: lib/runtime/src/logging.rs:131-204) and the per-request timing
+the SLA planner and KV router depend on. The repo already parses and
+forwards W3C trace context (runtime/logging.py, messaging.py); this module
+adds the *spans* — named, timed, attributed intervals keyed off
+:class:`~dynamo_tpu.runtime.logging.TraceContext` — and three derived
+views:
+
+- a bounded :class:`SpanRecorder` ring buffer (per process) queryable by
+  trace id;
+- a per-request **lifecycle ledger** (one structured record per finished
+  request: phase durations, TTFT/ITL, tokens, retries, migrations,
+  outcome), built by the HTTP ingress from the recorder;
+- a Chrome-trace/Perfetto export so a slow request renders as a flame
+  timeline (``/debug/traces/{trace_id}``, tools/trace_report.py).
+
+Span recording is process-local: in-process fleets (tests, mocker runs,
+single-host deployments) see the full frontend→router→worker nesting;
+across real process boundaries each process records its own segment of
+the trace, stitched by the shared trace id (grep the JSONL logs, or pull
+each process's ``/debug/traces``).
+
+Cost model: spans are per-request/per-phase, never per-token. With the
+recorder disabled (``DYNTPU_TRACING=0``) ``start_span`` returns a shared
+no-op span after one attribute load — nothing allocates, nothing locks.
+Serving-path call sites additionally record spans only for requests that
+carry a trace context (the HTTP ingress always sets one): untraced
+infrastructure RPCs — exporter scrapes, KV event subscriptions — stay
+span-free so they never pollute the phase histograms.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable
+
+from dynamo_tpu.runtime.logging import TraceContext, current_trace
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "NOOP_SPAN",
+    "start_span",
+    "start_span_if",
+    "record_interval",
+    "recorder",
+    "enabled",
+    "set_recorder",
+    "build_ledger",
+    "chrome_trace",
+    "install_metrics_sink",
+    "remove_metrics_sink",
+    "PHASE_SPANS",
+]
+
+# Span-name → ledger phase key. The ledger sums durations of all spans
+# sharing a phase (a migrated request has several engine.prefill spans).
+PHASE_SPANS = {
+    "http.admission": "admission_wait",
+    "http.preprocess": "preprocess",
+    "router.attempt": "route",
+    "wire.call": "wire",
+    "engine.queue": "queue_wait",
+    "engine.prefill": "prefill",
+    "engine.decode": "decode",
+}
+
+
+class Span:
+    """One timed interval in a trace. Not thread-safe per instance — a span
+    is owned by the coroutine/thread that started it; only ``end()`` crosses
+    into the (locked) recorder."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start_ts", "_t0",
+        "duration_s", "attrs", "events", "status", "_recorder", "_ended",
+        "flags", "tracestate",
+    )
+
+    recording = True
+
+    def __init__(
+        self,
+        recorder: "SpanRecorder",
+        name: str,
+        trace_id: str,
+        parent_id: str | None,
+        attrs: dict[str, Any],
+        flags: str = "01",
+        tracestate: str | None = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = secrets.token_hex(8)
+        self.parent_id = parent_id
+        # Inbound W3C sampled-flag and vendor tracestate ride through
+        # trace_context() so downstream hops see the client's values.
+        self.flags = flags
+        self.tracestate = tracestate
+        self.start_ts = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_s: float | None = None
+        self.attrs = attrs
+        self.events: list[tuple[str, float, dict]] = []
+        self.status = "ok"
+        self._recorder = recorder
+        self._ended = False
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def set_attrs(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        """Point-in-time marker within the span (offset seconds from start)."""
+        self.events.append((name, time.perf_counter() - self._t0, attrs))
+
+    def trace_context(self) -> TraceContext:
+        """This span as a TraceContext — set it as the current trace (or a
+        Context's ``trace``) and downstream spans/hops parent on this span."""
+        return TraceContext(
+            trace_id=self.trace_id, parent_span_id=self.span_id,
+            flags=self.flags, tracestate=self.tracestate,
+        )
+
+    def end(self, status: str | None = None, at: float | None = None) -> None:
+        """Idempotent; safe from ``finally`` on every exit path including
+        cancellation. Only the first call records. ``at`` is an optional
+        ``time.perf_counter()`` instant for intervals that ended in the past
+        (cross-thread stamps, see :func:`record_interval`)."""
+        if self._ended:
+            return
+        self._ended = True
+        if status is not None:
+            self.status = status
+        self.duration_s = (time.perf_counter() if at is None else at) - self._t0
+        self._recorder._record(self)
+
+    # Context-manager form for straight-line sections. (Multi-yield
+    # generator stages manage end() in their own finally instead.)
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> bool:
+        self.end(status=f"error:{exc_type.__name__}" if exc_type else None)
+        return False
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ts": self.start_ts,
+            "duration_s": self.duration_s,
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "events": [
+                {"name": n, "offset_s": off, **({"attrs": a} if a else {})}
+                for n, off, a in self.events
+            ],
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled-recorder fast path."""
+
+    __slots__ = ()
+    recording = False
+    name = ""
+    trace_id = ""
+    span_id = ""
+    parent_id = None
+    status = "ok"
+    duration_s = None
+
+    def set_attr(self, key, value) -> None:
+        pass
+
+    def set_attrs(self, **attrs) -> None:
+        pass
+
+    def add_event(self, name, **attrs) -> None:
+        pass
+
+    def trace_context(self) -> None:  # type: ignore[override]
+        return None
+
+    def end(self, status=None, at=None) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class SpanRecorder:
+    """Bounded ring buffer of *finished* spans + ledger records.
+
+    Thread-safe: the worker engine thread ends spans concurrently with the
+    event loop. Eviction is strict FIFO over span end order; the per-trace
+    index never outlives the ring (no unbounded growth under trace-id
+    cardinality)."""
+
+    def __init__(self, capacity: int = 4096, ledger_capacity: int = 1024):
+        self.capacity = capacity
+        self.ledger_capacity = ledger_capacity
+        self._spans: deque[Span] = deque()
+        self._by_trace: dict[str, list[Span]] = {}
+        self._ledger: deque[dict] = deque()
+        self._lock = threading.Lock()
+        self._sinks: dict[int, Callable[[Span], None]] = {}
+        self._next_sink = 0
+
+    # -- spans --------------------------------------------------------------
+
+    def start_span(
+        self, name: str, parent: TraceContext | None = None, **attrs: Any
+    ) -> Span:
+        """Parent resolution: explicit ``parent`` wins, else the current
+        trace contextvar, else a fresh root trace."""
+        if parent is None:
+            parent = current_trace()
+        if parent is not None:
+            return Span(
+                self, name, parent.trace_id, parent.parent_span_id, attrs,
+                flags=parent.flags, tracestate=parent.tracestate,
+            )
+        return Span(self, name, secrets.token_hex(16), None, attrs)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+            self._by_trace.setdefault(span.trace_id, []).append(span)
+            while len(self._spans) > self.capacity:
+                old = self._spans.popleft()
+                bucket = self._by_trace.get(old.trace_id)
+                if bucket is not None:
+                    try:
+                        bucket.remove(old)
+                    except ValueError:
+                        pass
+                    if not bucket:
+                        del self._by_trace[old.trace_id]
+            sinks = list(self._sinks.values())
+        for sink in sinks:  # histograms lock themselves; don't nest locks
+            try:
+                sink(span)
+            except Exception:  # noqa: BLE001 — a sink must never break tracing
+                pass
+
+    def spans(self, trace_id: str | None = None) -> list[Span]:
+        with self._lock:
+            if trace_id is not None:
+                return list(self._by_trace.get(trace_id, ()))
+            return list(self._spans)
+
+    # -- ledger -------------------------------------------------------------
+
+    def record_ledger(self, record: dict) -> None:
+        with self._lock:
+            self._ledger.append(record)
+            while len(self._ledger) > self.ledger_capacity:
+                self._ledger.popleft()
+
+    def ledger(self, trace_id: str | None = None, limit: int = 100) -> list[dict]:
+        """Most recent first."""
+        with self._lock:
+            records = list(self._ledger)
+        records.reverse()
+        if trace_id is not None:
+            records = [r for r in records if r.get("trace_id") == trace_id]
+        return records[:limit]
+
+    # -- metrics sinks ------------------------------------------------------
+
+    def add_sink(self, fn: Callable[[Span], None]) -> int:
+        with self._lock:
+            key = self._next_sink
+            self._next_sink += 1
+            self._sinks[key] = fn
+        return key
+
+    def remove_sink(self, key: int) -> None:
+        with self._lock:
+            self._sinks.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._by_trace.clear()
+            self._ledger.clear()
+
+
+# -- process-global recorder --------------------------------------------------
+
+def _env_enabled() -> bool:
+    return os.environ.get("DYNTPU_TRACING", "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+_recorder: SpanRecorder | None = (
+    SpanRecorder(
+        capacity=int(os.environ.get("DYNTPU_TRACING_CAPACITY", "4096")),
+        ledger_capacity=int(os.environ.get("DYNTPU_TRACING_LEDGER", "1024")),
+    )
+    if _env_enabled()
+    else None
+)
+
+
+def recorder() -> SpanRecorder | None:
+    return _recorder
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def set_recorder(rec: SpanRecorder | None) -> SpanRecorder | None:
+    """Swap the process recorder (tests; ``None`` disables). → previous."""
+    global _recorder
+    prev, _recorder = _recorder, rec
+    return prev
+
+
+def start_span(name: str, parent: TraceContext | None = None, **attrs: Any):
+    """The one tracing entry point. Disabled ⇒ the shared no-op span."""
+    rec = _recorder
+    if rec is None:
+        return NOOP_SPAN
+    return rec.start_span(name, parent, **attrs)
+
+
+def start_span_if(parent, name: str, **attrs: Any):
+    """``start_span`` gated on a trace context: serving-path call sites
+    record spans only for traced requests — an infra RPC without a trace
+    (exporter scrape, KV event subscription) passes ``parent=None`` and
+    gets the no-op span, keeping the phase histograms request-only."""
+    if parent is None:
+        return NOOP_SPAN
+    return start_span(name, parent, **attrs)
+
+
+def record_interval(
+    name: str,
+    parent: TraceContext | None = None,
+    *,
+    start: float,
+    end: float,
+    **attrs: Any,
+):
+    """Record an interval whose endpoints were stamped with
+    ``time.perf_counter()`` — possibly on another thread (the engine
+    scheduler stamps admission/prefill instants; the request coroutine
+    turns them into spans after the fact)."""
+    rec = _recorder
+    if rec is None:
+        return NOOP_SPAN
+    span = rec.start_span(name, parent, **attrs)
+    # Re-anchor the wall-clock start so the flame timeline lines up.
+    span.start_ts = time.time() - (time.perf_counter() - start)
+    span._t0 = start
+    span.end(at=end)
+    return span
+
+
+def install_metrics_sink(registry):
+    """Register ``phase_duration_seconds{phase=<span name>}`` on ``registry``
+    and feed it every finished span. → opaque handle for removal, or None
+    when tracing is disabled. The handle pins the recorder it was installed
+    on, so a later ``set_recorder`` swap can't mis-route the removal."""
+    rec = _recorder
+    if rec is None:
+        return None
+    hist = registry.histogram(
+        "phase_duration_seconds",
+        "Span durations by span name (http.request, router.attempt, "
+        "wire.call, wire.serve, engine.queue/prefill/decode, ...)",
+    )
+
+    def sink(span: Span) -> None:
+        if span.duration_s is not None:
+            hist.observe(span.duration_s, phase=span.name)
+
+    return (rec, rec.add_sink(sink))
+
+
+def remove_metrics_sink(handle) -> None:
+    if handle is not None:
+        rec, key = handle
+        rec.remove_sink(key)
+
+
+# -- derived views -------------------------------------------------------------
+
+def build_ledger(
+    trace_id: str,
+    *,
+    request_id: str,
+    model: str,
+    endpoint: str,
+    status: str,
+    duration_s: float,
+    prompt_tokens: int = 0,
+    completion_tokens: int = 0,
+    ttft_s: float | None = None,
+    itl_s: float | None = None,
+    spans: Iterable[Span] | None = None,
+    root_span_id: str | None = None,
+) -> dict:
+    """One lifecycle record for a finished request, derived from the
+    recorder's spans for its trace. Phase durations are sums over the spans
+    named in :data:`PHASE_SPANS`; retries/migrations are span counts.
+
+    ``root_span_id`` restricts the derivation to that span's subtree — a
+    client may send several requests under ONE traceparent trace id
+    (OpenTelemetry parent operations), and without the filter their
+    phases/retries would sum into each other's ledgers."""
+    if spans is None:
+        rec = _recorder
+        spans = rec.spans(trace_id) if rec is not None else []
+    spans = list(spans)
+    if root_span_id is not None:
+        keep = {root_span_id}
+        # Recorder order is by end time (children usually precede parents),
+        # so expand to a fixpoint rather than assuming topological order.
+        changed = True
+        while changed:
+            changed = False
+            for span in spans:
+                if span.span_id not in keep and span.parent_id in keep:
+                    keep.add(span.span_id)
+                    changed = True
+        spans = [s for s in spans if s.span_id in keep]
+    phases: dict[str, float] = {}
+    attempts = 0
+    migrations = 0
+    for span in spans:
+        phase = PHASE_SPANS.get(span.name)
+        if phase is not None and span.duration_s is not None:
+            phases[phase] = phases.get(phase, 0.0) + span.duration_s
+        if span.name == "router.attempt":
+            attempts += 1
+        elif span.name == "migration.redispatch":
+            migrations += 1
+    return {
+        "trace_id": trace_id,
+        "request_id": request_id,
+        "model": model,
+        "endpoint": endpoint,
+        "status": status,
+        "duration_s": round(duration_s, 6),
+        "ttft_s": None if ttft_s is None else round(ttft_s, 6),
+        "itl_s": None if itl_s is None else round(itl_s, 6),
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "retries": max(attempts - 1, 0),
+        "migrations": migrations,
+        "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "ts": time.time(),
+    }
+
+
+def chrome_trace(trace_id: str, spans: Iterable[Span] | None = None) -> dict:
+    """Chrome-trace ("catapult") JSON for one trace: complete ("X") events,
+    loadable in ``chrome://tracing`` / Perfetto. Span lineage travels in
+    ``args`` (span_id/parent_id) so tooling can rebuild the tree exactly."""
+    if spans is None:
+        rec = _recorder
+        spans = rec.spans(trace_id) if rec is not None else []
+    events = []
+    for span in sorted(spans, key=lambda s: s.start_ts):
+        events.append({
+            "name": span.name,
+            "cat": "serving",
+            "ph": "X",
+            "ts": int(span.start_ts * 1e6),
+            "dur": int((span.duration_s or 0.0) * 1e6),
+            "pid": 1,
+            "tid": 1,
+            "args": {
+                "span_id": span.span_id,
+                "parent_id": span.parent_id,
+                "status": span.status,
+                **span.attrs,
+            },
+        })
+        for name, offset, attrs in span.events:
+            events.append({
+                "name": f"{span.name}:{name}",
+                "cat": "serving",
+                "ph": "i",
+                "s": "t",
+                "ts": int((span.start_ts + offset) * 1e6),
+                "pid": 1,
+                "tid": 1,
+                "args": dict(attrs),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms", "otherData": {"trace_id": trace_id}}

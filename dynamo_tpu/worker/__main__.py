@@ -222,6 +222,11 @@ async def build_engine(args, config=None):
 async def async_main(args) -> None:
     rt = await DistributedRuntime.create(store_url=args.store_url)
     engine, card = await build_engine(args, config=rt.config)
+    # Engine-level chaos draws (mocker kill_p) count on this process's
+    # /metrics alongside the messaging-layer injector's.
+    engine_chaos = getattr(getattr(engine, "args", None), "chaos", None)
+    if engine_chaos is not None:
+        engine_chaos.bind_metrics(rt.metrics)
 
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)

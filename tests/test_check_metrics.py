@@ -1,0 +1,22 @@
+"""Tier-1 wiring for tools/check_metrics.py: the metrics catalog must stay
+clean — every registered metric carries help text, no name/type collisions
+across scopes or process registries."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_metrics_catalog_is_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_metrics.py")],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert "check_metrics: OK" in proc.stdout

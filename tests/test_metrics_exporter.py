@@ -63,3 +63,67 @@ def test_exporter_scrapes_workers():
     assert "dynamo_tpu_fleet_worker_kv_usage" in text
     assert 'dynamo_tpu_fleet_workers_live' in text
     assert "0.4" in text
+
+
+def test_exporter_poll_survives_hung_worker():
+    """Satellite: workers are scraped concurrently with a per-scrape
+    timeout — one hung worker costs at most scrape_timeout_s, and the
+    healthy worker's series still land."""
+    import time
+
+    from dynamo_tpu.runtime.push_router import RouterMode
+
+    async def go():
+        url = "memory://exporter_hung"
+        # Healthy worker.
+        wrt = await DistributedRuntime.create(store_url=url)
+        comp = wrt.namespace("dyn").component("backend")
+
+        async def load_metrics(payload, ctx):
+            yield ForwardPassMetrics(
+                worker=WorkerStats(request_active_slots=1, request_total_slots=4,
+                                   num_requests_waiting=0),
+                kv=KvStats(kv_active_blocks=2, kv_total_blocks=10,
+                           gpu_cache_usage_perc=0.2, gpu_prefix_cache_hit_rate=0.0),
+            ).to_dict()
+
+        await comp.endpoint(LOAD_METRICS_ENDPOINT).serve(load_metrics)
+
+        # Hung worker: accepts the scrape, never answers. Its own teardown
+        # must not wait out the graceful drain on the stuck handler either.
+        from dynamo_tpu.runtime.config import Config
+
+        hcfg = Config.from_env({})
+        hcfg.runtime.graceful_shutdown_timeout = 0.2
+        hrt = await DistributedRuntime.create(store_url=url, config=hcfg)
+
+        async def hung_metrics(payload, ctx):
+            await asyncio.sleep(60)
+            yield {}
+
+        await hrt.namespace("dyn").component("backend").endpoint(
+            LOAD_METRICS_ENDPOINT
+        ).serve(hung_metrics)
+
+        ert = await DistributedRuntime.create(store_url=url)
+        exporter = MetricsExporter(ert, "dyn", "backend", interval_s=999,
+                                   scrape_timeout_s=0.5)
+        ep = ert.namespace("dyn").component("backend").endpoint(LOAD_METRICS_ENDPOINT)
+        exporter._router = await ep.router(RouterMode.DIRECT)
+        await exporter._router.discovery.wait_for_instances(2, timeout=10)
+        t0 = time.monotonic()
+        n = await exporter.poll_once()
+        elapsed = time.monotonic() - t0
+        text = ert.metrics.render()
+        # Unblock the hung handler before teardown (drain would wait on it).
+        await hrt.shutdown()
+        await ert.shutdown()
+        await wrt.shutdown()
+        return n, elapsed, text
+
+    n, elapsed, text = asyncio.run(asyncio.wait_for(go(), timeout=30))
+    assert n == 1  # healthy worker scraped
+    # Sequential scraping would block ~60s on the hung worker; concurrent +
+    # timeout bounds the whole poll by the per-scrape budget (+ slack).
+    assert elapsed < 3.0, f"poll stalled {elapsed:.1f}s behind the hung worker"
+    assert "dynamo_tpu_fleet_worker_active_slots" in text

@@ -1,0 +1,369 @@
+"""Observability slice e2e: distributed span tracing, lifecycle ledger,
+/debug endpoints, and the new metric series across a real messaging hop.
+
+In-process fleets (mocker workers + frontend over real framed TCP) share
+the process-global SpanRecorder, so these tests see the full
+frontend→router→worker span nesting that a single-host deployment sees.
+"""
+
+import asyncio
+import logging
+
+import httpx
+import pytest
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+from dynamo_tpu.llm.pipeline import RouterSettings
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.chaos import ChaosConfig
+from dynamo_tpu.runtime.config import Config
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.push_router import RouterMode
+
+TRACEPARENT = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+TRACE_ID = "0af7651916cd43dd8448eb211c80319c"
+
+
+@pytest.fixture
+def fresh_recorder():
+    rec = tracing.SpanRecorder(capacity=4096, ledger_capacity=256)
+    prev = tracing.set_recorder(rec)
+    yield rec
+    tracing.set_recorder(prev)
+
+
+def fast_config(chaos: ChaosConfig | None = None) -> Config:
+    cfg = Config.from_env({})
+    cfg.runtime.retry_backoff_base = 0.005
+    cfg.runtime.retry_backoff_max = 0.05
+    cfg.runtime.circuit_cooldown = 0.2
+    if chaos is not None:
+        cfg.chaos = chaos
+    return cfg
+
+
+async def start_worker(store_url, namespace="obs", chaos=None, migration_limit=0,
+                       mocker: MockerArgs | None = None):
+    rt = await DistributedRuntime.create(store_url=store_url, config=fast_config(chaos))
+    engine = MockerEngine(
+        mocker or MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0)
+    )
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+    comp = rt.namespace(namespace).component("backend")
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await comp.endpoint("generate").serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    card = ModelDeploymentCard(
+        name="obs-model", kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS], context_length=512,
+        migration_limit=migration_limit,
+    )
+    await register_model(rt, namespace, card)
+    return rt, engine
+
+
+async def start_frontend(store_url):
+    rt = await DistributedRuntime.create(store_url=store_url, config=fast_config())
+    manager = ModelManager(rt, RouterSettings(mode=RouterMode.ROUND_ROBIN))
+    watcher = await ModelWatcher(rt, manager).start()
+    http = await HttpService(
+        manager, rt.metrics, health=rt.health, host="127.0.0.1", port=0
+    ).start()
+    return rt, manager, watcher, http
+
+
+def body(text="observe me", max_tokens=8, **kw):
+    out = {
+        "model": "obs-model",
+        "messages": [{"role": "user", "content": text}],
+        "max_tokens": max_tokens,
+    }
+    out.update(kw)
+    return out
+
+
+async def wait_model(client, base):
+    for _ in range(100):
+        r = await client.get(f"{base}/v1/models")
+        if r.json()["data"]:
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("model never appeared")
+
+
+def span_index(trace_json):
+    """Chrome-trace JSON → {span_id: event} for complete events."""
+    return {
+        e["args"]["span_id"]: e
+        for e in trace_json["traceEvents"]
+        if e["ph"] == "X"
+    }
+
+
+def ancestors(spans, event):
+    """Names of the event's ancestor chain (nearest first)."""
+    chain = []
+    parent = event["args"]["parent_id"]
+    while parent is not None and parent in spans:
+        event = spans[parent]
+        chain.append(event["name"])
+        parent = event["args"]["parent_id"]
+    return chain
+
+
+def test_inbound_traceparent_to_worker_spans_ledger_and_flame(fresh_recorder):
+    """A request with an inbound traceparent yields same-trace-id spans on
+    both sides of a real messaging hop, a /debug/requests ledger entry with
+    non-zero phases, and a /debug/traces flame whose spans nest
+    frontend→router→worker."""
+
+    captured = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            captured.append(record)
+
+    handler = Capture()
+    logging.getLogger("dynamo_tpu.ledger").addHandler(handler)
+
+    async def go():
+        url = "memory://obs_trace"
+        wrt, _eng = await start_worker(url)
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                await wait_model(client, base)
+                r = await client.post(
+                    f"{base}/v1/chat/completions", json=body(),
+                    headers={"traceparent": TRACEPARENT},
+                )
+                assert r.status_code == 200
+
+                # ledger entry via /debug/requests, filtered by trace id
+                r = await client.get(
+                    f"{base}/debug/requests", params={"trace_id": TRACE_ID}
+                )
+                assert r.status_code == 200
+                records = r.json()["requests"]
+                assert len(records) == 1, records
+                rec = records[0]
+                assert rec["trace_id"] == TRACE_ID
+                assert rec["model"] == "obs-model"
+                assert rec["status"] == "200"
+                assert rec["completion_tokens"] == 8
+                assert rec["ttft_s"] > 0
+                for phase in ("admission_wait", "preprocess", "route", "wire",
+                              "queue_wait", "prefill", "decode"):
+                    assert rec["phases"].get(phase, 0) > 0, (phase, rec["phases"])
+
+                # worker-side spans carry the inbound trace id (the hop is
+                # real framed TCP — the id crossed the wire)
+                names = {s.name for s in fresh_recorder.spans(TRACE_ID)}
+                assert {"wire.serve", "engine.queue", "engine.prefill",
+                        "engine.decode"} <= names, names
+
+                # flame export nests frontend→router→worker
+                r = await client.get(f"{base}/debug/traces/{TRACE_ID}")
+                assert r.status_code == 200
+                spans = span_index(r.json())
+                decodes = [e for e in spans.values() if e["name"] == "engine.decode"]
+                assert decodes, spans
+                chain = ancestors(spans, decodes[0])
+                assert chain[:4] == ["wire.serve", "wire.call", "router.attempt",
+                                     "http.request"], chain
+                assert decodes[0]["args"]["tokens"] == 8
+                assert decodes[0]["dur"] > 0
+
+                # unknown trace → 404
+                r = await client.get(f"{base}/debug/traces/{'0' * 32}")
+                assert r.status_code == 404
+
+                # ledger also rode the logging layer with structured fields
+                ledger_records = [
+                    c for c in captured
+                    if getattr(c, "event", None) == "request_ledger"
+                    and getattr(c, "trace_id", None) == TRACE_ID
+                ]
+                assert ledger_records, "no ledger log line"
+                assert ledger_records[0].phases["decode"] > 0
+        finally:
+            logging.getLogger("dynamo_tpu.ledger").removeHandler(handler)
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+def test_chaos_run_ledger_counts_retries_and_migrations(fresh_recorder):
+    """Acceptance: a chaos-run request (mocker path) yields a ledger entry
+    with non-zero phase durations and retry/migration counts, plus the new
+    metric series in /metrics text exposition."""
+
+    async def go():
+        url = "memory://obs_chaos"
+        # Frame drops cut the transport mid-stream (after payload flowed),
+        # which is what forces Migration re-dispatch; truncation at the
+        # final frame alone is absorbed by the over-delivery guard.
+        chaos = ChaosConfig(enabled=True, seed=7, frame_drop_p=0.08, truncate_p=0.2)
+        w1 = await start_worker(url, chaos=chaos, migration_limit=20)
+        w2 = await start_worker(
+            url, chaos=ChaosConfig(enabled=True, seed=8, frame_drop_p=0.08, truncate_p=0.2),
+            migration_limit=20,
+        )
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                await wait_model(client, base)
+                migrated = None
+                for _ in range(25):
+                    r = await client.post(
+                        f"{base}/v1/chat/completions", json=body(max_tokens=24),
+                        headers={"X-Request-Timeout": "30"},
+                    )
+                    assert r.status_code == 200, r.text
+                    r = await client.get(f"{base}/debug/requests", params={"limit": "1"})
+                    rec = r.json()["requests"][0]
+                    if rec["migrations"] > 0:
+                        migrated = rec
+                        break
+                assert migrated is not None, "chaos never forced a migration in 25 runs"
+                assert migrated["status"] == "200"
+                assert migrated["completion_tokens"] == 24
+                assert migrated["phases"]["decode"] > 0
+                assert migrated["phases"]["prefill"] > 0
+
+                # /metrics text exposition: phase histograms + admission series
+                r = await client.get(f"{base}/metrics")
+                text = r.text
+                assert "dynamo_tpu_phase_duration_seconds_bucket" in text
+                assert 'phase="http.request"' in text
+                assert 'phase="router.attempt"' in text
+                assert "dynamo_tpu_admission_queue_depth" in text
+                assert "dynamo_tpu_admission_wait_seconds_bucket" in text
+                assert "dynamo_tpu_http_requests_total" in text
+
+                # worker registries: engine phases + chaos injections
+                wtext = w1[0].metrics.render() + w2[0].metrics.render()
+                assert 'phase="engine.decode"' in wtext
+                assert "dynamo_tpu_chaos_injections_total" in wtext
+                assert 'kind="frame_drop"' in wtext or 'kind="truncate"' in wtext
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await w1[0].shutdown()
+            await w2[0].shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=120))
+
+
+def test_deadline_breaker_retry_series_and_shed_ledger(fresh_recorder):
+    """deadline_expired_total / router_retries_total / circuit_breaker_state
+    appear once their paths fire; shed requests get ledger entries too."""
+    from dynamo_tpu.runtime.admission import AdmissionController
+
+    async def go():
+        url = "memory://obs_series"
+        wrt, _eng = await start_worker(
+            url, mocker=MockerArgs(block_size=4, num_kv_blocks=256, itl_ms=50.0)
+        )
+        frt = await DistributedRuntime.create(store_url=url, config=fast_config())
+        manager = ModelManager(frt, RouterSettings(mode=RouterMode.ROUND_ROBIN))
+        watcher = await ModelWatcher(frt, manager).start()
+        http = await HttpService(
+            manager, frt.metrics, health=frt.health, host="127.0.0.1", port=0,
+            admission=AdmissionController(max_inflight=1, retry_after=1.0),
+        ).start()
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=30) as client:
+                await wait_model(client, base)
+
+                # deadline → 504 + counter
+                r = await client.post(
+                    f"{base}/v1/chat/completions", json=body(max_tokens=100),
+                    headers={"X-Request-Timeout": "0.3"},
+                )
+                assert r.status_code == 504
+                text = (await client.get(f"{base}/metrics")).text
+                assert 'dynamo_tpu_deadline_expired_total{' in text
+                assert 'scope="http"' in text
+
+                # shed → 429 with its own ledger record
+                slow = asyncio.ensure_future(client.post(
+                    f"{base}/v1/chat/completions", json=body(max_tokens=30)
+                ))
+                while http.admission.inflight == 0:
+                    await asyncio.sleep(0.01)
+                r = await client.post(f"{base}/v1/chat/completions", json=body())
+                assert r.status_code == 429
+                await slow
+                r = await client.get(f"{base}/debug/requests", params={"limit": "10"})
+                statuses = [rec["status"] for rec in r.json()["requests"]]
+                assert "429" in statuses, statuses
+
+                # breaker: mark the instance down → gauge series appears
+                pipe = manager.get("obs-model")
+                disc = pipe.discovery
+                iid = disc.instances()[0].instance_id
+                disc.report_instance_down(iid)
+                text = frt.metrics.render()
+                assert "dynamo_tpu_circuit_breaker_state" in text
+                assert f'instance="{iid:x}"' in text
+                disc.report_instance_up(iid)
+                assert 'dynamo_tpu_circuit_breaker_state{' in frt.metrics.render()
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=60))
+
+
+def test_debug_endpoints_when_tracing_disabled():
+    prev = tracing.set_recorder(None)
+
+    async def go():
+        url = "memory://obs_off"
+        wrt, _eng = await start_worker(url)
+        frt, manager, watcher, http = await start_frontend(url)
+        base = f"http://127.0.0.1:{http.port}"
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                await wait_model(client, base)
+                # serving still works with the no-op fast path
+                r = await client.post(f"{base}/v1/chat/completions", json=body())
+                assert r.status_code == 200
+                r = await client.get(f"{base}/debug/requests")
+                assert r.json() == {"enabled": False, "requests": []}
+                r = await client.get(f"{base}/debug/traces/{'0' * 32}")
+                assert r.status_code == 404
+        finally:
+            await http.close()
+            await watcher.close()
+            await manager.close()
+            await frt.shutdown()
+            await wrt.shutdown()
+
+    try:
+        asyncio.run(asyncio.wait_for(go(), timeout=60))
+    finally:
+        tracing.set_recorder(prev)

@@ -1,0 +1,213 @@
+"""Span tracing unit tests: recorder ring bounds, no-op fast path, ledger
+derivation, Chrome-trace export, phase-histogram sink, JSONL extras."""
+
+import json
+import logging
+import time
+
+import pytest
+
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.logging import (
+    JsonlFormatter,
+    TraceContext,
+    reset_current_trace,
+    set_current_trace,
+)
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+
+@pytest.fixture
+def fresh_recorder():
+    rec = tracing.SpanRecorder(capacity=64, ledger_capacity=8)
+    prev = tracing.set_recorder(rec)
+    yield rec
+    tracing.set_recorder(prev)
+
+
+def test_span_basics_and_parenting(fresh_recorder):
+    root = tracing.start_span("http.request", endpoint="chat")
+    assert root.recording and root.parent_id is None
+    child = tracing.start_span("router.attempt", parent=root.trace_context())
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    child.set_attr("instance", "ab")
+    child.add_event("backoff", delay=0.1)
+    child.end()
+    root.end()
+    spans = fresh_recorder.spans(root.trace_id)
+    assert [s.name for s in spans] == ["router.attempt", "http.request"]
+    assert spans[0].duration_s >= 0
+    assert spans[0].attrs["instance"] == "ab"
+    assert spans[0].events[0][0] == "backoff"
+    # end() is idempotent
+    child.end(status="error:X")
+    assert child.status == "ok"
+
+
+def test_parent_from_current_trace_contextvar(fresh_recorder):
+    ctx = TraceContext.parse("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    token = set_current_trace(ctx)
+    try:
+        span = tracing.start_span("wire.call")
+    finally:
+        reset_current_trace(token)
+    assert span.trace_id == ctx.trace_id
+    assert span.parent_id == ctx.parent_span_id
+
+
+def test_ring_buffer_evicts_and_index_follows(fresh_recorder):
+    for i in range(100):
+        tracing.start_span(f"s{i}").end()
+    assert len(fresh_recorder.spans()) == 64
+    # evicted trace ids are gone from the index too
+    first = fresh_recorder.spans()[0]
+    assert fresh_recorder.spans(first.trace_id) == [first]
+
+
+def test_noop_fast_path_when_disabled():
+    prev = tracing.set_recorder(None)
+    try:
+        a = tracing.start_span("x", foo=1)
+        b = tracing.start_span("y")
+        assert a is b is tracing.NOOP_SPAN
+        assert not a.recording
+        a.set_attrs(z=2)
+        a.add_event("e")
+        a.end(status="whatever")
+        with tracing.start_span("ctx") as s:
+            assert s is tracing.NOOP_SPAN
+        assert a.trace_context() is None
+        assert tracing.record_interval("q", start=0.0, end=1.0) is tracing.NOOP_SPAN
+        assert tracing.install_metrics_sink(MetricsRegistry()) is None
+    finally:
+        tracing.set_recorder(prev)
+
+
+def test_record_interval_retroactive(fresh_recorder):
+    now = time.perf_counter()
+    span = tracing.record_interval(
+        "engine.queue", None, start=now - 0.5, end=now - 0.25, waited=True
+    )
+    assert span.duration_s == pytest.approx(0.25, abs=1e-6)
+    assert span.start_ts <= time.time() - 0.4
+    assert fresh_recorder.spans()[-1] is span
+
+
+def test_build_ledger_phases_retries_migrations(fresh_recorder):
+    root = tracing.start_span("http.request")
+    trace = root.trace_context()
+    now = time.perf_counter()
+    tracing.record_interval("http.admission", trace, start=now - 1.0, end=now - 0.9)
+    for _ in range(3):
+        tracing.record_interval("router.attempt", trace, start=now - 0.9, end=now - 0.8)
+    tracing.record_interval("engine.queue", trace, start=now - 0.8, end=now - 0.7)
+    tracing.record_interval("engine.prefill", trace, start=now - 0.7, end=now - 0.5)
+    tracing.record_interval("engine.decode", trace, start=now - 0.5, end=now - 0.1)
+    tracing.start_span("migration.redispatch", parent=trace).end()
+    root.end()
+    rec = tracing.build_ledger(
+        root.trace_id, request_id="r1", model="m", endpoint="chat",
+        status="200", duration_s=1.0, prompt_tokens=5, completion_tokens=8,
+        ttft_s=0.6, itl_s=0.05,
+    )
+    assert rec["retries"] == 2
+    assert rec["migrations"] == 1
+    assert rec["phases"]["admission_wait"] == pytest.approx(0.1, abs=1e-3)
+    assert rec["phases"]["route"] == pytest.approx(0.3, abs=1e-3)
+    assert rec["phases"]["queue_wait"] == pytest.approx(0.1, abs=1e-3)
+    assert rec["phases"]["prefill"] == pytest.approx(0.2, abs=1e-3)
+    assert rec["phases"]["decode"] == pytest.approx(0.4, abs=1e-3)
+    assert rec["completion_tokens"] == 8
+
+
+def test_build_ledger_scopes_to_root_subtree(fresh_recorder):
+    """Two requests under ONE client trace id (OTel parent op): each
+    ledger derives only from its own root's span subtree."""
+    now = time.perf_counter()
+    roots = []
+    for _ in range(2):
+        root = tracing.start_span("http.request")
+        # Force both onto one trace id, as an inbound traceparent would.
+        root.trace_id = roots[0].trace_id if roots else root.trace_id
+        trace = root.trace_context()
+        tracing.record_interval("router.attempt", trace, start=now - 0.2, end=now - 0.1)
+        tracing.record_interval("engine.decode", trace, start=now - 0.1, end=now)
+        root.end()
+        roots.append(root)
+    for root in roots:
+        rec = tracing.build_ledger(
+            root.trace_id, root_span_id=root.span_id,
+            request_id="r", model="m", endpoint="chat", status="200",
+            duration_s=0.2,
+        )
+        assert rec["retries"] == 0  # one attempt each, not summed to 2-1
+        assert rec["phases"]["decode"] == pytest.approx(0.1, abs=1e-3)
+        assert rec["phases"]["route"] == pytest.approx(0.1, abs=1e-3)
+
+
+def test_ledger_ring_bound_and_query(fresh_recorder):
+    for i in range(20):
+        fresh_recorder.record_ledger({"trace_id": f"t{i}", "n": i})
+    records = fresh_recorder.ledger()
+    assert len(records) == 8  # ledger_capacity
+    assert records[0]["n"] == 19  # newest first
+    assert fresh_recorder.ledger("t15") == [{"trace_id": "t15", "n": 15}]
+
+
+def test_chrome_trace_export(fresh_recorder):
+    root = tracing.start_span("http.request", endpoint="chat")
+    child = tracing.start_span("router.attempt", parent=root.trace_context())
+    child.add_event("picked", instance="7")
+    child.end()
+    root.end()
+    out = tracing.chrome_trace(root.trace_id)
+    events = out["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"http.request", "router.attempt"}
+    by_name = {e["name"]: e for e in complete}
+    assert by_name["router.attempt"]["args"]["parent_id"] == \
+        by_name["http.request"]["args"]["span_id"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert instants and instants[0]["name"] == "router.attempt:picked"
+
+
+def test_phase_histogram_sink(fresh_recorder):
+    reg = MetricsRegistry()
+    key = tracing.install_metrics_sink(reg)
+    tracing.start_span("engine.decode").end()
+    tracing.start_span("wire.call").end()
+    text = reg.render()
+    assert 'dynamo_tpu_phase_duration_seconds_count{phase="engine.decode"} 1' in text
+    assert 'phase="wire.call"' in text
+    tracing.remove_metrics_sink(key)
+    tracing.start_span("engine.decode").end()
+    assert 'phase="engine.decode"} 1' in reg.render()  # sink removed: unchanged
+
+
+def test_jsonl_formatter_includes_extra_fields():
+    fmt = JsonlFormatter()
+    logger = logging.getLogger("dynamo_tpu.test_jsonl")
+    record = logger.makeRecord(
+        "dynamo_tpu.test_jsonl", logging.INFO, __file__, 1, "hello %s", ("world",),
+        None, extra={"event": "request_ledger", "phases": {"decode": 0.2},
+                     "completion_tokens": 8},
+    )
+    out = json.loads(fmt.format(record))
+    assert out["message"] == "hello world"
+    assert out["event"] == "request_ledger"
+    assert out["phases"] == {"decode": 0.2}
+    assert out["completion_tokens"] == 8
+    # stdlib internals are not leaked
+    assert "args" not in out and "msg" not in out and "levelno" not in out
+
+
+def test_jsonl_formatter_extra_survives_unserializable_values():
+    fmt = JsonlFormatter()
+    logger = logging.getLogger("dynamo_tpu.test_jsonl2")
+    record = logger.makeRecord(
+        "dynamo_tpu.test_jsonl2", logging.INFO, __file__, 1, "x", (),
+        None, extra={"obj": object()},
+    )
+    out = json.loads(fmt.format(record))
+    assert out["obj"].startswith("<object object")

@@ -31,6 +31,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def _free_port() -> int:
@@ -80,8 +82,18 @@ def _drive_streams(base: str, k: int, gen_len: int) -> tuple[int, int]:
 
 
 async def run(streams_list: list[int], gen_len: int, n_workers: int,
-              router_mode: str, as_json: bool, delta_tokens: int = 1) -> list[dict]:
+              router_mode: str, as_json: bool, delta_tokens: int = 1,
+              tracing_on: bool = False) -> list[dict]:
     import httpx
+
+    # Default off: this tool measures the recorder-DISABLED fast path (the
+    # per-token hot loop must not pay for spans). --tracing on measures the
+    # enabled path for comparison; spans are per-request/phase, not
+    # per-token, so the delta should stay in the noise.
+    os.environ["DYNTPU_TRACING"] = "1" if tracing_on else "0"
+    from dynamo_tpu.runtime import tracing as _tracing
+
+    _tracing.set_recorder(_tracing.SpanRecorder() if tracing_on else None)
 
     from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
     from dynamo_tpu.llm.http_service import HttpService
@@ -90,7 +102,8 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
     from dynamo_tpu.runtime.metrics import MetricsRegistry
     from dynamo_tpu.runtime.push_router import RouterMode
 
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = dict(os.environ, PYTHONPATH=REPO,
+               DYNTPU_TRACING="1" if tracing_on else "0")
     port = _free_port()
     url = f"tcp://127.0.0.1:{port}"
     procs: list[subprocess.Popen] = []
@@ -101,7 +114,18 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
             [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
              "--host", "127.0.0.1", "--port", str(port)], env=env,
         ))
-        await asyncio.sleep(1.0)
+        # Wait for the store to accept connections (interpreter start +
+        # imports can take seconds on a cold container).
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.close()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("store server never came up")
+                await asyncio.sleep(0.25)
         for _ in range(n_workers):
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "dynamo_tpu.worker",
@@ -167,6 +191,7 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
                 row = {
                     "streams": s, "gen_len": gen_len, "workers": n_workers,
                     "router_mode": router_mode, "delta_tokens": delta_tokens,
+                    "tracing": tracing_on,
                     "elapsed_s": round(dur, 3),
                     "frontend_tok_s": round(total / dur, 1),
                     "errors": errs,
@@ -204,11 +229,14 @@ def main():
     p.add_argument("--router-mode", default="kv")
     p.add_argument("--delta-tokens", type=int, default=1,
                    help="tokens per worker delta (engine window bursts ~ decode_steps)")
+    p.add_argument("--tracing", choices=["on", "off"], default="off",
+                   help="span recorder state for frontend AND workers "
+                        "(off = measure the no-op fast path)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args()
     streams = [int(s) for s in args.streams.split(",")]
     asyncio.run(run(streams, args.gen_len, args.workers, args.router_mode,
-                    args.json, args.delta_tokens))
+                    args.json, args.delta_tokens, tracing_on=args.tracing == "on"))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,128 @@
+"""Render a request's Chrome-trace JSON as a text flame timeline.
+
+Input: the JSON served by the frontend's ``/debug/traces/{trace_id}``
+endpoint (also loadable in Perfetto / chrome://tracing as-is) — from a
+file, stdin, or fetched live with ``--base/--trace``.
+
+    python tools/trace_report.py trace.json
+    curl -s localhost:8080/debug/traces/<id> | python tools/trace_report.py -
+    python tools/trace_report.py --base http://localhost:8080 --trace <id>
+    python tools/trace_report.py --base http://localhost:8080 --latest
+
+Output: one line per span, indented by parent lineage, with offset from
+the trace start, duration, a proportional bar, status, and key attrs —
+a slow request's hop-by-hop timeline at a glance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+BAR_WIDTH = 40
+SKIP_ATTRS = {"span_id", "parent_id", "status"}
+
+
+def fetch(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def load(args) -> dict:
+    if args.base:
+        if args.latest:
+            ledger = fetch(f"{args.base}/debug/requests?limit=1")
+            records = ledger.get("requests") or []
+            if not records:
+                sys.exit("no ledger records on the target frontend")
+            args.trace = records[0]["trace_id"]
+        if not args.trace:
+            sys.exit("--base requires --trace <id> or --latest")
+        return fetch(f"{args.base}/debug/traces/{args.trace}")
+    if args.input == "-":
+        return json.load(sys.stdin)
+    with open(args.input) as f:
+        return json.load(f)
+
+
+def build_tree(events: list[dict]):
+    """→ (roots, children) over complete ('X') events, by span lineage."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    by_id = {e["args"]["span_id"]: e for e in spans}
+    children: dict[str, list[dict]] = {}
+    roots = []
+    for e in spans:
+        parent = e["args"].get("parent_id")
+        if parent in by_id:
+            children.setdefault(parent, []).append(e)
+        else:
+            roots.append(e)
+    for bucket in children.values():
+        bucket.sort(key=lambda e: e["ts"])
+    roots.sort(key=lambda e: e["ts"])
+    return roots, children
+
+
+def render(trace: dict, out=sys.stdout) -> None:
+    events = trace.get("traceEvents", [])
+    roots, children = build_tree(events)
+    if not roots:
+        print("no spans in trace", file=out)
+        return
+    t0 = min(e["ts"] for e in roots)
+    t_end = max(e["ts"] + e.get("dur", 0) for e in events if e.get("ph") == "X")
+    total = max(t_end - t0, 1)
+    trace_id = trace.get("otherData", {}).get("trace_id", "?")
+    print(f"trace {trace_id}  total {total / 1000:.2f} ms", file=out)
+
+    def bar(e) -> str:
+        lead = int(BAR_WIDTH * (e["ts"] - t0) / total)
+        width = max(1, int(BAR_WIDTH * e.get("dur", 0) / total))
+        return " " * lead + "#" * min(width, BAR_WIDTH - lead)
+
+    def attrs_str(e) -> str:
+        pairs = [f"{k}={v}" for k, v in e["args"].items() if k not in SKIP_ATTRS]
+        status = e["args"].get("status", "ok")
+        if status != "ok":
+            pairs.insert(0, f"status={status}")
+        return f"  [{' '.join(pairs)}]" if pairs else ""
+
+    def walk(e, depth):
+        offset_ms = (e["ts"] - t0) / 1000
+        dur_ms = e.get("dur", 0) / 1000
+        name = "  " * depth + e["name"]
+        print(
+            f"{name:<32} {offset_ms:9.2f}ms {dur_ms:9.2f}ms "
+            f"|{bar(e):<{BAR_WIDTH}}|{attrs_str(e)}",
+            file=out,
+        )
+        for child in children.get(e["args"]["span_id"], []):
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    instants = [e for e in events if e.get("ph") == "i"]
+    if instants:
+        print(f"\n{len(instants)} event marker(s):", file=out)
+        for e in sorted(instants, key=lambda e: e["ts"]):
+            print(f"  {(e['ts'] - t0) / 1000:9.2f}ms  {e['name']} {e.get('args', {})}", file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("input", nargs="?", default="-",
+                   help="Chrome-trace JSON file, or '-' for stdin")
+    p.add_argument("--base", default=None,
+                   help="frontend base URL to fetch from (e.g. http://localhost:8080)")
+    p.add_argument("--trace", default=None, help="trace id to fetch from --base")
+    p.add_argument("--latest", action="store_true",
+                   help="with --base: render the most recent ledger entry's trace")
+    args = p.parse_args(argv)
+    render(load(args))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
