@@ -98,6 +98,11 @@ async def run_ab(args) -> dict:
         block_size=args.block_size, num_kv_blocks=args.kv_blocks,
         max_num_seqs=256, ttft_ms=2.0, prefill_ms_per_token=0.2,
         itl_ms=2.0, speedup=args.speedup,
+        # Per-token frames: this A/B measures ROUTING quality, and the
+        # whole fleet shares one event loop — emit coalescing would change
+        # per-token yield pacing (and thus index-update vs arrival timing),
+        # not the thing under test.
+        delta_max_tokens=0,
     )
     results = {}
     for mode in ("round-robin", "kv"):

@@ -178,6 +178,16 @@ class EngineArgs:
     # key throughput lever when host↔device roundtrips are slow; tokens
     # stream in bursts of this size. 1 = classic per-step loop.
     decode_steps: int = 8
+    # Emit coalescing: when a stream's consumer lags (GIL-bound frontend
+    # path), decode-window deltas already queued merge into one frame up
+    # to this many tokens before hitting the wire — strictly less
+    # per-token Python work with zero added latency (only backlog merges).
+    # 0 disables (one frame per decode window).
+    delta_max_tokens: int = 64
+    # Optional bounded wait (ms) to gather MORE deltas per frame beyond
+    # the backlog: adds up to this much inter-token latency. 0 (default)
+    # never waits. Keep ≤ one decode-window duration.
+    delta_max_ms: float = 0.0
     # Max prompt tokens admitted per scheduler step (prefill-vs-decode
     # fairness knob). Each admitted prompt still prefills in
     # max_prefill_tokens chunks; this budget only gates how many requests

@@ -35,7 +35,12 @@ from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine.config import EngineArgs
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
-from dynamo_tpu.llm.protocols import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.llm.protocols import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    coalesce_delta,
+)
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
@@ -294,9 +299,58 @@ class TpuEngine:
         watcher = asyncio.get_running_loop().create_task(watch_cancel())
         dspan = tracing.NOOP_SPAN
         first = True
+        # Emit coalescing: merge the backlog of decode-window deltas
+        # already sitting in the queue into one frame (bounded by
+        # delta_max_tokens; optional delta_max_ms gather wait). The first
+        # delta is never delayed (TTFT), and a finish delta terminates the
+        # merge so it rides the same frame as its tokens.
+        cap = self.args.delta_max_tokens
+        gather_s = self.args.delta_max_ms / 1000.0
+        pending: Any = None
         try:
             while True:
-                item = await queue.get()
+                item = pending if pending is not None else await queue.get()
+                pending = None
+                if cap > 0 and isinstance(item, dict) and not item.get("finish_reason"):
+                    # Backlog merge first (free — deltas already queued),
+                    # then the opt-in bounded gather to fill the frame
+                    # further toward the cap (costs ≤ delta_max_ms of ITL;
+                    # default 0 never waits; the first delta never waits).
+                    deadline = (
+                        time.monotonic() + gather_s
+                        if gather_s > 0.0 and not first else None
+                    )
+                    while (
+                        pending is None
+                        and len(item.get("token_ids") or ()) < cap
+                        and not item.get("finish_reason")
+                    ):
+                        if not queue.empty():
+                            nxt = queue.get_nowait()
+                        elif deadline is not None:
+                            wait = deadline - time.monotonic()
+                            if wait <= 0:
+                                break
+                            try:
+                                nxt = await asyncio.wait_for(queue.get(), wait)
+                            except asyncio.TimeoutError:
+                                break
+                        else:
+                            break
+                        if not isinstance(nxt, dict):
+                            pending = nxt  # _SENTINEL_DONE: deliver after item
+                            break
+                        if (
+                            len(item.get("token_ids") or ())
+                            + len(nxt.get("token_ids") or ())
+                        ) > cap:
+                            pending = nxt  # merging would exceed the cap
+                            break
+                        merged = coalesce_delta(item, nxt)
+                        if merged is None:
+                            pending = nxt
+                            break
+                        item = merged
                 if item is _SENTINEL_DONE:
                     return
                 if first:

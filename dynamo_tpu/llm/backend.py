@@ -38,7 +38,9 @@ class StopJail:
         the match and the remainder is discarded."""
         if not self.stop:
             return text, False
-        self.held += text
+        # Avoid the concat when nothing is jailed (the common case: the
+        # previous push released everything).
+        self.held = text if not self.held else self.held + text
         # 1. Confirmed match anywhere in held text → truncate & stop.
         best = -1
         for s in self.stop:
@@ -81,42 +83,64 @@ class Backend(Operator):
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         req = request if isinstance(request, PreprocessedRequest) else PreprocessedRequest.from_dict(request)
         stream = DecodeStream(self.tokenizer)
-        jail = StopJail(req.stop.stop)
+        stop_strings = [s for s in req.stop.stop if s]
+        jail = StopJail(stop_strings)
         eos_ids = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
         ignore_eos = req.stop.ignore_eos
         min_tokens = req.stop.min_tokens
+        # Multi-token fast path preconditions, hoisted out of the loop: a
+        # coalesced delta detokenizes in ONE DecodeStream call and skips the
+        # per-piece stop-jail scan when no stop string / eos check applies.
+        scan_eos = bool(eos_ids) and not ignore_eos
         n_emitted = 0
         finished = False
+        text_parts: list[str] = []  # per-stream scratch, reused per delta
 
         wire_req = req.to_dict() if isinstance(request, PreprocessedRequest) else request
         inner_stream = self.inner.generate(wire_req, context.child())
         try:
             async for raw in inner_stream:
-                out = raw if isinstance(raw, LLMEngineOutput) else LLMEngineOutput.from_dict(raw)
-                if out.finish_reason == FinishReason.ERROR:
-                    yield out.to_dict()
+                # Hot path works on the raw wire dict: no LLMEngineOutput
+                # construction (and its list copies) per delta.
+                if not isinstance(raw, dict):
+                    raw = raw.to_dict()
+                finish_raw = raw.get("finish_reason")
+                if finish_raw == "error":
+                    yield raw
                     return
-                text_parts: list[str] = []
+                token_ids = raw.get("token_ids") or ()
+                text_parts.clear()
                 stop_kind: str | None = None  # "token" (eos/stop id) | "string"
-                n_new = 0
-                for tid in out.token_ids:
-                    n_emitted += 1
-                    n_new += 1
-                    if not ignore_eos and tid in eos_ids and n_emitted >= min_tokens:
-                        # vLLM semantics: the eos token counts toward min_tokens.
-                        stop_kind = "token"
-                        break  # never detokenize the stop token itself
-                    piece = stream.step(tid)
+                n_new = len(token_ids)
+                if not stop_strings and not (
+                    scan_eos and any(t in eos_ids for t in token_ids)
+                ):
+                    # Fast path: no stop string and no eos in this delta —
+                    # the whole delta is output; one detokenizer call.
+                    piece = stream.step_many(token_ids)
                     if piece is not None:
-                        released, matched = jail.push(piece)
-                        if released:
-                            text_parts.append(released)
-                        if matched:
-                            stop_kind = "string"
-                            break
-                finish = out.finish_reason
+                        text_parts.append(piece)
+                    n_emitted += n_new
+                else:
+                    n_new = 0
+                    for tid in token_ids:
+                        n_emitted += 1
+                        n_new += 1
+                        if not ignore_eos and tid in eos_ids and n_emitted >= min_tokens:
+                            # vLLM semantics: the eos token counts toward min_tokens.
+                            stop_kind = "token"
+                            break  # never detokenize the stop token itself
+                        piece = stream.step(tid)
+                        if piece is not None:
+                            released, matched = jail.push(piece)
+                            if released:
+                                text_parts.append(released)
+                            if matched:
+                                stop_kind = "string"
+                                break
+                finish = finish_raw
                 if stop_kind is not None:
-                    finish = FinishReason.STOP
+                    finish = "stop"
                 if finish is not None and stop_kind != "string":
                     # Natural end or eos stop: text still held in the decode
                     # window / jail is legitimate output — flush it. A stop
@@ -127,7 +151,7 @@ class Backend(Operator):
                         if released:
                             text_parts.append(released)
                         if matched:
-                            finish = FinishReason.STOP
+                            finish = "stop"
                         else:
                             rest = jail.flush()
                             if rest:
@@ -136,17 +160,28 @@ class Backend(Operator):
                         rest = jail.flush()
                         if rest:
                             text_parts.append(rest)
-                delta = LLMEngineOutput(
-                    token_ids=list(out.token_ids[:n_new]),
-                    text="".join(text_parts) if text_parts else None,
-                    finish_reason=finish,
-                    log_probs=list(out.log_probs[:n_new]) if out.log_probs else None,
-                    top_log_probs=out.top_log_probs[:n_new] if out.top_log_probs else None,
-                    cum_log_probs=out.cum_log_probs,
-                    kv_transfer_params=out.kv_transfer_params,
-                )
-                if delta.token_ids or delta.text or delta.finished:
-                    yield delta.to_dict()
+                if n_new or text_parts or finish is not None:
+                    delta: dict[str, Any] = {
+                        "token_ids": list(token_ids[:n_new]),
+                    }
+                    if text_parts:
+                        delta["text"] = (
+                            text_parts[0] if len(text_parts) == 1
+                            else "".join(text_parts)
+                        )
+                    if finish is not None:
+                        delta["finish_reason"] = finish
+                    log_probs = raw.get("log_probs")
+                    if log_probs:
+                        delta["log_probs"] = list(log_probs[:n_new])
+                    top_lp = raw.get("top_log_probs")
+                    if top_lp:
+                        delta["top_log_probs"] = top_lp[:n_new]
+                    if raw.get("cum_log_probs") is not None:
+                        delta["cum_log_probs"] = raw["cum_log_probs"]
+                    if raw.get("kv_transfer_params") is not None:
+                        delta["kv_transfer_params"] = raw["kv_transfer_params"]
+                    yield delta
                 if finish is not None:
                     finished = True
                     break

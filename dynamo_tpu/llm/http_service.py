@@ -484,19 +484,29 @@ class HttpService:
         last_gen = None
         failed = False
         t_first_tok = t_last_tok = None
+        # Hoisted per-stream: the hot loop below runs once per chunk.
+        write = resp.write
+        perf_counter = time.perf_counter
+        anext_ = stream.__anext__
         try:
             while head is not None:
                 gen, chunk = head
                 last_gen = gen
                 if chunk is not None:
-                    t_last_tok = time.perf_counter()
+                    t_last_tok = perf_counter()
                     if first:
                         first = False
                         t_first_tok = t_last_tok
-                        info["ttft_s"] = time.perf_counter() - t0
+                        info["ttft_s"] = t_last_tok - t0
                         self.m_ttft.observe(info["ttft_s"], model=model)
                     try:
-                        await resp.write(sse_event(json.dumps(chunk)))
+                        # Pure content deltas arrive preserialized
+                        # (EncodedSse, a bytes subclass); dict chunks (role
+                        # / logprobs / finish) serialize generically.
+                        if type(chunk) is dict:
+                            await write(sse_event(json.dumps(chunk)))
+                        else:
+                            await write(chunk)
                     except (ConnectionResetError, ConnectionError):
                         # Client went away: propagate cancellation upstream
                         # (reference: lib/llm/src/http/service/disconnect.rs).
@@ -504,7 +514,7 @@ class HttpService:
                         log.info("client disconnected mid-stream (%s)", ctx.id)
                         break
                 try:
-                    head = await stream.__anext__()
+                    head = await anext_()
                 except StopAsyncIteration:
                     head = None
         except asyncio.CancelledError:
@@ -663,7 +673,10 @@ class HttpService:
                 g, chunk = head
                 gen = g
                 if chunk is not None:
-                    delta = (chunk.get("choices") or [{}])[0].get("delta", {}).get("content")
+                    if type(chunk) is dict:
+                        delta = (chunk.get("choices") or [{}])[0].get("delta", {}).get("content")
+                    else:  # EncodedSse carries its delta text
+                        delta = chunk.text
                     if delta:
                         t_last_tok = time.perf_counter()
                         if first:

@@ -21,12 +21,7 @@ from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
-from dynamo_tpu.llm.protocols import (
-    ChatCompletionRequest,
-    CompletionRequest,
-    FinishReason,
-    LLMEngineOutput,
-)
+from dynamo_tpu.llm.protocols import ChatCompletionRequest, CompletionRequest
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 
@@ -223,13 +218,26 @@ class ModelPipeline:
         stream = self.backend.generate(pre.to_dict(), context)
         try:
             async for raw in stream:
-                out = LLMEngineOutput.from_dict(raw)
-                if out.finish_reason == FinishReason.ERROR:
-                    raise RuntimeError(out.error or "engine error")
-                finish = out.finish_reason.value if out.finish_reason else None
-                chunks = gen.on_delta(out.text, len(out.token_ids), finish,
-                                      token_ids=out.token_ids, logprobs=out.log_probs,
-                                      top_logprobs=out.top_log_probs)
+                # Hot path on the raw Backend dict: no LLMEngineOutput
+                # construction per delta, and pure text deltas render
+                # straight to a preserialized SSE frame (EncodedSse).
+                finish = raw.get("finish_reason")
+                if finish == "error":
+                    raise RuntimeError(raw.get("error") or "engine error")
+                token_ids = raw.get("token_ids") or ()
+                text = raw.get("text")
+                if finish is None and raw.get("log_probs") is None:
+                    if text:
+                        fast = gen.encode_content_chunk(text, len(token_ids))
+                        if fast is not None:
+                            yield gen, fast
+                            continue
+                    elif token_ids and gen.note_tokens_only(len(token_ids)):
+                        yield gen, None
+                        continue
+                chunks = gen.on_delta(text, len(token_ids), finish,
+                                      token_ids=token_ids, logprobs=raw.get("log_probs"),
+                                      top_logprobs=raw.get("top_log_probs"))
                 if not chunks:
                     yield gen, None
                 for c in chunks:

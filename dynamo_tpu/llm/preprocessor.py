@@ -21,13 +21,16 @@ from dynamo_tpu.llm.protocols import (
     ChatCompletionRequest,
     ChatMessage,
     CompletionRequest,
+    EncodedSse,
     OpenAIError,
     PreprocessedRequest,
     SamplingOptions,
     StopConditions,
+    _SSE_SENTINEL,
     chat_chunk,
     completion_chunk,
     gen_request_id,
+    sse_content_template,
     usage_dict,
 )
 from dynamo_tpu.llm.tokenizer import Tokenizer, load_tokenizer
@@ -255,6 +258,47 @@ class DeltaGenerator:
         self.lp_tokens: list[int] = []
         self.lp_values: list[float] = []
         self.lp_tops: list[list | None] = []
+        # Preserialized SSE envelope for pure content deltas (built lazily
+        # per stream; False = template unsplittable, use the generic path).
+        self._sse_tpl: tuple[bytes, bytes] | bool | None = None
+
+    # -- streaming fast path ------------------------------------------------
+
+    def _build_sse_template(self) -> tuple[bytes, bytes] | bool:
+        if self.kind == "chat":
+            chunk = chat_chunk(self.id, self.model, self.created, content=_SSE_SENTINEL)
+        else:
+            chunk = completion_chunk(self.id, self.model, self.created, text=_SSE_SENTINEL)
+        return sse_content_template(chunk) or False
+
+    def note_tokens_only(self, n_tokens: int) -> bool:
+        """Bookkeeping for a tokens-only delta (text still held in the stop
+        jail / decode window): count toward usage, emit no chunk. False when
+        the generic path must run instead (the first chat delta emits the
+        role chunk even without text)."""
+        if self._first and self.kind == "chat":
+            return False
+        self.completion_tokens += n_tokens
+        return True
+
+    def encode_content_chunk(self, text: str, n_tokens: int) -> EncodedSse | None:
+        """Fast path for a pure text delta: returns the fully-rendered SSE
+        frame (byte-identical to ``sse_event(json.dumps(chunk))`` of the
+        equivalent :func:`chat_chunk`/:func:`completion_chunk`) built from a
+        cached per-stream envelope — the per-delta cost is one json string
+        encode of the new text. None when the generic path must run (first
+        chunk still pending, logprobs requested, or no template)."""
+        if self.want_logprobs or (self._first and self.kind == "chat"):
+            return None
+        tpl = self._sse_tpl
+        if tpl is None:
+            tpl = self._sse_tpl = self._build_sse_template()
+        if tpl is False:
+            return None
+        self.completion_tokens += n_tokens
+        self.text_parts.append(text)
+        prefix, suffix = tpl
+        return EncodedSse(prefix + json.dumps(text).encode() + suffix, text)
 
     def _top_entries(self, top: list | None) -> list[dict]:
         """One token's ranked alternatives → OpenAI chat entries."""

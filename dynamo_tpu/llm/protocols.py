@@ -11,6 +11,7 @@ request plane, so they must stay schema-stable and language-neutral.
 
 from __future__ import annotations
 
+import json
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -751,6 +752,43 @@ def model_list(models: Iterable[str], owned_by: str = "dynamo-tpu") -> dict[str,
 
 
 # ---------------------------------------------------------------------------
+# Engine-delta coalescing (frontend streaming fast path)
+# ---------------------------------------------------------------------------
+
+
+def coalesce_delta(head: dict, tail: dict) -> dict | None:
+    """Merge two adjacent LLMEngineOutput dicts into one, or None when they
+    can't merge. Used by the engine emit layer to batch a backlog of decode
+    deltas into one wire frame. ``head`` must be an open delta (no finish/
+    error); ``tail`` may carry the finish — it rides the merged frame.
+    Merging is refused when optional per-token fields (logprobs) are
+    present on one side only, so alignment with token_ids never breaks."""
+    if head.get("finish_reason") or head.get("error") or tail.get("error"):
+        return None
+    h_ids, t_ids = head.get("token_ids") or [], tail.get("token_ids") or []
+    for key in ("log_probs", "top_log_probs"):
+        h, t = head.get(key), tail.get(key)
+        # The side missing a per-token field must have no tokens, or the
+        # merged field would misalign with the merged token_ids.
+        if (h is None) != (t is None) and (t_ids if t is None else h_ids):
+            return None
+    if head.get("text") is not None or tail.get("text") is not None:
+        return None  # detokenized deltas are not engine-mergeable
+    out = {"token_ids": h_ids + t_ids}
+    for key in ("log_probs", "top_log_probs"):
+        h, t = head.get(key), tail.get(key)
+        if h is not None or t is not None:
+            out[key] = (h or []) + (t or [])
+    for key in ("finish_reason", "cum_log_probs", "kv_transfer_params"):
+        v = tail.get(key)
+        if v is None:
+            v = head.get(key)
+        if v is not None:
+            out[key] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
 # SSE codec (reference: lib/llm/src/protocols/codec.rs:755)
 # ---------------------------------------------------------------------------
 
@@ -759,6 +797,38 @@ SSE_DONE = b"data: [DONE]\n\n"
 
 def sse_event(data: str) -> bytes:
     return f"data: {data}\n\n".encode()
+
+
+class EncodedSse(bytes):
+    """A fully-rendered ``data: ...\\n\\n`` SSE frame, spliced from a
+    per-stream preserialized envelope. ``text`` carries the raw delta text
+    so consumers that need the content (the Responses event stream) don't
+    re-parse the JSON."""
+
+    text: str
+
+    def __new__(cls, data: bytes, text: str) -> "EncodedSse":
+        self = super().__new__(cls, data)
+        self.text = text
+        return self
+
+
+_SSE_SENTINEL = "\x00@@dyntpu-delta@@\x00"
+
+
+def sse_content_template(chunk: dict[str, Any]) -> tuple[bytes, bytes] | None:
+    """→ (prefix, suffix) byte fragments of ``sse_event(json.dumps(chunk))``
+    split at ``chunk``'s sentinel-valued content field, so a per-delta frame
+    is ``prefix + json.dumps(text).encode() + suffix`` — byte-identical to
+    serializing the whole chunk dict, at the cost of encoding only the new
+    text. ``chunk`` must carry :data:`_SSE_SENTINEL` as the value of the
+    content field. None when the split isn't unambiguous."""
+    rendered = json.dumps(chunk)
+    marker = json.dumps(_SSE_SENTINEL)
+    pre, sep, post = rendered.partition(marker)
+    if not sep or marker in post:
+        return None
+    return b"data: " + pre.encode(), post.encode() + b"\n\n"
 
 
 def sse_typed_event(event: str, data: str) -> bytes:

@@ -220,6 +220,35 @@ class DecodeStream:
             return out
         return None
 
+    def step_many(self, token_ids) -> str | None:
+        """Feed a whole coalesced delta in one call: two decodes per DELTA
+        instead of two per token when the window tail is stable (the
+        overwhelmingly common case); the rare unstable tail falls back to
+        per-token stepping so held-back boundaries behave exactly as the
+        per-token path. The concatenated output is identical either way —
+        the prefix-window algorithm only advances offsets at stability
+        points, which is what makes emission granularity-independent."""
+        if not token_ids:
+            return None
+        if len(token_ids) == 1:
+            return self.step(token_ids[0])
+        start = len(self.ids)
+        self.ids.extend(int(t) for t in token_ids)
+        prefix_text = self.tokenizer.decode(
+            self.ids[self.prefix_offset : self.read_offset], self.skip_special
+        )
+        new_text = self.tokenizer.decode(self.ids[self.prefix_offset :], self.skip_special)
+        if len(new_text) > len(prefix_text) and not new_text.endswith(_REPLACEMENT):
+            out = new_text[len(prefix_text) :]
+            self.prefix_offset = self.read_offset
+            self.read_offset = len(self.ids)
+            return out
+        # Unstable tail (mid-character / merge region): replay per token to
+        # release the stable prefix and hold only the suspicious suffix.
+        del self.ids[start:]
+        parts = [p for p in (self.step(t) for t in token_ids) if p]
+        return "".join(parts) if parts else None
+
     def flush(self) -> str | None:
         """Emit whatever is still held (end of stream), replacement chars
         and all."""

@@ -16,6 +16,7 @@ flat until a cliff. A ``speedup`` divides everything for fast tests.
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
@@ -41,10 +42,21 @@ class MockerArgs:
     itl_kv_pressure: float = 1.0     # ITL multiplier at 100% KV usage: 1+this
     prefill_contention: float = 0.5  # TTFT multiplier at full slots: 1+this
     speedup: float = 1.0
-    # Tokens per emitted delta: the real engine streams K-token window
-    # bursts (engine decode_steps), not single tokens — mirror that shape
-    # so frontend-path costs are modeled per delta, not per token.
+    # Production window: the real engine samples K-token fused windows
+    # (engine decode_steps), not single tokens — tokens become emittable in
+    # groups of this size, so frontend-path costs are modeled per window.
     delta_tokens: int = 1
+    # Emit coalescing (bounded-latency): when the stream is BEHIND its
+    # simulated schedule (event loop congested — exactly when the Python
+    # frontend path is the bottleneck), all due windows batch into one
+    # frame up to this cap. 0 disables coalescing (one frame per window,
+    # the legacy shape). Coalescing adds no latency: a frame always
+    # flushes before the stream sleeps for the next not-yet-due token.
+    delta_max_tokens: int = 64
+    # Optional extra hold (simulated ms, scaled like all times): let a
+    # complete window ride through sleeps this long to gather more windows
+    # per frame. 0 = never hold across a sleep. Bounds added ITL.
+    delta_max_ms: float = 0.0
     # Seeded fault injection (runtime/chaos.py): per-step worker-kill draws.
     chaos: ChaosInjector | None = None
 
@@ -148,7 +160,35 @@ class MockerEngine:
 
             max_tokens = req.stop.max_tokens or 64
             eos = set(req.eos_token_ids) | set(req.stop.stop_token_ids)
+            want_lp = req.sampling.logprobs
+            top_n = req.sampling.top_logprobs if want_lp else 0
+            window = max(a.delta_tokens, 1)
+            cap = max(a.delta_max_tokens, window) if a.delta_max_tokens > 0 else window
+            hold_s = a.scaled(a.delta_max_ms) if a.delta_max_ms > 0 else 0.0
             burst: list[int] = []
+            burst_lps: list[float] | None = [] if want_lp else None
+            burst_tops: list | None = [] if top_n else None
+            burst_t0 = 0.0
+
+            def frame(finish: FinishReason | None = None) -> dict:
+                # One delta for everything pending — a finish discovered
+                # with a non-empty burst rides the SAME frame (never a
+                # trailing finish-only frame + extra queue hop).
+                nonlocal burst, burst_lps, burst_tops
+                d = LLMEngineOutput(
+                    token_ids=burst, finish_reason=finish,
+                    log_probs=burst_lps or None, top_log_probs=burst_tops or None,
+                ).to_dict()
+                burst = []
+                burst_lps = [] if want_lp else None
+                burst_tops = [] if top_n else None
+                return d
+
+            # Per-token due times: token i is due itl_i after token i-1.
+            # On schedule the stream sleeps between tokens and emits one
+            # frame per production window; behind schedule (loop congested)
+            # every already-due token batches into the current frame.
+            next_due = time.perf_counter()
             while emitted < max_tokens:
                 if emitted:
                     # Batch effect + KV paging pressure (superlinear near
@@ -157,12 +197,19 @@ class MockerEngine:
                     itl = a.itl_ms * (
                         1.0 + a.itl_batch_slope * max(self._active - 1, 0)
                     ) * (1.0 + a.itl_kv_pressure * usage * usage)
-                    await asyncio.sleep(a.scaled(itl))
+                    next_due += a.scaled(itl)
+                    now = time.perf_counter()
+                    if next_due > now:
+                        # About to sleep: flush completed windows unless the
+                        # hold knob lets them gather (bounded by hold_s).
+                        if len(burst) >= window and (
+                            hold_s <= 0.0 or now - burst_t0 >= hold_s
+                        ):
+                            yield frame()
+                        await asyncio.sleep(next_due - now)
                 if context.cancelled:
                     # flush the pending burst so counted tokens are delivered
-                    yield LLMEngineOutput(
-                        token_ids=burst, finish_reason=FinishReason.CANCELLED
-                    ).to_dict()
+                    yield frame(FinishReason.CANCELLED)
                     return
                 # Out of budget mid-generation: raise the typed error (the
                 # messaging layer sends it as a "deadline" err frame) — the
@@ -175,9 +222,7 @@ class MockerEngine:
                     try:
                         block_ids.append(self.pool.allocate_block())
                     except NoFreeBlocksError:
-                        yield LLMEngineOutput(
-                            token_ids=burst, finish_reason=FinishReason.LENGTH
-                        ).to_dict()
+                        yield frame(FinishReason.LENGTH)
                         return
                 sealed = block_seq.append(token)
                 emitted += 1
@@ -192,12 +237,27 @@ class MockerEngine:
                     finish = FinishReason.STOP
                 elif emitted >= max_tokens:
                     finish = FinishReason.LENGTH
+                if not burst:
+                    burst_t0 = time.perf_counter()
                 burst.append(token)
-                if finish is not None or len(burst) >= max(a.delta_tokens, 1):
-                    yield LLMEngineOutput(token_ids=burst, finish_reason=finish).to_dict()
-                    burst = []
+                if want_lp:
+                    # Deterministic fake logprobs: a pure function of the
+                    # token id, so coalesced and per-token streams must
+                    # attribute identically (frontend logprob-path tests).
+                    lp = -((token % 13) + 1) / 16.0
+                    burst_lps.append(lp)
+                    if top_n:
+                        burst_tops.append(
+                            [[token + r, lp - 0.25 * r] for r in range(top_n)]
+                        )
                 if finish is not None:
+                    yield frame(finish)
                     return
+                if len(burst) >= cap:
+                    yield frame()
+                    # Behind schedule the production loop has no awaits:
+                    # give other streams a scheduling slot per cap flush.
+                    await asyncio.sleep(0)
         finally:
             dspan.set_attrs(tokens=emitted)
             dspan.end(status="cancelled" if context.cancelled else None)

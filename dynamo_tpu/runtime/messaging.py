@@ -16,6 +16,13 @@ and ingress/push_endpoint.rs:46-136) with a single plane:
 Wire frames (msgpack maps):
   client→server: {t:"req", id, subject, payload, headers} | {t:"cancel", id}
   server→client: {t:"data", id, payload} | {t:"final", id} | {t:"err", id, error}
+
+Hot-path notes (the per-delta token stream rides this plane): data frames
+are packed against a per-request preserialized envelope prefix (no
+per-frame dict build or key re-encode), written synchronously, and drained
+only when the transport buffer actually backs up — one ``drain()`` per
+flush instead of one per frame. Sockets run with TCP_NODELAY so
+single-delta flushes aren't Nagle-delayed.
 """
 
 from __future__ import annotations
@@ -39,6 +46,16 @@ from dynamo_tpu.runtime.logging import (
 log = get_logger("messaging")
 
 Handler = Callable[[Any, Context], AsyncIterator[Any]]
+
+# Drain (backpressure) only once this much is buffered on the transport.
+# Below it, writes flush eagerly on their own and drain() would be a no-op
+# await + lock acquisition per frame.
+DRAIN_HIWAT = 64 * 1024
+
+# Queue marker: the request's Context was cancelled (the reader side
+# translates it to a clean end-of-stream instead of polling a waiter task
+# per frame).
+_CANCELLED = object()
 
 
 class StreamError(Exception):
@@ -158,14 +175,19 @@ class EndpointServer:
             await self._server.wait_closed()
 
     async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        framing.set_nodelay(writer)
         self._writers.add(writer)
         write_lock = asyncio.Lock()
         tasks: dict[str, asyncio.Task] = {}
         contexts: dict[str, Context] = {}
 
         async def send(obj) -> None:
+            # StreamWriter.write is synchronous, so frames from concurrent
+            # request tasks can't interleave; the lock only serializes
+            # drain() (asyncio allows a single drain waiter per transport).
+            writer.write(framing.pack(obj))
             async with write_lock:
-                await framing.write_frame(writer, obj)
+                await writer.drain()
 
         def abort() -> None:
             """Cut the transport without a final/err frame — the client sees
@@ -183,7 +205,7 @@ class EndpointServer:
                     ctx = self._make_context(rid, msg.get("headers") or {})
                     contexts[rid] = ctx
                     task = asyncio.get_running_loop().create_task(
-                        self._run_request(msg, ctx, send, abort)
+                        self._run_request(msg, ctx, send, abort, writer, write_lock)
                     )
                     tasks[rid] = task
                     task.add_done_callback(lambda _t, r=rid: (tasks.pop(r, None), contexts.pop(r, None)))
@@ -224,7 +246,9 @@ class EndpointServer:
                     ctx.set_timeout(timeout_s)
         return ctx
 
-    async def _run_request(self, msg: dict, ctx: Context, send, abort) -> None:
+    async def _run_request(
+        self, msg: dict, ctx: Context, send, abort, writer, write_lock
+    ) -> None:
         rid, subject = msg["id"], msg["subject"]
         handler = self._handlers.get(subject)
         if handler is None or subject in self._draining:
@@ -251,20 +275,29 @@ class EndpointServer:
         token = set_current_trace(ctx.trace)
         n_frames = 0
         gen = handler(msg.get("payload"), ctx)
+        # Per-request preserialized data-frame envelope: each delta packs
+        # only its payload; write is synchronous and drain happens once per
+        # backed-up flush, not once per frame.
+        data_prefix = framing.map3_prefix("t", "data", "id", rid, "payload")
+        transport = writer.transport
+        chaos = self.chaos
         try:
             ctx.check_deadline()  # expired in transit/queue: don't start work
             async for item in gen:
                 if ctx.cancelled:
                     break
                 ctx.check_deadline()
-                if self.chaos is not None:
-                    await self.chaos.inject_latency()
-                    if self.chaos.should_drop_frame():
+                if chaos is not None:
+                    await chaos.inject_latency()
+                    if chaos.should_drop_frame():
                         span.end(status="chaos:frame_drop")
                         abort()
                         return
-                await send({"t": "data", "id": rid, "payload": item})
+                writer.write(framing.pack_prefixed(data_prefix, item))
                 n_frames += 1
+                if transport.get_write_buffer_size() > DRAIN_HIWAT:
+                    async with write_lock:
+                        await writer.drain()
             if self.chaos is not None and self.chaos.should_truncate():
                 span.end(status="chaos:truncate")
                 abort()
@@ -364,6 +397,7 @@ class MessageClient:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(addr[0], addr[1]), self.connect_timeout
             )
+            framing.set_nodelay(writer)
             conn = _Connection(reader, writer)
             conn.start_pump()
             self._conns[addr] = conn
@@ -417,31 +451,38 @@ class MessageClient:
             raise TruncatedStreamError(f"connection to {addr} lost on send") from e
 
         async def _gen() -> AsyncIterator[Any]:
-            cancel_waiter = asyncio.get_running_loop().create_task(context.wait_cancelled())
+            # ONE waiter task per call (not per frame): on cancellation it
+            # drops a marker into the response queue, so the hot loop below
+            # is a bare queue.get() per frame — no asyncio.wait fan-in, no
+            # getter task churn per token.
+            async def _pump_cancel() -> None:
+                await context.wait_cancelled()
+                queue.put_nowait(_CANCELLED)
+
+            cancel_waiter = asyncio.get_running_loop().create_task(_pump_cancel())
+            has_deadline = context.deadline is not None
             finished = False
             try:
                 while True:
-                    getter = asyncio.get_running_loop().create_task(queue.get())
-                    # The wait is bounded by the request deadline: a stalled
-                    # worker (or injected latency) can't hold the caller past
-                    # its budget — the finally-block cancel frame frees the
-                    # worker side.
-                    done, _ = await asyncio.wait(
-                        {getter, cancel_waiter},
-                        return_when=asyncio.FIRST_COMPLETED,
-                        timeout=context.time_remaining(),
-                    )
-                    if not done:  # deadline hit while waiting
-                        getter.cancel()
-                        span.end(status="deadline")
-                        raise DeadlineExceededError(
-                            f"request {context.id} exceeded its deadline awaiting {addr}"
-                        )
-                    if cancel_waiter in done and getter not in done:
-                        getter.cancel()
+                    if not has_deadline:
+                        msg = await queue.get()
+                    else:
+                        # The wait is bounded by the request deadline: a
+                        # stalled worker (or injected latency) can't hold the
+                        # caller past its budget — the finally-block cancel
+                        # frame frees the worker side.
+                        try:
+                            msg = await asyncio.wait_for(
+                                queue.get(), context.time_remaining()
+                            )
+                        except asyncio.TimeoutError:
+                            span.end(status="deadline")
+                            raise DeadlineExceededError(
+                                f"request {context.id} exceeded its deadline awaiting {addr}"
+                            ) from None
+                    if msg is _CANCELLED:
                         span.end(status="cancelled")
                         return
-                    msg = getter.result()
                     if msg is None:
                         span.end(status="error:truncated")
                         raise TruncatedStreamError(f"stream from {addr} truncated")

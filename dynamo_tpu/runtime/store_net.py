@@ -80,6 +80,7 @@ class StoreServer:
         await self.store.close()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        framing.set_nodelay(writer)
         conn_leases: set[int] = set()
         conn_watches: dict[int, tuple[Watch, asyncio.Task]] = {}
         write_lock = asyncio.Lock()
@@ -203,6 +204,7 @@ class TcpStoreClient(KeyValueStore):
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        framing.set_nodelay(self._writer)
         self._pump = asyncio.get_running_loop().create_task(self._pump_loop())
 
     async def _pump_loop(self) -> None:

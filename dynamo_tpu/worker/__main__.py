@@ -58,6 +58,12 @@ def parse_args(argv=None):
     p.add_argument("--max-num-seqs", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--decode-steps", type=int, default=8)
+    # Streaming delta coalescing (both engines): cap on tokens merged into
+    # one wire frame when a stream's consumer lags (0 = one frame per
+    # decode window), and an optional bounded gather wait in ms (adds up
+    # to that much ITL; keep <= one decode step).
+    p.add_argument("--delta-max-tokens", type=int, default=64)
+    p.add_argument("--delta-max-ms", type=float, default=0.0)
     p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
                    default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -99,7 +105,7 @@ def parse_args(argv=None):
     p.add_argument("--mocker-itl-ms", type=float, default=5.0)
     p.add_argument("--mocker-speedup", type=float, default=1.0)
     p.add_argument("--mocker-delta-tokens", type=int, default=1,
-                   help="tokens per emitted delta (mirror engine window bursts)")
+                   help="tokens per simulated decode window (mirror engine decode_steps)")
     args = p.parse_args(argv)
     if args.engine == "mocker" and (args.remote_prefill or args.is_prefill_worker):
         # The disagg handlers drive the real engine's KV extract/inject
@@ -162,6 +168,8 @@ async def build_engine(args, config=None):
                 itl_ms=args.mocker_itl_ms,
                 speedup=args.mocker_speedup,
                 delta_tokens=args.mocker_delta_tokens,
+                delta_max_tokens=args.delta_max_tokens,
+                delta_max_ms=args.delta_max_ms,
                 # Env-driven fault injection (DYNTPU_CHAOS_*): engine-level
                 # kill draws; the messaging layer reads the same section.
                 chaos=ChaosInjector.from_config(cfg.chaos),
@@ -357,6 +365,8 @@ def _engine_args(args, model):
         dtype=args.dtype,
         tp=args.tp,
         decode_steps=args.decode_steps,
+        delta_max_tokens=args.delta_max_tokens,
+        delta_max_ms=args.delta_max_ms,
         attn_impl=args.attn_impl,
         quant=args.quant,
         host_kv_blocks=args.host_kv_blocks,

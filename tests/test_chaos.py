@@ -60,7 +60,14 @@ async def start_chaos_worker(
     """One in-process 'worker': its own runtime (own EndpointServer, so
     chaos config and connection cuts are per-worker, like real processes)."""
     rt = await DistributedRuntime.create(store_url=store_url, config=config)
-    engine = MockerEngine(mocker or MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0))
+    # delta_max_tokens=0: per-token frames. Chaos scenarios cut transports
+    # BETWEEN frames (frame drops, mid-stream kills followed by migration);
+    # emit coalescing would collapse a speedup-1000 stream into ~one frame
+    # and both starve the per-frame fault draws and shift the seeded draw
+    # sequence.
+    engine = MockerEngine(mocker or MockerArgs(
+        block_size=4, num_kv_blocks=256, speedup=1000.0, delta_max_tokens=0,
+    ))
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)
 
@@ -139,7 +146,7 @@ def test_chaos_engine_kills_without_migration_surface_typed_errors():
         # Engine-level kill draws (ChaosKillError → transport cut).
         cfg = plain_config()
         mocker = MockerArgs(
-            block_size=4, num_kv_blocks=256, speedup=1000.0,
+            block_size=4, num_kv_blocks=256, speedup=1000.0, delta_max_tokens=0,
             chaos=ChaosInjector(ChaosConfig(enabled=True, seed=SEED, kill_p=0.08)),
         )
         w = await start_chaos_worker(url, cfg, mocker)
@@ -169,7 +176,7 @@ def test_chaos_engine_kills_with_migration_complete():
     async def go():
         url = "memory://chaos_kill1"
         mocker = MockerArgs(
-            block_size=4, num_kv_blocks=512, speedup=1000.0,
+            block_size=4, num_kv_blocks=512, speedup=1000.0, delta_max_tokens=0,
             chaos=ChaosInjector(ChaosConfig(enabled=True, seed=SEED, kill_p=0.05)),
         )
         w1 = await start_chaos_worker(url, plain_config(), mocker)
@@ -386,7 +393,8 @@ async def start_http_worker(store_url, itl_ms=0.0, namespace="chaos"):
 
     rt = await DistributedRuntime.create(store_url=store_url, config=plain_config())
     speedup = 1.0 if itl_ms else 1000.0
-    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=256, itl_ms=itl_ms or 5.0, speedup=speedup))
+    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=256, itl_ms=itl_ms or 5.0,
+                                     speedup=speedup, delta_max_tokens=0))
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)
 

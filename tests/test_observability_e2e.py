@@ -50,8 +50,12 @@ def fast_config(chaos: ChaosConfig | None = None) -> Config:
 async def start_worker(store_url, namespace="obs", chaos=None, migration_limit=0,
                        mocker: MockerArgs | None = None):
     rt = await DistributedRuntime.create(store_url=store_url, config=fast_config(chaos))
+    # delta_max_tokens=0: per-window frames. The chaos/migration assertions
+    # need multi-frame streams (a mid-stream cut only exists between
+    # frames); emit coalescing would ship a whole fast burst in one frame.
     engine = MockerEngine(
-        mocker or MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0)
+        mocker or MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0,
+                             delta_max_tokens=0)
     )
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)

@@ -1,0 +1,26 @@
+"""Tier-1 guard for tools/profile_frontend.py: the profiler boots its
+whole harness (store server + mocker worker + frontend + client
+subprocesses) in --quick mode and asserts completion + exact token
+accounting itself — so the tool can't bit-rot between perf rounds.
+
+No timing assertions: --quick makes no throughput claims.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_frontend_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_frontend.py"),
+         "--quick", "--json"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    # --quick prints QUICK-OK only after its internal accounting asserts
+    # (errors == 0, delivered tokens == streams * gen_len) passed.
+    assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]

@@ -1,0 +1,312 @@
+"""Golden stream-equivalence: coalesced (delta_max_tokens > 1) and
+per-token streaming must be indistinguishable to the client — identical
+concatenated text, finish_reason, usage, and valid SSE chunk JSON — across
+the mocker and the frontend operator chain (Backend → DeltaGenerator).
+
+Also pins the streaming fast paths introduced with coalescing:
+- mocker: burst + finish ride ONE frame (no trailing finish-only frame);
+- DecodeStream.step_many == per-token stepping, concatenated;
+- stop strings and top_logprobs straddling a coalesced delta boundary
+  truncate/attribute exactly as in per-token mode;
+- the preserialized SSE envelope is byte-identical to json.dumps of the
+  equivalent chunk dict.
+"""
+
+import asyncio
+import json
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.preprocessor import DeltaGenerator
+from dynamo_tpu.llm.protocols import (
+    EncodedSse,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    chat_chunk,
+    coalesce_delta,
+    completion_chunk,
+    sse_event,
+)
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.engine import Context, collect
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def mocker(delta_tokens=1, delta_max_tokens=0, delta_max_ms=0.0, **kw):
+    d = dict(block_size=4, num_kv_blocks=256, max_num_seqs=32, speedup=1000.0)
+    d.update(kw)
+    return MockerEngine(MockerArgs(
+        delta_tokens=delta_tokens, delta_max_tokens=delta_max_tokens,
+        delta_max_ms=delta_max_ms, **d,
+    ))
+
+
+def req(prompt_text="the quick brown fox jumps over the lazy dog ",
+        max_tokens=48, **kw) -> PreprocessedRequest:
+    tok = ByteTokenizer()
+    r = PreprocessedRequest(model="mock", token_ids=tok.encode(prompt_text))
+    r.stop.max_tokens = max_tokens
+    r.stop.ignore_eos = True
+    for k, v in kw.items():
+        setattr(r.stop, k, v) if hasattr(r.stop, k) else setattr(r, k, v)
+    return r
+
+
+class Summary:
+    """Client-observable view of one streamed request."""
+
+    def __init__(self, outs: list[dict]):
+        self.text = "".join(o.get("text") or "" for o in outs)
+        self.tokens = [t for o in outs for t in o.get("token_ids") or []]
+        self.finish = outs[-1].get("finish_reason")
+        self.log_probs = [
+            lp for o in outs for lp in o.get("log_probs") or []
+        ]
+        self.top_log_probs = [
+            t for o in outs for t in o.get("top_log_probs") or []
+        ]
+
+    def key(self):
+        return (self.text, self.tokens, self.finish, self.log_probs,
+                self.top_log_probs)
+
+
+def drive(engine, request: PreprocessedRequest) -> list[dict]:
+    backend = Backend(engine, ByteTokenizer())
+    return run(collect(backend.generate(request.to_dict(), Context())))
+
+
+# -- engine-level equivalence ------------------------------------------------
+
+
+def test_mocker_coalesced_equals_per_token():
+    """Same request, four framing shapes → identical client view."""
+    shapes = [
+        dict(delta_tokens=1, delta_max_tokens=0),    # legacy per-token
+        dict(delta_tokens=1, delta_max_tokens=64),   # backlog coalescing
+        dict(delta_tokens=4, delta_max_tokens=0),    # window bursts
+        dict(delta_tokens=4, delta_max_tokens=64, delta_max_ms=5.0),
+    ]
+    views = [Summary(drive(mocker(**s), req())).key() for s in shapes]
+    assert all(v == views[0] for v in views), views
+    assert views[0][2] == "length"
+
+
+def test_mocker_finish_rides_the_burst_frame():
+    """Satellite: finish with a non-empty pending burst is ONE frame, never
+    a burst frame + a trailing finish-only frame."""
+    for shape in (
+        dict(delta_tokens=1, delta_max_tokens=64),
+        dict(delta_tokens=8, delta_max_tokens=0),
+        dict(delta_tokens=3, delta_max_tokens=0),   # max_tokens % window != 0
+    ):
+        outs = run(collect(mocker(**shape).generate(req(max_tokens=8).to_dict(),
+                                                    Context())))
+        assert outs[-1].get("finish_reason") == "length"
+        assert outs[-1].get("token_ids"), "finish frame lost its burst"
+        assert sum(len(o.get("token_ids") or []) for o in outs) == 8
+        # No frame after the finish frame, and no empty filler frames.
+        assert all(o.get("token_ids") for o in outs)
+
+
+def test_mocker_coalescing_caps_frame_size():
+    outs = run(collect(
+        mocker(delta_tokens=1, delta_max_tokens=4).generate(
+            req(max_tokens=32).to_dict(), Context())
+    ))
+    sizes = [len(o.get("token_ids") or []) for o in outs]
+    assert max(sizes) <= 4
+    assert sum(sizes) == 32
+
+
+# -- stop sequences / logprobs across delta boundaries -----------------------
+
+
+def test_stop_string_across_coalesced_boundary():
+    """A stop string whose characters straddle a coalesced delta must
+    truncate at the same point and count the same tokens as per-token mode."""
+    # Echoed prompt contains "END" such that coalesced frames of 5 split it.
+    prompt = "abcdENDxyz"
+    per_tok = req(prompt, max_tokens=10)
+    per_tok.stop.stop = ["END"]
+    coal = req(prompt, max_tokens=10)
+    coal.stop.stop = ["END"]
+    a = Summary(drive(mocker(delta_tokens=1, delta_max_tokens=0), per_tok))
+    b = Summary(drive(mocker(delta_tokens=5, delta_max_tokens=64), coal))
+    assert a.finish == b.finish == "stop"
+    assert a.text == b.text == "abcd"
+    assert a.tokens == b.tokens  # same tokens consumed → same usage
+
+
+def test_top_logprobs_across_coalesced_boundary():
+    """top_logprobs attribution must be identical when token windows
+    straddle a coalesced frame boundary."""
+    shapes = [
+        dict(delta_tokens=1, delta_max_tokens=0),
+        dict(delta_tokens=3, delta_max_tokens=64),
+        dict(delta_tokens=1, delta_max_tokens=7),
+    ]
+    views = []
+    for s in shapes:
+        r = req(max_tokens=20)
+        r.sampling.logprobs = True
+        r.sampling.top_logprobs = 3
+        views.append(Summary(drive(mocker(**s), r)))
+    base = views[0]
+    assert len(base.log_probs) == 20
+    assert len(base.top_log_probs) == 20
+    assert all(len(t) == 3 for t in base.top_log_probs)
+    for v in views[1:]:
+        assert v.key() == base.key()
+
+
+def test_stop_token_truncates_aligned_logprobs_mid_delta():
+    """An eos/stop token inside a coalesced delta cuts token_ids AND the
+    logprob lists at the same position (never a misaligned tail)."""
+    tok = ByteTokenizer()
+    prompt = tok.encode("ab") + [ByteTokenizer.EOS] + tok.encode("zz")
+    r = PreprocessedRequest(model="mock", token_ids=prompt,
+                            eos_token_ids=[ByteTokenizer.EOS])
+    r.stop.max_tokens = 10
+    r.sampling.logprobs = True
+    outs = drive(mocker(delta_tokens=1, delta_max_tokens=64), r)
+    s = Summary(outs)
+    assert s.finish == "stop"
+    assert s.text == "ab"
+    assert len(s.log_probs) == len(s.tokens)
+
+
+# -- SSE chunk layer ---------------------------------------------------------
+
+
+def sse_chunks(outs: list[dict], kind="chat", prompt_tokens=0) -> list[bytes]:
+    gen = DeltaGenerator(model="mock", kind=kind, prompt_tokens=prompt_tokens)
+    frames: list[bytes] = []
+    for o in outs:
+        text = o.get("text")
+        finish = o.get("finish_reason")
+        fast = None
+        if text and finish is None and o.get("log_probs") is None:
+            fast = gen.encode_content_chunk(text, len(o.get("token_ids") or []))
+        if fast is not None:
+            frames.append(fast)
+            continue
+        for c in gen.on_delta(text, len(o.get("token_ids") or []), finish,
+                              token_ids=o.get("token_ids"),
+                              logprobs=o.get("log_probs"),
+                              top_logprobs=o.get("top_log_probs")):
+            frames.append(sse_event(json.dumps(c)))
+    return frames
+
+
+def test_sse_chunks_valid_json_and_equivalent_usage():
+    """Every SSE frame parses as valid chunk JSON; coalesced and per-token
+    streams agree on concatenated content, finish_reason, and usage."""
+    def render(shape):
+        outs = drive(mocker(**shape), req(max_tokens=24))
+        frames = sse_chunks(outs, prompt_tokens=len(req().token_ids))
+        payloads = [json.loads(f.decode()[len("data: "):]) for f in frames]
+        text = "".join(
+            (p["choices"][0]["delta"].get("content") or "") for p in payloads
+        )
+        finish = [p["choices"][0]["finish_reason"] for p in payloads if
+                  p["choices"][0]["finish_reason"]]
+        usage = [p["usage"] for p in payloads if p.get("usage")]
+        for p in payloads:
+            assert p["object"] == "chat.completion.chunk"
+            assert p["model"] == "mock"
+        return text, finish, usage
+
+    a = render(dict(delta_tokens=1, delta_max_tokens=0))
+    b = render(dict(delta_tokens=1, delta_max_tokens=64))
+    c = render(dict(delta_tokens=6, delta_max_tokens=64))
+    assert a == b == c
+    assert a[1] == ["length"]
+    assert a[2] == [{"prompt_tokens": len(req().token_ids),
+                     "completion_tokens": 24,
+                     "total_tokens": len(req().token_ids) + 24}]
+
+
+def test_preserialized_sse_is_byte_identical_to_generic_path():
+    """Tentpole invariant: the cached-envelope splice must produce the
+    EXACT bytes json.dumps of the equivalent chunk dict produces."""
+    for kind, builder in (("chat", chat_chunk), ("completion", completion_chunk)):
+        gen = DeltaGenerator(model="m odel-\"x\"", kind=kind)
+        if kind == "chat":
+            gen.on_delta("", 0, None)  # consume the first-chunk (role) path
+        for text in ("hello", 'quotes " and \\ backslash', "uni 漢字 🎉", "\n\t"):
+            fast = gen.encode_content_chunk(text, 1)
+            assert isinstance(fast, EncodedSse)
+            assert fast.text == text
+            kw = {"content": text} if kind == "chat" else {"text": text}
+            want = sse_event(json.dumps(
+                builder(gen.id, gen.model, gen.created, **kw)
+            ))
+            assert bytes(fast) == want
+
+
+def test_encode_content_chunk_defers_to_generic_path():
+    # First chat chunk must carry the role delta → no fast path yet.
+    gen = DeltaGenerator(model="m", kind="chat")
+    assert gen.encode_content_chunk("x", 1) is None
+    gen.on_delta("x", 1, None)
+    assert gen.encode_content_chunk("y", 1) is not None
+    # Logprobs streams always use the generic path.
+    lp = DeltaGenerator(model="m", kind="chat", want_logprobs=True)
+    lp.on_delta("x", 1, None)
+    assert lp.encode_content_chunk("y", 1) is None
+
+
+def test_fast_path_bookkeeping_feeds_final_response():
+    """Fast-path chunks still accumulate text/usage for aggregation and
+    tool-call parsing at finish."""
+    gen = DeltaGenerator(model="m", kind="chat")
+    gen.on_delta("he", 1, None)
+    assert gen.encode_content_chunk("llo", 2) is not None
+    gen.on_delta(None, 1, "stop")
+    final = gen.final_response()
+    assert final["choices"][0]["message"]["content"] == "hello"
+    assert final["usage"]["completion_tokens"] == 4
+
+
+# -- step_many / coalesce_delta units ---------------------------------------
+
+
+def test_decode_stream_step_many_matches_per_token():
+    tok = ByteTokenizer()
+    text = "héllo 漢字 🎉 plain tail"
+    ids = tok.encode(text)
+    for cut in (1, 2, 3, 5, len(ids)):
+        a, b = DecodeStream(tok), DecodeStream(tok)
+        out_a = [p for p in (a.step(t) for t in ids) if p]
+        out_b = []
+        for i in range(0, len(ids), cut):
+            p = b.step_many(ids[i:i + cut])
+            if p:
+                out_b.append(p)
+        for ds, out in ((a, out_a), (b, out_b)):
+            tail = ds.flush()
+            if tail:
+                out.append(tail)
+        assert "".join(out_b) == "".join(out_a) == text
+
+
+def test_coalesce_delta_merge_rules():
+    a = LLMEngineOutput(token_ids=[1, 2], log_probs=[-0.1, -0.2]).to_dict()
+    b = LLMEngineOutput(token_ids=[3], log_probs=[-0.3],
+                        finish_reason=None).to_dict()
+    merged = coalesce_delta(a, b)
+    assert merged == {"token_ids": [1, 2, 3], "log_probs": [-0.1, -0.2, -0.3]}
+    # finish on the tail rides the merged frame
+    fin = coalesce_delta(merged, {"token_ids": [], "finish_reason": "stop"})
+    assert fin["finish_reason"] == "stop" and fin["token_ids"] == [1, 2, 3]
+    # a closed head never merges
+    assert coalesce_delta(fin, {"token_ids": [9]}) is None
+    # one-sided logprobs with tokens to cover → refuse (alignment)
+    assert coalesce_delta(a, {"token_ids": [4]}) is None
+    assert coalesce_delta({"token_ids": [0]}, b) is None
+    # errors never merge
+    assert coalesce_delta(a, {"error": "boom", "finish_reason": "error"}) is None
