@@ -8,6 +8,7 @@ All shapes that reach jit are derived here and static.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from dataclasses import dataclass, field
 
@@ -196,12 +197,35 @@ class EngineArgs:
     # throughput loss on ramp-up); too large starves running decodes.
     # 0 = admit until slots are full.
     admission_budget_tokens: int = 8192
-    # Keep one decode window in flight: window w+1 is dispatched chaining
+    # Keep decode windows in flight: window w+1 is dispatched chaining
     # from w's on-device outputs before w is fetched, hiding the
     # host↔device sync roundtrip (~100 ms on tunneled TPUs). Stops are
-    # then discovered one window late (≤decode_steps wasted tokens per
-    # finished sequence). Full-sampler batches always run unpipelined.
+    # then discovered up to pipeline_depth windows late (≤ depth ×
+    # decode_steps wasted tokens per finished sequence). Full-sampler
+    # batches always run unpipelined.
     pipeline_windows: bool = True
+    # Max decode windows dispatched-but-not-fetched at once (0 = drain
+    # each window before dispatching the next, i.e. unpipelined; 1 = the
+    # classic one-window pipeline). Depth 2 lets the host ride out a full
+    # fetch roundtrip of jitter without ever idling the device; deeper
+    # only adds stop-discovery latency. Fetches are started async at
+    # dispatch (copy_to_host_async) and harvested by readiness polling,
+    # so the host blocks only when the pipeline is full.
+    pipeline_depth: int = 2
+    # Prefill T-bucket ladder: "fine" (default) inserts 1.5x midpoints
+    # into the pow2 ladder through the common range (≤512), halving the
+    # worst-case pad; "coarse" is the legacy 2x/4x ladder (fewest
+    # compiles); a comma list ("64,128,384") pins an explicit schedule
+    # (values round up to block_size multiples; max_prefill_tokens is
+    # always appended). Each bucket × table-width pair is one compile —
+    # warm the lattice (bench.py --precompile-only) after widening.
+    prefill_buckets_spec: str = "fine"
+    # Split a suffix whose bucket pad is large into [bucket-sized chunk,
+    # re-bucketed tail] chunked-prefill dispatches: a 600-token suffix
+    # runs as 512 + (88→96) instead of padding a whole 1024 row. Exact
+    # (chunked prefill is exact); costs one extra dispatch, so only
+    # splits that save ≥ 2 blocks of padding are taken.
+    prefill_tail_split: bool = True
     # Max sequences packed into one prefill dispatch (model.prefill_batch).
     # Default 1 (singles): packing existed because r3 paid a host sync per
     # admission, but async admission pipelines single-row prefills with no
@@ -222,6 +246,12 @@ class EngineArgs:
     disk_kv_blocks: int = 4096
 
     def __post_init__(self):
+        # Fail fast on a mistyped ladder spec: anything that is not a
+        # named schedule must parse as a comma list of ints, or the error
+        # would otherwise surface as a bare int() ValueError deep inside
+        # the first bucket_prefill call.
+        if self.prefill_buckets_spec not in ("fine", "coarse"):
+            self._parse_bucket_list(self.prefill_buckets_spec)
         if self.max_model_len % self.block_size:
             self.max_model_len = ((self.max_model_len // self.block_size) + 1) * self.block_size
         if self.max_prefill_tokens % self.block_size:
@@ -234,25 +264,47 @@ class EngineArgs:
     def blocks_per_seq(self) -> int:
         return self.max_model_len // self.block_size
 
-    @property
+    # Bucket ladders are cached_properties: bucket_prefill/bucket_decode/
+    # bucket_table run on the scheduler hot thread (plan_prefill_chunks
+    # probes the ladder O(buckets) times per admitted suffix), so the
+    # tuple must be built once, not re-derived per access. EngineArgs is
+    # effectively frozen after construction; replace() makes a new
+    # instance with a fresh cache.
+    @functools.cached_property
     def prefill_buckets(self) -> tuple[int, ...]:
-        # 2x stride through the common range, 4x beyond 512: prefill is
-        # where the FLOPs are, and a 4x stride meant a median ShareGPT
-        # prompt (~130 tok) padded to 512 — measured as ~2/3 of the 8B
-        # bench's device time going to prefill padding (BENCH r5 phase
-        # breakdown). Each (Bp x T x W) combination is still a separate
-        # compile, so the stride widens again past 512 where real prompts
-        # thin out.
-        lo = min(max(self.block_size * 2, 32), self.max_prefill_tokens)
+        # Prefill is where the FLOPs are: every padded token runs the
+        # full model, so the ladder's stride IS the pad waste (r5 bench:
+        # pad_ratio 1.45 on the legacy 2x/4x ladder). "fine" adds 1.5x
+        # midpoints to the pow2 ladder through the common range (≤512,
+        # where real ShareGPT prompts live) and stays 2x beyond — the
+        # tail-split planner (plan_prefill_chunks) covers the long range
+        # without more buckets. Values stay block_size-aligned (model.py
+        # scatter contract) and each (Bp x T x W) combination is still a
+        # separate compile, so the ladder is a knob, not a free lunch.
+        spec = self.prefill_buckets_spec
+        bs = self.block_size
+        if spec not in ("fine", "coarse"):
+            vals = sorted({
+                min(-(-x // bs) * bs, self.max_prefill_tokens)
+                for x in self._parse_bucket_list(spec)
+            })
+            return tuple(dict.fromkeys(vals + [self.max_prefill_tokens]))
+        lo = min(max(bs * 2, 32), self.max_prefill_tokens)
         out = []
         b = lo
         while b < self.max_prefill_tokens:
             out.append(b)
-            b *= 2 if b < 512 else 4
+            if spec == "fine":
+                mid = -(-(b * 3 // 2) // bs) * bs  # 1.5x, block-aligned
+                if b < 512 and mid < self.max_prefill_tokens and mid > b:
+                    out.append(mid)
+                b *= 2
+            else:
+                b *= 2 if b < 512 else 4
         out.append(self.max_prefill_tokens)
-        return tuple(dict.fromkeys(out))
+        return tuple(dict.fromkeys(sorted(out)))
 
-    @property
+    @functools.cached_property
     def decode_buckets(self) -> tuple[int, ...]:
         # Floor of 8, 4x stride: decode steps are parameter-bandwidth-
         # bound and padded rows cost ~nothing in the Pallas attention
@@ -261,7 +313,7 @@ class EngineArgs:
         # most expensive compiles, 20-40s each on the tunnel).
         return _pow2_buckets(min(8, self.max_num_seqs), self.max_num_seqs, factor=4)
 
-    @property
+    @functools.cached_property
     def table_buckets(self) -> tuple[int, ...]:
         """Block-table width ladder. Decode/prefill attention cost scales
         with the table width actually passed (model.py derives W from the
@@ -289,6 +341,47 @@ class EngineArgs:
                 return b
         raise ValueError(f"prefill of {n} tokens exceeds max_prefill_tokens={self.max_prefill_tokens}")
 
+    @staticmethod
+    def _parse_bucket_list(spec: str) -> list[int]:
+        """Parse an explicit comma-list bucket spec; the ONE shared parse
+        for __post_init__ (fail fast at construction) and the ladder
+        builder, so validation can't drift from use."""
+        try:
+            vals = [int(x) for x in spec.split(",") if x.strip()]
+        except ValueError:
+            vals = []
+        if not vals or any(v <= 0 for v in vals):
+            raise ValueError(
+                f"prefill_buckets_spec must be 'fine', 'coarse' or a comma "
+                f"list of positive ints; got {spec!r}"
+            )
+        return vals
+
+    def plan_prefill_chunks(self, sfx: int) -> list[int]:
+        """Chunk plan for one suffix of ``sfx`` tokens (≤ max_prefill_tokens):
+        ``[sfx]`` = one dispatch padded to its bucket, or ``[c1, sfx-c1]``
+        when splitting the tail into a smaller bucket saves ≥ 2 blocks of
+        padding. ``c1`` is a bucket value, hence block-aligned, so the
+        second chunk starts on a block boundary (model.py scatter
+        contract). Chunked prefill is exact, so the split never changes
+        tokens — only the padded-FLOPs bill."""
+        direct = self.bucket_prefill(sfx)
+        if not self.prefill_tail_split or direct == sfx:
+            return [sfx]
+        best, best_cost = [sfx], direct
+        for c1 in self.prefill_buckets:
+            if c1 >= sfx:
+                break
+            cost = c1 + self.bucket_prefill(sfx - c1)
+            # <= : on cost ties the LARGEST first chunk wins (600 →
+            # [512, 88→96], not [96, 504]) — one bucket-sized chunk plus
+            # a small tail, as documented.
+            if cost <= best_cost:
+                best, best_cost = [c1, sfx - c1], cost
+        if direct - best_cost >= 2 * self.block_size:
+            return best
+        return [sfx]
+
     def bucket_prefill_rows(self, n: int) -> int:
         # Pow2 row ladder: steady-state admission waves are small (1-3
         # slots free per step), and padding a 2-seq wave to 8 rows cost
@@ -303,6 +396,11 @@ class EngineArgs:
             if n <= b:
                 return b
         raise ValueError(f"decode batch {n} exceeds max_num_seqs={self.max_num_seqs}")
+
+    @property
+    def effective_pipeline_depth(self) -> int:
+        """pipeline_windows is the master enable; depth 0 = unpipelined."""
+        return max(0, self.pipeline_depth) if self.pipeline_windows else 0
 
     def kv_bytes_per_block(self) -> int:
         m = self.model

@@ -12,11 +12,18 @@ dedicated thread; asyncio callers submit requests through a lock-guarded
 queue and receive ``LLMEngineOutput`` dicts on per-request asyncio queues
 via ``loop.call_soon_threadsafe``.
 
-Host↔device sync budget (the latency cost model): one sync per
+Host↔device sync budget (the latency cost model): one *fetch* per
 ``decode_steps``-token fused window (model.multi_decode feeds sampled
 tokens back on device) and one per admission wave (all first tokens
-sampled together). Per-step syncing (decode_steps=1) is the fallback for
-full-sampler batches and near-max_model_len sequences.
+sampled together) — and the host starts every fetch asynchronously at
+dispatch time (``copy_to_host_async``), harvesting results from a FIFO
+completion queue by readiness polling. The scheduler therefore blocks on
+a fetch only when the window pipeline is full (``pipeline_depth``
+windows in flight) or a consumer needs host-visible tokens (full
+sampler, per-step path, preemption); admission, prefill dispatch and the
+next window dispatch all proceed while fetches are in flight. Per-step
+syncing (decode_steps=1) is the fallback for full-sampler batches and
+near-max_model_len sequences.
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ import numpy as np
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.engine.runner import host_ready, start_host_fetch
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
 from dynamo_tpu.llm.protocols import (
@@ -125,6 +133,61 @@ class _Window:
         self.row_of = {s: i for i, s in enumerate(rows)}
         self.top_n = top_n
 
+    def fetch_arrays(self) -> list:
+        a = [self.ref.arrs[0], self.ref.arrs[1]]
+        if self.top_n:
+            a += [self.ref.arrs[2], self.ref.arrs[3]]
+        return a
+
+
+class _First:
+    """One dispatched admission wave's first-token sample (not yet
+    fetched). Entries: (seq, row) into the wave's padded sample batch."""
+
+    __slots__ = ("entries", "out_d", "lps_d", "top_ref")
+
+    def __init__(self, entries: list[tuple[_Seq, int]], out_d, lps_d, top_ref):
+        self.entries = entries
+        self.out_d = out_d
+        self.lps_d = lps_d
+        self.top_ref = top_ref
+
+    def fetch_arrays(self) -> list:
+        a = [self.out_d, self.lps_d]
+        if self.top_ref is not None:
+            a += [self.top_ref.arrs[0], self.top_ref.arrs[1]]
+        return a
+
+
+# Host-side phases during which the scheduler thread is (or may be)
+# BLOCKED on a device fetch/sync — the bench.py host_blocked_frac
+# numerator. drain_ready is included conservatively: is_ready() reflects
+# device COMPUTE completion, not arrival of the async D2H copy, so a
+# "ready" drain's np.asarray can still wait out the transfer tail on a
+# slow link; counting it keeps the metric an honest upper bound (it is
+# ~µs when overlap works, which is the claim being measured).
+BLOCKING_PHASES = ("first_sample", "drain_sync", "drain_ready", "single_step")
+
+
+def register_engine_metrics(registry):
+    """Register the engine gauges on a MetricsRegistry → (inflight
+    windows, pending first fetches, prefill pad ratio). Shared by the
+    worker (bind_metrics) and the tools/check_metrics.py catalog guard."""
+    return (
+        registry.gauge(
+            "engine_inflight_windows",
+            "Decode windows dispatched on device but not yet drained",
+        ),
+        registry.gauge(
+            "engine_pending_first_fetches",
+            "Admission first-token sample fetches in flight",
+        ),
+        registry.gauge(
+            "engine_prefill_pad_ratio",
+            "Cumulative dispatched/true prefill token ratio (bucket padding waste)",
+        ),
+    )
+
 
 class TpuEngine:
     def __init__(
@@ -162,13 +225,15 @@ class TpuEngine:
         self._waiting: collections.deque[_Seq] = collections.deque()
         self._running: list[_Seq] = []
         self._stopping = False
-        self._inflight: _Window | None = None
-        # Async admission: first tokens are sampled on device and folded
-        # into per-sequence chain slots; the host fetches them AFTER
-        # dispatching the next decode window, so admission never stalls
-        # the pipeline (r4 bench: first-token syncs were 68% of wall
-        # time). Entries: (seq, toks_dev, lps_dev, top_ref|None, row).
-        self._pending_first: list[tuple[_Seq, Any, Any, Any, int]] = []
+        # FIFO completion queue of dispatched-but-unfetched device work:
+        # _First admission samples and _Window decode windows, in
+        # dispatch order. Every item's D2H fetch was started async at
+        # dispatch (start_host_fetch); _drain_completed harvests ready
+        # items from the front, and force-drains only when the pipeline
+        # is full or host-visible tokens are required. FIFO order is the
+        # per-sequence emission-order invariant: a seq's first sample is
+        # always queued before any window containing it.
+        self._fetchq: collections.deque[_First | _Window] = collections.deque()
         self._free_slots: list[int] = list(range(args.max_num_seqs))
         # (tokens, future, loop) embedding jobs; served between scheduler
         # steps on the engine thread (device dispatch affinity).
@@ -191,6 +256,22 @@ class TpuEngine:
         # decode_dispatch / drain_sync / emit / other.
         self.phase_s: dict[str, float] = collections.defaultdict(float)
         self.phase_n: dict[str, int] = collections.defaultdict(int)
+        # Optional Prometheus gauges (worker bind_metrics): in-flight
+        # windows / pending first fetches / prefill pad ratio.
+        self._gauges = None
+
+    def bind_metrics(self, registry) -> None:
+        """Attach the engine gauges to a MetricsRegistry; updated once
+        per scheduler step (never per token)."""
+        self._gauges = register_engine_metrics(registry)
+
+    def _update_gauges(self) -> None:
+        if self._gauges is None:
+            return
+        g_win, g_first, g_pad = self._gauges
+        g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
+        g_first.set(sum(1 for it in self._fetchq if isinstance(it, _First)))
+        g_pad.set(self.total_prefill_padded / max(1, self.total_prefilled))
 
     def _phase(self, key: str, t0: float) -> float:
         """Accumulate perf_counter()-t0 into phase `key`; → new t0."""
@@ -412,7 +493,7 @@ class TpuEngine:
         finally:
             # Flip stopping FIRST so late generate() calls are rejected
             # instead of queueing onto a dead thread.
-            self._inflight = None  # drop; leftovers get terminal posts below
+            self._fetchq.clear()  # drop; leftovers get terminal posts below
             with self._wakeup:
                 self._stopping = True
                 leftovers = list(self._running) + list(self._waiting) + list(self._submissions)
@@ -434,6 +515,10 @@ class TpuEngine:
                 )
 
     def _step(self) -> None:
+        # Harvest whatever fetches completed while the host was away:
+        # frees slots/KV and discovers stops as early as possible, and
+        # costs nothing when the head of the queue is still in flight.
+        self._drain_completed()
         self._reap_cancelled()
         while self._embed_jobs:
             self._serve_embed(*self._embed_jobs.popleft())
@@ -442,7 +527,10 @@ class TpuEngine:
         # Prefill-priority admission, two phases: (1) allocate KV for the
         # whole wave, (2) dispatch prefills PACKED by suffix bucket
         # (model.prefill_batch) — one-at-a-time prefill was the r3 TTFT
-        # killer. The wave then shares ONE first-token sampling sync.
+        # killer. The wave shares ONE first-token sampling fetch, and the
+        # whole wave is dispatched while previously-dispatched decode
+        # windows are still executing (prefill interleave: arrivals no
+        # longer inherit a blocking drain's worth of queueing delay).
         # The wave is budgeted to ~one max_prefill_tokens chunk so running
         # decodes are not starved by a long burst of arrivals.
         t0 = time.perf_counter()
@@ -494,10 +582,10 @@ class TpuEngine:
             t0 = self._phase("prefill_dispatch", t0)
         if admitted:
             # Async admission: sample first tokens ON DEVICE, fold them
-            # into each sequence's chain slot, and defer the host fetch
-            # until after the next decode window is dispatched — the
-            # sample's sync then overlaps the window's execution instead
-            # of idling the device (r4 bench: these syncs were 68% of the
+            # into each sequence's chain slot, and enqueue the host fetch
+            # on the completion queue (transfer started immediately) —
+            # the fetch roundtrip overlaps window execution instead of
+            # idling the device (r4 bench: these syncs were 68% of the
             # timed section). Waves padded to a decode bucket so sampling
             # compiles once per bucket.
             seqs = [s for s, _, _ in admitted]
@@ -523,24 +611,34 @@ class TpuEngine:
                     self._finish(seq, FinishReason.ERROR, error=f"sampling failed: {e}")
                 seqs = []
             t0 = self._phase("first_dispatch", t0)
-            for i, seq in enumerate(seqs):
-                seq.first_pend = True
-                self._running.append(seq)
-                self._pending_first.append((seq, out_d, lps_d, top_ref, i))
-            # Prefill-only requests (disagg export, max_tokens=1) finish at
-            # the first token — resolve now so they never ride a decode
-            # window as instant zombies.
-            if any(s.stop.max_tokens == 1 for s in seqs):
-                self._resolve_first()
+            if seqs:
+                for seq in seqs:
+                    seq.first_pend = True
+                    self._running.append(seq)
+                first = _First(
+                    [(s, i) for i, s in enumerate(seqs)], out_d, lps_d, top_ref
+                )
+                start_host_fetch(first.fetch_arrays())
+                self._fetchq.append(first)
+                # Prefill-only requests (disagg export, max_tokens=1)
+                # finish at the first token — resolve JUST this wave's
+                # sample now (its seqs ride no earlier queued item, so
+                # draining it out of FIFO order is safe) so they never
+                # ride a decode window as instant zombies and the rest of
+                # the pipeline stays in flight.
+                if any(s.stop.max_tokens == 1 for s in seqs):
+                    self._fetchq.pop()  # == first, just appended
+                    self._drain_one(first)
         if self._running:
             self._decode_iteration()
             self._flush_offloads()
-        elif self._inflight is not None:
-            # Every row of the in-flight window died during its drain:
-            # release the window (all-dead rows; keeps StepRef/device
-            # arrays from idling and total_decode_steps honest).
-            self._drain_inflight()
-        self._resolve_first()  # catch-all: nothing pends across steps
+        elif self._fetchq:
+            # Every owner of the queued fetches died during a drain:
+            # release them all (zombie rows; keeps StepRef/device arrays
+            # from idling forever — the idle predicate ignores _fetchq —
+            # and total_decode_steps honest).
+            self._drain_completed(force=True)
+        self._update_gauges()
 
     # -- embeddings (reference: http/service/openai.rs:302) ----------------
 
@@ -677,21 +775,29 @@ class TpuEngine:
     ) -> list[tuple[_Seq, Any, int]]:
         """Phase 2 of admission: run the wave's prefills. Suffixes that fit
         one chunk are PACKED by (T bucket) into prefill_batch dispatches;
-        longer prompts fall back to per-sequence chunked prefill. Returns
-        (seq, logits array, row index) triples (logits not synced)."""
+        longer prompts fall back to per-sequence chunked prefill, and
+        suffixes whose bucket pad is large split into [bucket chunk,
+        re-bucketed tail] chunked dispatches (plan_prefill_chunks) so the
+        remainder packs a small bucket instead of padding a whole row.
+        Returns (seq, logits array, row index) triples (logits not
+        synced)."""
         out: list[tuple[_Seq, Any, int]] = []
-        singles: list[tuple[_Seq, int]] = []
+        singles: list[tuple[_Seq, int, list[int] | None]] = []
         groups: dict[int, list[tuple[_Seq, int]]] = {}
         for seq, start in allocated:
             sfx = len(seq.tokens) - start
             if sfx > self.args.max_prefill_tokens:
-                singles.append((seq, start))
+                singles.append((seq, start, None))
+                continue
+            plan = self.args.plan_prefill_chunks(sfx)
+            if len(plan) > 1:
+                singles.append((seq, start, plan))
             else:
                 groups.setdefault(self.args.bucket_prefill(sfx), []).append((seq, start))
 
-        for seq, start in singles:
+        for seq, start, plan in singles:
             # row=None: chunked prefill yields [V] logits, not a batch row.
-            out.append((seq, self._prefill_chunked(seq, start), None))
+            out.append((seq, self._prefill_chunked(seq, start, plan), None))
 
         bmax = max(1, self.args.prefill_batch_max)
         for t_pad, members in sorted(groups.items()):
@@ -733,9 +839,13 @@ class TpuEngine:
             self._finish_prefill_bookkeeping(seq, start)
         return ref
 
-    def _prefill_chunked(self, seq: _Seq, start: int) -> Any:
-        """Per-sequence chunked prefill (suffix > max_prefill_tokens).
-        Returns last-token logits [V] (not synced)."""
+    def _prefill_chunked(self, seq: _Seq, start: int,
+                         chunks: list[int] | None = None) -> Any:
+        """Per-sequence chunked prefill: suffix > max_prefill_tokens, or
+        an explicit tail-split ``chunks`` plan (true lengths; every chunk
+        but the last is bucket-sized, hence block-aligned, so each chunk
+        starts on a block boundary). Returns last-token logits [V] (not
+        synced)."""
         prompt = seq.tokens
         plen = len(prompt)
         W = self.args.bucket_table(len(seq.block_ids))
@@ -744,8 +854,14 @@ class TpuEngine:
         logits = None
         pos = start
         max_chunk = self.args.max_prefill_tokens
+        ci = 0
         while pos < plen:
-            chunk = prompt[pos : pos + max_chunk]
+            if chunks is not None:
+                n = chunks[ci]
+                ci += 1
+            else:
+                n = min(max_chunk, plen - pos)
+            chunk = prompt[pos : pos + n]
             t_pad = self.args.bucket_prefill(len(chunk))
             toks = np.zeros((t_pad,), np.int32)
             toks[: len(chunk)] = chunk
@@ -869,7 +985,7 @@ class TpuEngine:
     def _preempt(self, seq: _Seq) -> None:
         """Recompute-preemption: free blocks, requeue with all tokens as the
         new prompt (reference behaviour matches vLLM recompute mode)."""
-        self._resolve_first()  # pending first tokens must be host-visible
+        self._drain_completed(force=True)  # pending tokens must be host-visible
         if seq.dead or seq not in self._running:
             return  # resolution finished it (stop condition on token 1)
         log.warning("preempting request %s (KV pressure)", seq.request_id)
@@ -895,96 +1011,112 @@ class TpuEngine:
     # -- decode window pipeline -------------------------------------------
     #
     # With host↔device syncs costing a full tunnel roundtrip (~100 ms
-    # measured), the engine keeps ONE decode window in flight: window w+1
-    # is dispatched (chaining its input tokens from w's on-device outputs)
-    # BEFORE w's results are fetched, so the fetch roundtrip overlaps
-    # w+1's device execution. Consequences handled here:
-    # - stops are discovered one window late; a stopped sequence rides the
-    #   in-flight window as a zombie row whose output is discarded (waste
-    #   bounded by K tokens, same order as the fused window itself);
+    # measured), the engine keeps up to ``pipeline_depth`` decode windows
+    # in flight: window w+1 is dispatched (chaining its input tokens from
+    # w's on-device outputs via the per-slot fold buffer) BEFORE w's
+    # results are fetched, and every fetch is started asynchronously at
+    # dispatch, so the fetch roundtrips overlap later windows' device
+    # execution. Consequences handled here:
+    # - stops are discovered up to depth windows late; a stopped sequence
+    #   rides the remaining in-flight windows as a zombie row whose
+    #   output is discarded (waste bounded by depth × K tokens, same
+    #   order as the fused window itself);
     # - zombie rows only write KV at positions beyond the drained
     #   boundary, and block registration is gated by complete kept-token
     #   blocks, so prefix reuse never sees junk;
-    # - the device stream is serial, so later prefills reusing freed
-    #   blocks are ordered after the in-flight window's writes;
+    # - the device stream is serial and a sequence's blocks/slot are only
+    #   freed after every window containing it has been DISPATCHED, so
+    #   later prefills/samples reusing freed blocks or slots are ordered
+    #   after all zombie writes and folds;
     # - the full sampler needs host-visible penalty windows, so sampler-
-    #   heavy batches drain first and run unpipelined.
+    #   heavy batches drain everything first and run unpipelined.
 
     def _pend(self, seq: _Seq) -> int:
         """Tokens already sampled on device for this sequence but not yet
-        drained/emitted (its host-visible length lags by this many): the
-        in-flight window's K steps plus an unfetched admission sample."""
-        w = self._inflight
-        p = w.K if w is not None and seq in w.row_of else 0
-        return p + (1 if seq.first_pend else 0)
+        drained/emitted (its host-visible length lags by this many): K
+        steps per in-flight window it rides plus an unfetched admission
+        sample."""
+        p = 1 if seq.first_pend else 0
+        for item in self._fetchq:
+            if isinstance(item, _Window) and seq in item.row_of:
+                p += item.K
+        return p
 
-    def _resolve_first(self) -> None:
-        """Fetch + emit deferred admission samples. The sample op was
-        dispatched before the current window, so by the time this syncs
-        the device has long moved on — cost ≈ one transfer round-trip,
-        overlapped with window execution when called post-dispatch."""
-        if not self._pending_first:
-            return
-        pend, self._pending_first = self._pending_first, []
+    def _inflight_windows(self) -> int:
+        return sum(1 for it in self._fetchq if isinstance(it, _Window))
+
+    def _drain_completed(self, force: bool = False) -> None:
+        """Harvest the completion queue from the front, strictly FIFO.
+        Non-forced: pop only items whose async fetch already finished
+        (free — the host never blocks). Forced: fetch-blocking drain of
+        everything (needed when the pipeline is full, host-visible tokens
+        are required, or all consumers died)."""
+        while self._fetchq:
+            if not force and not host_ready(self._fetchq[0].fetch_arrays()):
+                break
+            self._drain_one(self._fetchq.popleft())
+
+    def _drain_one(self, item: "_First | _Window") -> None:
+        """Fetch + emit one queue item, attributing the fetch time by
+        whether the host actually had to wait for it."""
+        ready = host_ready(item.fetch_arrays())
+        if isinstance(item, _First):
+            self._drain_first(item, blocked=not ready)
+        else:
+            self._drain_window(item, blocked=not ready)
+
+    def _drain_first(self, f: _First, blocked: bool = True) -> None:
+        """Fetch + emit one admission wave's first-token samples."""
         t0 = time.perf_counter()
-        fetched: dict[int, tuple[np.ndarray, np.ndarray]] = {}
-        fetched_top: dict[int, tuple[np.ndarray, np.ndarray]] = {}
-        for seq, out_d, lps_d, top_ref, _row in pend:
+        toks = np.asarray(f.out_d)
+        lps = np.asarray(f.lps_d)
+        tvals_l = tids_l = None
+        if f.top_ref is not None:
+            tvals_l = np.asarray(f.top_ref.arrs[0]).tolist()
+            tids_l = np.asarray(f.top_ref.arrs[1]).tolist()
+        t0 = self._phase("first_sample" if blocked else "drain_ready", t0)
+        toks_l, lps_l = toks.tolist(), lps.tolist()
+        for seq, row in f.entries:
             seq.first_pend = False
-            if id(out_d) not in fetched:
-                fetched[id(out_d)] = (np.asarray(out_d), np.asarray(lps_d))
-            if top_ref is not None and id(top_ref) not in fetched_top:
-                fetched_top[id(top_ref)] = (
-                    np.asarray(top_ref.arrs[0]), np.asarray(top_ref.arrs[1])
-                )
-        t0 = self._phase("first_sample", t0)
-        for seq, out_d, _lps_d, top_ref, row in pend:
             if seq.dead:
                 continue  # cancelled while the sample was in flight
-            toks, lps = fetched[id(out_d)]
             tops = None
-            if top_ref is not None and seq.sampling.top_logprobs:
-                tvals, tids = fetched_top[id(top_ref)]
+            if tids_l is not None and seq.sampling.top_logprobs:
                 n = seq.sampling.top_logprobs
-                tops = [[[int(tids[row, r]), float(tvals[row, r])] for r in range(n)]]
-            self._emit_tokens(seq, [int(toks[row])], [float(lps[row])], tops)
+                tops = [[list(p) for p in zip(tids_l[row][:n], tvals_l[row][:n])]]
+            self._emit_tokens(seq, [toks_l[row]], [lps_l[row]], tops)
         self._phase("emit", t0)
 
-    def _plan_window(self) -> tuple[int, bool]:
-        """→ (K, pipeline?). K=1 is the end-of-life tail near
-        max_model_len; pipelining needs K>1 and no full-sampler rows."""
+    def _plan_window(self) -> tuple[int, int]:
+        """→ (K, depth). K=1 is the end-of-life tail near max_model_len;
+        pipelining (depth > 0) needs K>1 and no full-sampler rows."""
         K = max(1, self.args.decode_steps)
         if K > 1:
             for s in self._running:
                 if len(s.tokens) + self._pend(s) + K > self.args.max_model_len:
                     K = 1
                     break
-        pipe = (
-            K > 1
-            and self.args.pipeline_windows
-            and not any(self._needs_full_sampler(s) for s in self._running)
-        )
-        return K, pipe
+        depth = self.args.effective_pipeline_depth
+        if K == 1 or any(self._needs_full_sampler(s) for s in self._running):
+            depth = 0
+        return K, depth
 
     def _decode_iteration(self) -> None:
         if not self._running:
-            self._drain_inflight()
+            self._drain_completed(force=True)
             return
-        # Full-sampler windows seed penalty counts from host-visible
-        # tokens — an unfetched first token would be missed, so resolve
-        # before dispatch in that (already unpipelined) case.
-        if self._pending_first and any(
-            self._needs_full_sampler(s) for s in self._running
-        ):
-            self._resolve_first()
-        K, pipe = self._plan_window()
-        if self._inflight is not None and not pipe:
-            self._drain_inflight()
-            return self._decode_iteration()  # re-plan on drained state
+        K, depth = self._plan_window()
+        if depth == 0 and self._fetchq:
+            # Unpipelined plan (full sampler / K=1 tail): host-visible
+            # tokens (penalty windows, per-step inputs) are required, so
+            # everything pending drains first — then re-plan on the
+            # drained state.
+            self._drain_completed(force=True)
+            return self._decode_iteration()
         # Grow block tables K ahead; under KV pressure drain the in-flight
-        # window first (its tokens must land before a preempted sequence
-        # re-queues), then preempt newest-first. A lone sequence that
-        # cannot grow is finished (cache physically too small).
+        # windows first (their tokens must land before a preempted
+        # sequence re-queues), then preempt newest-first. A lone sequence
+        # that cannot grow is finished (cache physically too small).
         while self._running:
             blocked = next(
                 (s for s in self._running
@@ -993,29 +1125,32 @@ class TpuEngine:
             )
             if blocked is None:
                 break
-            if self._inflight is not None:
-                self._drain_inflight()
+            if self._fetchq:
+                self._drain_completed(force=True)
                 return self._decode_iteration()
             if len(self._running) == 1:
                 self._finish(blocked, FinishReason.LENGTH)
             else:
                 self._preempt(self._running[-1])
         if not self._running:
-            self._drain_inflight()
+            self._drain_completed(force=True)
             return
 
         if K > 1:
             w = self._dispatch_window(K)
-            prev, self._inflight = self._inflight, w
-            self._resolve_first()  # admission fetch overlaps w's execution
-            if prev is not None:
-                self._drain_window(prev)  # fetch overlaps w's execution
-            if not pipe or not self._running:
-                # not self._running: every sequence finished during prev's
-                # drain — w is all zombie rows and nothing would ever wake
-                # the loop to fetch it (the idle predicate ignores
-                # _inflight), so release it now.
-                self._drain_inflight()
+            self._fetchq.append(w)
+            # Opportunistic harvest first (free), then enforce the depth
+            # bound: block-draining the OLDEST window while the newest
+            # executes is where the fetch roundtrip hides.
+            self._drain_completed()
+            while self._inflight_windows() > depth and self._fetchq:
+                self._drain_one(self._fetchq.popleft())
+            if not self._running:
+                # Every sequence finished during the drains — remaining
+                # queued windows are all zombie rows and nothing would
+                # ever wake the loop to fetch them (the idle predicate
+                # ignores _fetchq), so release them now.
+                self._drain_completed(force=True)
         else:
             self._decode_single_step()
 
@@ -1088,44 +1223,44 @@ class TpuEngine:
             temps, seeds, steps0, tks, tps, freqs, press, pen, fold_slots,
             top_n,
         )
+        w = _Window(batch, pos0, K, ref, top_n)
+        start_host_fetch(w.fetch_arrays())
         self._phase("decode_dispatch", t0)
-        return _Window(batch, pos0, K, ref, top_n)
+        return w
 
-    def _drain_window(self, w: "_Window") -> None:
+    def _drain_window(self, w: "_Window", blocked: bool = True) -> None:
         self.total_decode_steps += w.K
         t0 = time.perf_counter()
-        toks_np = np.asarray(w.ref.arrs[0])  # [K, B] — the one host sync
+        toks_np = np.asarray(w.ref.arrs[0])  # [K, B] — the one host fetch
         logps_np = np.asarray(w.ref.arrs[1])
-        tvals = np.asarray(w.ref.arrs[2]) if w.top_n else None
-        tids = np.asarray(w.ref.arrs[3]) if w.top_n else None
-        t0 = self._phase("drain_sync", t0)
+        tvals_l = tids_l = None
+        if w.top_n:
+            # transpose → [B, K, top_n]; bulk-converted once (per-element
+            # int()/float() at K·B·n scale was measurable emit cost).
+            tvals_l = np.asarray(w.ref.arrs[2]).transpose(1, 0, 2).tolist()
+            tids_l = np.asarray(w.ref.arrs[3]).transpose(1, 0, 2).tolist()
+        t0 = self._phase("drain_sync" if blocked else "drain_ready", t0)
+        toks_l = toks_np.T.tolist()    # [B][K] python ints
+        logps_l = logps_np.T.tolist()  # [B][K] python floats
         for i, seq in enumerate(w.rows):
             if seq.dead:
                 continue  # finished/cancelled while this window was in flight
             seq.kv_written = w.pos0[i] + w.K
             self._register_written_blocks(seq)
             tops = None
-            if w.top_n and seq.sampling.top_logprobs:
+            if tids_l is not None and seq.sampling.top_logprobs:
                 n = seq.sampling.top_logprobs
                 tops = [
-                    [[int(tids[j, i, r]), float(tvals[j, i, r])] for r in range(n)]
+                    [list(p) for p in zip(tids_l[i][j][:n], tvals_l[i][j][:n])]
                     for j in range(w.K)
                 ]
-            self._emit_tokens(
-                seq,
-                [int(toks_np[j, i]) for j in range(w.K)],
-                [float(logps_np[j, i]) for j in range(w.K)],
-                tops,
-            )
+            self._emit_tokens(seq, toks_l[i], logps_l[i], tops)
         self._phase("emit", t0)
 
-    def _drain_inflight(self) -> None:
-        w, self._inflight = self._inflight, None
-        if w is not None:
-            self._drain_window(w)
-
     def _decode_single_step(self) -> None:
-        self._resolve_first()  # per-step path needs host-visible tokens
+        # Per-step path needs host-visible tokens (inputs come from
+        # seq.tokens[-1]); drain everything pending first.
+        self._drain_completed(force=True)
         if not self._running:
             return
         t_start = time.perf_counter()

@@ -447,15 +447,22 @@ def multi_decode_impl(
     pres_penalty: jax.Array,  # [B] fp32 (mode="full")
     penalty_tokens: jax.Array,  # [B, L] int32 generated-so-far ids, -1 pad (mode="full")
     chain_mask: jax.Array | None = None,  # [B] bool — row chains from last_toks
-    chain_src: jax.Array | None = None,   # [B] int32 — row in last_toks
-    last_toks: jax.Array | None = None,   # [Bmax] int32 — previous window's
-                                          # final sampled tokens (device)
+    chain_src: jax.Array | None = None,   # [B] int32 — SLOT in last_toks
+    last_toks: jax.Array | None = None,   # [slots+1] int32 — per-slot latest
+                                          # sampled token (device). Fed by every
+                                          # window's fold and admission samples,
+                                          # in dispatch order, so a chained row
+                                          # reads the newest on-device token for
+                                          # its slot even with several windows
+                                          # in flight (pipeline_depth > 1).
     *,
     attn_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, KVCache]:
     """``num_steps`` fused decode+sample steps: sampled tokens feed back on
-    device, so the host syncs once per num_steps×B tokens instead of per
-    token. THE latency lever when the host↔device link is slow (remote
+    device, so the host fetches once per num_steps×B tokens instead of
+    per token — and with the engine's window pipeline, consecutive
+    windows chain through ``last_toks`` so the device never waits for a
+    host fetch either. THE latency lever when the host↔device link is slow (remote
     TPU tunnels ~100ms/roundtrip) and a dispatch saver everywhere; the
     same trick as vLLM's multi-step scheduling, expressed as lax.scan.
 

@@ -68,6 +68,29 @@ class StepRef:
         self.arrs = arrs
 
 
+def start_host_fetch(arrs) -> None:
+    """Begin async device→host transfers for a dispatch's result arrays.
+    Called by the engine at dispatch time so the D2H copy rides the
+    tunnel while the device executes subsequent work; the later
+    ``np.asarray`` then completes from the host-side buffer instead of
+    paying a full blocking roundtrip. No-op for host-resident arrays."""
+    for a in arrs:
+        fn = getattr(a, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+
+def host_ready(arrs) -> bool:
+    """True when every array's device computation (and any started host
+    copy) has completed — fetching now will not block the caller on
+    device work. Arrays without ``is_ready`` (numpy) are always ready."""
+    for a in arrs:
+        fn = getattr(a, "is_ready", None)
+        if fn is not None and not fn():
+            return False
+    return True
+
+
 def _pack_np(a: np.ndarray) -> dict:
     a = np.ascontiguousarray(a)
     return {"b": a.tobytes(), "d": str(a.dtype), "s": list(a.shape)}

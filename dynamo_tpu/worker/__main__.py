@@ -58,6 +58,15 @@ def parse_args(argv=None):
     p.add_argument("--max-num-seqs", type=int, default=16)
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--decode-steps", type=int, default=8)
+    # Decode-window pipelining: max windows dispatched-but-unfetched (0 =
+    # unpipelined; fetches still start async). Stops are discovered up to
+    # this many windows late (≤ depth × decode-steps wasted tokens).
+    p.add_argument("--pipeline-depth", type=int, default=2)
+    # Prefill T-bucket ladder: "fine" (1.5x midpoints ≤512), "coarse"
+    # (legacy 2x/4x, fewest compiles) or an explicit comma list.
+    p.add_argument("--prefill-buckets", default="fine")
+    p.add_argument("--no-prefill-tail-split", action="store_true",
+                   help="disable splitting padded prefill tails into smaller buckets")
     # Streaming delta coalescing (both engines): cap on tokens merged into
     # one wire frame when a stream's consumer lags (0 = one frame per
     # decode window), and an optional bounded gather wait in ms (adds up
@@ -235,6 +244,10 @@ async def async_main(args) -> None:
     engine_chaos = getattr(getattr(engine, "args", None), "chaos", None)
     if engine_chaos is not None:
         engine_chaos.bind_metrics(rt.metrics)
+    # TPU engine hot-loop gauges (in-flight windows, pending first-sample
+    # fetches, prefill pad ratio); catalog-guarded by tools/check_metrics.py.
+    if hasattr(engine, "bind_metrics"):
+        engine.bind_metrics(rt.metrics)
 
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)
@@ -365,6 +378,10 @@ def _engine_args(args, model):
         dtype=args.dtype,
         tp=args.tp,
         decode_steps=args.decode_steps,
+        pipeline_depth=args.pipeline_depth,
+        pipeline_windows=args.pipeline_depth > 0,
+        prefill_buckets_spec=args.prefill_buckets,
+        prefill_tail_split=not args.no_prefill_tail_split,
         delta_max_tokens=args.delta_max_tokens,
         delta_max_ms=args.delta_max_ms,
         attn_impl=args.attn_impl,

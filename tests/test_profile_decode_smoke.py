@@ -1,0 +1,28 @@
+"""Tier-1 guard for tools/profile_decode.py: --quick runs every ablation
+at toy CPU shapes plus the engine hot-loop probe (TpuEngine scheduler at
+pipeline depths 0 and 2) and asserts its own accounting — full token
+delivery and depth-0 == depth-2 golden token streams — so hot-loop
+profiling can't silently rot between perf rounds (the mode's first run
+caught two already-rotted ablations).
+
+No timing assertions: --quick makes no throughput claims.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_decode_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_decode.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    # QUICK-OK prints only after the internal accounting asserts (token
+    # delivery complete, pipelined == unpipelined streams) passed.
+    assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]

@@ -46,6 +46,12 @@ async def build_registries():
     wrt = await DistributedRuntime.create(store_url=url, config=wcfg)
     engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=64, speedup=1000.0))
     broadcaster = KvEventBroadcaster(engine.pool)
+    # TPU-engine hot-loop gauges (what worker/__main__ binds for
+    # engine=tpu): register via the shared path so the catalog guard
+    # covers them without booting a real engine. Lazy import — pulls jax.
+    from dynamo_tpu.engine.engine import register_engine_metrics
+
+    register_engine_metrics(wrt.metrics)
 
     async def gen_handler(payload, ctx):
         async for item in engine.generate(payload, ctx):
