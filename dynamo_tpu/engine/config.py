@@ -244,6 +244,47 @@ class EngineArgs:
     host_kv_blocks: int = 0
     disk_kv_dir: str | None = None
     disk_kv_blocks: int = 4096
+    # Speculative decoding (engine/drafter.py + model.spec_verify): max
+    # draft tokens verified per pass (0 = off). Decode is weight-
+    # bandwidth-bound — one verify pass streams the weights ONCE and can
+    # emit up to spec_tokens+1 tokens per sequence, so acceptance rate
+    # directly multiplies tokens-per-weight-pass. Drafts come from
+    # host-side n-gram prompt lookup (free — no draft model); greedy
+    # rows accept by exact match (byte-identical to the dense path),
+    # sampled rows use rejection sampling (distribution unchanged).
+    spec_tokens: int = 0
+    # n-gram match length for the prompt-lookup drafter: the last
+    # spec_ngram generated/prompt tokens are matched against the
+    # sequence's own history and the continuation of the most recent
+    # earlier occurrence becomes the draft.
+    spec_ngram: int = 3
+    # Adaptive acceptance EMA per sequence: update weight, the EMA below
+    # which a row stops proposing drafts, and how many decode iterations
+    # an EMA-disabled row waits before re-probing with a (naturally
+    # short, EMA-scaled) draft. Rows whose drafter simply finds no match
+    # are NOT throttled — that scan is an O(new tokens) dict lookup and
+    # never forces a pipeline drain by itself. Keeps adversarial
+    # (incompressible) workloads at the dense path's cost instead of
+    # paying rejected verify work forever.
+    spec_ema_alpha: float = 0.3
+    spec_ema_disable: float = 0.2
+    spec_probe_every: int = 16
+    # Verify forward shape: True (default) = single-pass fused forward —
+    # ONE weight stream scores the whole draft, the bandwidth win.
+    # False = teacher-forced scan of the dense decode step — bitwise
+    # identical to the dense path on every backend (fused matmul
+    # reduction order can differ at the last ulp on some backends, which
+    # perturbs reported logprob values, not sampling decisions); keeps
+    # only the one-dispatch/one-fetch saving. Parity/debug mode and the
+    # golden suite's byte-identity anchor.
+    spec_fused: bool = True
+    # Batch-level dispatch gate: speculate only when the EMA-weighted
+    # expected tokens per row-pass, mean(1 + ema_i * draft_len_i),
+    # clears this threshold. Protects mixed batches (a few drafting rows
+    # must not drop everyone else from K-token windows to 1-token
+    # passes) and ramp phases where loops have not formed yet. 0 = always
+    # speculate when any draft exists (golden tests use this).
+    spec_gate: float = 1.5
 
     def __post_init__(self):
         # Fail fast on a mistyped ladder spec: anything that is not a

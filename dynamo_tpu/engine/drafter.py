@@ -1,0 +1,85 @@
+"""Host-side draft-token proposers for speculative decoding.
+
+The engine's verify pass (model.spec_verify) scores any proposed draft
+in one weight stream; WHERE drafts come from is pluggable behind the
+``Drafter`` interface. The default is n-gram prompt lookup (Saxena 2023,
+"Prompt Lookup Decoding"): match the sequence's trailing n-gram against
+its own prompt+generated history and propose the continuation of the
+most recent earlier occurrence. Zero model cost, zero RNG draws, and
+exactly the TPU-native shape — the expensive half (verification) runs
+on device while drafting is a dict lookup on the host.
+
+A draft-model backend (small model proposing tokens, Leviathan et al.
+2023) slots in behind the same two methods; its ``draft`` would dispatch
+device work, which is why the interface takes the whole token list
+rather than a delta.
+
+State is PER SEQUENCE (``new_state``) and fed incrementally: ``draft``
+absorbs tokens appended since the last call before matching, so the
+steady-state cost is O(new tokens), not O(history). Preemption-by-
+recompute keeps ``seq.tokens`` intact, so drafter state survives it
+unchanged.
+"""
+
+from __future__ import annotations
+
+
+class NgramState:
+    """Incremental n-gram index over one sequence's token history:
+    ``index[ngram] = end position of its most recent occurrence`` —
+    excluding the n-gram that ends at the final token, which is the
+    lookup KEY (indexing it would make every lookup find itself)."""
+
+    __slots__ = ("index", "observed")
+
+    def __init__(self):
+        self.index: dict[tuple[int, ...], int] = {}
+        self.observed = 0  # positions with their ending n-gram indexed
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: propose the continuation of the most
+    recent earlier occurrence of the trailing ``n``-gram. Deterministic
+    (no RNG — unseeded-request reproducibility is untouched)."""
+
+    def __init__(self, n: int):
+        if n < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {n}")
+        self.n = n
+
+    def new_state(self) -> NgramState:
+        return NgramState()
+
+    def draft(self, tokens: list[int], state: NgramState, max_len: int) -> list[int]:
+        """→ up to ``max_len`` proposed next tokens (possibly empty)."""
+        n = self.n
+        L = len(tokens)
+        if max_len <= 0 or L < n + 1:
+            return []
+        # Absorb history: index n-grams ending at positions [n-1, L-2].
+        # The tail n-gram (ending at L-1) stays unindexed until the
+        # sequence grows past it.
+        start = max(n - 1, state.observed)
+        for e in range(start, L - 1):
+            state.index[tuple(tokens[e - n + 1 : e + 1])] = e
+        state.observed = max(state.observed, L - 1)
+        e = state.index.get(tuple(tokens[L - n :]))
+        if e is None:
+            return []
+        # Self-extending copy: when the continuation run reaches the tail
+        # of the history, keep copying from the draft itself — a period-p
+        # loop then drafts max_len tokens (cycling the loop) instead of
+        # stopping p tokens in. Repetitive generation usually has short
+        # periods, so this is where most of the draft length comes from.
+        out: list[int] = []
+        src = e + 1
+        for _ in range(max_len):
+            out.append(tokens[src] if src < L else out[src - L])
+            src += 1
+        return out
+
+
+def build_drafter(args) -> NgramDrafter:
+    """EngineArgs → drafter instance. The single construction seam for
+    future backends (draft model, Medusa-style heads)."""
+    return NgramDrafter(args.spec_ngram)

@@ -40,6 +40,7 @@ import numpy as np
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.engine.drafter import build_drafter
 from dynamo_tpu.engine.runner import host_ready, start_host_fetch
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
@@ -70,6 +71,7 @@ class _Seq:
         "cancelled", "preempted", "prefix_hit_blocks", "sample_seed",
         "kv_written", "export", "export_meta", "inject", "dead",
         "slot", "first_pend", "t_admit",
+        "spec_ema", "spec_cool", "draft_state",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -108,6 +110,15 @@ class _Seq:
         # fetched/emitted (async admission).
         self.slot: int | None = None
         self.first_pend = False
+        # Speculative decoding: per-sequence acceptance-rate EMA (starts
+        # optimistic so new sequences get full drafts; a few rejected
+        # passes decay it below the disable threshold), cooldown counter
+        # of decode iterations before a disabled/draft-less row proposes
+        # again, and the drafter's incremental n-gram index (built lazily
+        # on the first draft call).
+        self.spec_ema = 1.0
+        self.spec_cool = 0
+        self.draft_state = None
         # Disaggregation (engine side of llm/disagg.py):
         ktp = req.kv_transfer_params or {}
         self.export = bool(ktp.get("do_remote_decode"))  # prefill-only + export KV
@@ -137,6 +148,32 @@ class _Window:
         a = [self.ref.arrs[0], self.ref.arrs[1]]
         if self.top_n:
             a += [self.ref.arrs[2], self.ref.arrs[3]]
+        return a
+
+
+class _Spec:
+    """One dispatched speculative verify pass (results not yet fetched).
+    Unlike a _Window, the number of tokens a row will emit (1 + accepted
+    drafts) is unknown until the fetch lands, so the scheduler never
+    plans further decode work for these rows while a _Spec is queued —
+    _decode_iteration force-drains any queued _Spec before planning."""
+
+    __slots__ = ("rows", "pos0", "draft_lens", "ref", "top_n")
+
+    def __init__(self, rows: list[_Seq], pos0: list[int],
+                 draft_lens: list[int], ref, top_n: int = 0):
+        self.rows = rows
+        self.pos0 = pos0
+        self.draft_lens = draft_lens
+        # StepRef: arrs = (out [B, S1], n_emit [B], logps [B, S1],
+        # top_vals [B, S1, n], top_ids [B, S1, n])
+        self.ref = ref
+        self.top_n = top_n
+
+    def fetch_arrays(self) -> list:
+        a = [self.ref.arrs[0], self.ref.arrs[1], self.ref.arrs[2]]
+        if self.top_n:
+            a += [self.ref.arrs[3], self.ref.arrs[4]]
         return a
 
 
@@ -170,9 +207,11 @@ BLOCKING_PHASES = ("first_sample", "drain_sync", "drain_ready", "single_step")
 
 
 def register_engine_metrics(registry):
-    """Register the engine gauges on a MetricsRegistry → (inflight
-    windows, pending first fetches, prefill pad ratio). Shared by the
-    worker (bind_metrics) and the tools/check_metrics.py catalog guard."""
+    """Register the engine gauges/counters on a MetricsRegistry →
+    (inflight windows, pending first fetches, prefill pad ratio,
+    spec proposed counter, spec accepted counter, spec accept-rate gauge,
+    tokens-per-weight-pass gauge). Shared by the worker (bind_metrics)
+    and the tools/check_metrics.py catalog guard."""
     return (
         registry.gauge(
             "engine_inflight_windows",
@@ -185,6 +224,23 @@ def register_engine_metrics(registry):
         registry.gauge(
             "engine_prefill_pad_ratio",
             "Cumulative dispatched/true prefill token ratio (bucket padding waste)",
+        ),
+        registry.counter(
+            "engine_spec_proposed_total",
+            "Draft tokens proposed to speculative verify passes",
+        ),
+        registry.counter(
+            "engine_spec_accepted_total",
+            "Proposed draft tokens accepted by speculative verification",
+        ),
+        registry.gauge(
+            "engine_spec_accept_rate",
+            "Cumulative accepted/proposed draft-token ratio",
+        ),
+        registry.gauge(
+            "engine_tokens_per_weight_pass",
+            "Decode tokens sampled per per-sequence weight stream "
+            "(1.0 = dense; >1.0 = speculation paying off)",
         ),
     )
 
@@ -233,15 +289,45 @@ class TpuEngine:
         # is full or host-visible tokens are required. FIFO order is the
         # per-sequence emission-order invariant: a seq's first sample is
         # always queued before any window containing it.
-        self._fetchq: collections.deque[_First | _Window] = collections.deque()
+        self._fetchq: collections.deque[_First | _Window | _Spec] = collections.deque()
         self._free_slots: list[int] = list(range(args.max_num_seqs))
         # (tokens, future, loop) embedding jobs; served between scheduler
         # steps on the engine thread (device dispatch affinity).
         self._embed_jobs: collections.deque = collections.deque()
+        # (fn, future, loop) host jobs run on the engine thread between
+        # steps — the device-dispatch-affinity seam for out-of-band work
+        # like AOT-warming the spec_verify compile lattice (bench).
+        self._host_jobs: collections.deque = collections.deque()
         # Disagg exports: handle → (KvPagePayload, deadline). Host copies,
         # so they survive cache donation; reaped after export_ttl_s.
         self._exports: dict[str, tuple[Any, float]] = {}
         self.export_ttl_s = 60.0
+        # Speculative decoding: host-side drafter + a runtime-togglable
+        # draft length (initialized from args; bench/tests flip it on an
+        # idle engine to compare dense vs speculative on one warmed
+        # engine — it is read once per scheduler iteration, never mid-
+        # dispatch).
+        self._drafter = build_drafter(args)
+        self.spec_tokens = args.spec_tokens
+        # Scheduler-step counter + last-ticked stamp: _decode_iteration
+        # can re-enter _try_speculative within one step (drain → replan),
+        # and probe cooldowns must tick once per STEP, not per attempt.
+        self._step_no = 0
+        self._spec_ticked = -1
+        # Spec counters: proposed/accepted draft tokens, verify
+        # dispatches, live row-passes and tokens they emitted — the
+        # numerators/denominators for accept-rate and tokens-per-pass.
+        self.total_spec_proposed = 0
+        self.total_spec_accepted = 0
+        self.total_spec_passes = 0
+        self.total_spec_rows = 0
+        self.total_spec_emitted = 0
+        # Tokens-per-weight-pass accounting: every (row, substep) of a
+        # drained window or single step is one per-sequence weight pass
+        # yielding one token; a spec row-pass is one weight pass yielding
+        # n_emit tokens. Dense-only traffic sits at exactly 1.0.
+        self.total_row_passes = 0
+        self.total_row_tokens = 0
         # Cumulative counters for metrics/bench.
         self.total_generated = 0
         self.total_prefilled = 0
@@ -257,8 +343,12 @@ class TpuEngine:
         self.phase_s: dict[str, float] = collections.defaultdict(float)
         self.phase_n: dict[str, int] = collections.defaultdict(int)
         # Optional Prometheus gauges (worker bind_metrics): in-flight
-        # windows / pending first fetches / prefill pad ratio.
+        # windows / pending first fetches / prefill pad ratio / spec
+        # series. _ctr_pushed tracks what the monotonic counters have
+        # already been fed (engine keeps plain ints; registry counters
+        # get the delta once per step).
         self._gauges = None
+        self._ctr_pushed = [0, 0]  # (proposed, accepted) already inc'd
 
     def bind_metrics(self, registry) -> None:
         """Attach the engine gauges to a MetricsRegistry; updated once
@@ -268,10 +358,18 @@ class TpuEngine:
     def _update_gauges(self) -> None:
         if self._gauges is None:
             return
-        g_win, g_first, g_pad = self._gauges
+        g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp = self._gauges
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
         g_first.set(sum(1 for it in self._fetchq if isinstance(it, _First)))
         g_pad.set(self.total_prefill_padded / max(1, self.total_prefilled))
+        if self.total_spec_proposed > self._ctr_pushed[0]:
+            c_prop.inc(self.total_spec_proposed - self._ctr_pushed[0])
+            self._ctr_pushed[0] = self.total_spec_proposed
+        if self.total_spec_accepted > self._ctr_pushed[1]:
+            c_acc.inc(self.total_spec_accepted - self._ctr_pushed[1])
+            self._ctr_pushed[1] = self.total_spec_accepted
+        g_rate.set(self.total_spec_accepted / max(1, self.total_spec_proposed))
+        g_tpp.set(self.total_row_tokens / max(1, self.total_row_passes))
 
     def _phase(self, key: str, t0: float) -> float:
         """Accumulate perf_counter()-t0 into phase `key`; → new t0."""
@@ -479,6 +577,7 @@ class TpuEngine:
                         and not self._waiting
                         and not self._running
                         and not self._embed_jobs
+                        and not self._host_jobs
                     ):
                         self._wakeup.wait()
                     if self._stopping:
@@ -505,16 +604,20 @@ class TpuEngine:
             for seq in leftovers:
                 self._post(seq, LLMEngineOutput(finish_reason=reason, error=err).to_dict())
                 self._post_done(seq)
-            # Pending embed futures must resolve too, or their awaiters
-            # hang forever.
-            while self._embed_jobs:
-                _toks, fut, floop = self._embed_jobs.popleft()
+            # Pending embed/host-job futures must resolve too, or their
+            # awaiters hang forever.
+            while self._embed_jobs or self._host_jobs:
+                if self._embed_jobs:
+                    _toks, fut, floop = self._embed_jobs.popleft()
+                else:
+                    _fn, fut, floop = self._host_jobs.popleft()
                 exc = RuntimeError(err or "engine stopped")
                 floop.call_soon_threadsafe(
                     lambda f=fut, e=exc: f.set_exception(e) if not f.cancelled() else None
                 )
 
     def _step(self) -> None:
+        self._step_no += 1
         # Harvest whatever fetches completed while the host was away:
         # frees slots/KV and discovers stops as early as possible, and
         # costs nothing when the head of the queue is still in flight.
@@ -522,6 +625,8 @@ class TpuEngine:
         self._reap_cancelled()
         while self._embed_jobs:
             self._serve_embed(*self._embed_jobs.popleft())
+        while self._host_jobs:
+            self._serve_host_job(*self._host_jobs.popleft())
         if self._exports:
             self._reap_exports()
         # Prefill-priority admission, two phases: (1) allocate KV for the
@@ -655,6 +760,72 @@ class TpuEngine:
             self._embed_jobs.append((list(token_ids), fut, loop))
             self._wakeup.notify()
         return await fut
+
+    async def run_on_engine_thread(self, fn):
+        """Run ``fn()`` on the scheduler thread between steps (device
+        dispatch affinity) and await its result. Bench/warmup seam — not
+        a serving-path API."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        with self._wakeup:
+            if self._stopping:
+                raise RuntimeError("engine is stopping")
+            self._host_jobs.append((fn, fut, loop))
+            self._wakeup.notify()
+        return await fut
+
+    def _serve_host_job(self, fn, fut, loop) -> None:
+        try:
+            result = fn()
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(result) if not fut.cancelled() else None
+            )
+        except Exception as e:  # noqa: BLE001 — surface to the caller
+            err = e
+            loop.call_soon_threadsafe(
+                lambda: fut.set_exception(err) if not fut.cancelled() else None
+            )
+
+    async def warm_spec(self, modes: tuple[str, ...] = ("greedy",),
+                        top_ns: tuple[int, ...] = (0,)) -> int:
+        """AOT-compile the REQUESTED subset of the spec_verify variant
+        lattice: one inert dispatch (all rows inactive → KV writes land
+        in garbage block 0) per (decode bucket x table bucket x mode x
+        top_n). Drafts cannot be forced through real traffic — they
+        depend on the model looping — so cold variants would otherwise
+        compile mid-serving. The default covers the bench shape (greedy,
+        no top_logprobs); a serving worker expecting sampled or
+        top_logprobs traffic should pass modes=("greedy", "simple") and
+        top_ns=(0, args.top_logprobs_max), or rely on the persistent
+        compile cache (DYNTPU_COMPILE_CACHE) like every other variant
+        family. → number of variants dispatched."""
+        S = self.spec_tokens
+        if S <= 0:
+            return 0
+        args = self.args
+
+        def _warm():
+            count = 0
+            for mode in modes:
+                for top_n in top_ns:
+                    for B in args.decode_buckets:
+                        for W in args.table_buckets:
+                            self._runner.spec_verify(
+                                S + 1, mode,
+                                np.zeros((B, S + 1), np.int32),
+                                np.zeros((B,), np.int32),
+                                np.full((B,), S, np.int32),
+                                np.zeros((B, W), np.int32),
+                                np.zeros((B,), bool),
+                                np.ones((B,), np.float32),
+                                np.zeros((B,), np.uint32),
+                                np.zeros((B,), np.int32),
+                                None, top_n,
+                            )
+                            count += 1
+            return count
+
+        return await self.run_on_engine_thread(_warm)
 
     def _serve_embed(self, token_ids: list[int], fut, loop) -> None:
         try:
@@ -1035,7 +1206,10 @@ class TpuEngine:
         """Tokens already sampled on device for this sequence but not yet
         drained/emitted (its host-visible length lags by this many): K
         steps per in-flight window it rides plus an unfetched admission
-        sample."""
+        sample. _Spec items are invisible here BY INVARIANT: their
+        pending count is data-dependent (1 + accepted), so
+        _decode_iteration force-drains any queued _Spec before any
+        planning that consults _pend."""
         p = 1 if seq.first_pend else 0
         for item in self._fetchq:
             if isinstance(item, _Window) and seq in item.row_of:
@@ -1056,12 +1230,14 @@ class TpuEngine:
                 break
             self._drain_one(self._fetchq.popleft())
 
-    def _drain_one(self, item: "_First | _Window") -> None:
+    def _drain_one(self, item: "_First | _Window | _Spec") -> None:
         """Fetch + emit one queue item, attributing the fetch time by
         whether the host actually had to wait for it."""
         ready = host_ready(item.fetch_arrays())
         if isinstance(item, _First):
             self._drain_first(item, blocked=not ready)
+        elif isinstance(item, _Spec):
+            self._drain_spec(item, blocked=not ready)
         else:
             self._drain_window(item, blocked=not ready)
 
@@ -1102,8 +1278,17 @@ class TpuEngine:
         return K, depth
 
     def _decode_iteration(self) -> None:
+        # A queued _Spec hides an unknown number of pending tokens per
+        # row (1 + accepted), so no decode work may be PLANNED past it:
+        # positions, block lookahead and chain pends would all be wrong.
+        # Its fetch has been in flight since dispatch (overlapping the
+        # admission/prefill work _step did meanwhile); settle it first.
+        if any(isinstance(it, _Spec) for it in self._fetchq):
+            self._drain_completed(force=True)
         if not self._running:
             self._drain_completed(force=True)
+            return
+        if self._try_speculative():
             return
         K, depth = self._plan_window()
         if depth == 0 and self._fetchq:
@@ -1245,6 +1430,10 @@ class TpuEngine:
         for i, seq in enumerate(w.rows):
             if seq.dead:
                 continue  # finished/cancelled while this window was in flight
+            # Dense accounting: K per-sequence weight passes, one token
+            # each (the tokens-per-weight-pass denominator/numerator).
+            self.total_row_passes += w.K
+            self.total_row_tokens += w.K
             seq.kv_written = w.pos0[i] + w.K
             self._register_written_blocks(seq)
             tops = None
@@ -1255,6 +1444,202 @@ class TpuEngine:
                     for j in range(w.K)
                 ]
             self._emit_tokens(seq, toks_l[i], logps_l[i], tops)
+        self._phase("emit", t0)
+
+    # -- speculative decoding ---------------------------------------------
+    #
+    # Decode is weight-bandwidth-bound: a dense substep streams the full
+    # weights for ONE token per sequence. A speculative pass streams them
+    # once for up to spec_tokens+1 tokens per sequence: the host drafts
+    # each row's likely continuation by n-gram prompt lookup (free), the
+    # device scores draft_len+1 positions in one forward
+    # (model.spec_verify — a decode-time prefill chunk over the same
+    # paged-attention path), and on-device acceptance keeps the longest
+    # prefix the target model agrees with plus one corrected/bonus token.
+    # Greedy rows are byte-identical to the dense path (argmax match);
+    # sampled rows use rejection sampling, leaving the output
+    # distribution unchanged.
+    #
+    # Scheduling contract: drafting needs the full host-visible history
+    # and the drain reveals how far each row advanced, so a speculative
+    # pass is a pipeline BARRIER — everything pending drains before
+    # dispatch, and the pass itself drains before the next decode plan
+    # (admission + prefill dispatch still overlap it: the _Spec rides
+    # _fetchq with its fetch in flight while _step admits new work).
+    # Rows whose drafts keep being rejected (or that never match) decay
+    # an acceptance EMA / enter a probe cooldown, so incompressible
+    # workloads fall back to the dense window pipeline at full depth.
+
+    def _row_draft(self, seq: _Seq, S: int) -> list[int]:
+        """Propose up to S draft tokens for one row, applying the
+        adaptive controls. Empty ⇒ the row rides the pass with
+        draft_len 0 (a plain next-token step) or, if no row drafts,
+        the batch falls back to the dense path entirely."""
+        args = self.args
+        # Never draft past the model length: the pass emits up to
+        # draft_len+1 tokens and writes KV at positions0+draft_len.
+        cap = min(S, args.max_model_len - len(seq.tokens) - 1)
+        if cap <= 0 or seq.spec_cool > 0:
+            return []
+        # EMA-proportional shrink: full drafts at ema >= 0.5, linearly
+        # shorter below, floor 1 — a just-re-enabled low-EMA row proposes
+        # a naturally short probe, and acceptance lifts the EMA back up.
+        eff = min(cap, max(1, round(S * min(1.0, seq.spec_ema / 0.5))))
+        if seq.draft_state is None:
+            seq.draft_state = self._drafter.new_state()
+        return self._drafter.draft(seq.tokens, seq.draft_state, eff)
+
+    def _spec_gate_passes(self, drafts: dict["_Seq", list[int]]) -> bool:
+        """Batch-level dispatch decision: the EMA-weighted expected
+        tokens per row-pass, mean(1 + ema_i * draft_len_i), must clear
+        spec_gate — and at least one draft must exist at all."""
+        if not drafts or not any(drafts.values()):
+            return False
+        expected = sum(
+            1.0 + s.spec_ema * len(d) for s, d in drafts.items()
+        ) / len(drafts)
+        return expected >= self.args.spec_gate
+
+    def _try_speculative(self) -> bool:
+        """Dispatch one speculative verify pass over the running set if
+        it is eligible and at least one row has a draft. → True when a
+        pass was dispatched (the caller's decode iteration is done).
+
+        Two-phase drafting keeps the dense pipeline intact when there is
+        nothing to verify: a cheap scan over the HOST-VISIBLE history
+        (which may lag in-flight windows) decides whether draining the
+        pipeline could pay off at all; only a scan hit forces the drain,
+        after which rows re-draft on their complete histories for the
+        actual dispatch. The drafter's incremental index makes the
+        per-iteration scan O(newly visible tokens)."""
+        S = self.spec_tokens
+        if S <= 0:
+            return False
+        # Full-sampler rows need host-visible penalty windows stepwise;
+        # same constraint that forces the dense path unpipelined.
+        if any(self._needs_full_sampler(s) for s in self._running):
+            return False
+        # Tick rejection cooldowns once per scheduler STEP (this method
+        # can run twice in a step when a drain forces a replan): a row
+        # whose acceptance EMA collapsed proposes nothing until its
+        # cooldown expires, then re-probes with an EMA-shortened draft.
+        if self._spec_ticked != self._step_no:
+            self._spec_ticked = self._step_no
+            for s in self._running:
+                if s.spec_cool > 0:
+                    s.spec_cool -= 1
+        t0 = time.perf_counter()
+        drafts = {s: self._row_draft(s, S) for s in self._running}
+        if not self._spec_gate_passes(drafts):
+            self._phase("draft", t0)
+            return False
+        # The gate passed on the visible history: drafting positions +
+        # inputs need COMPLETE histories, so settle everything in flight,
+        # then re-draft rows whose histories just advanced.
+        if self._fetchq:
+            self._phase("draft", t0)
+            self._drain_completed(force=True)
+            if not self._running:
+                return True
+            t0 = time.perf_counter()
+            drafts = {s: self._row_draft(s, S) for s in self._running}
+        t0 = self._phase("draft", t0)
+        if not self._spec_gate_passes(drafts):
+            return False
+        batch = list(self._running)
+        # Cover writes at positions0 + draft_len; rows that cannot grow
+        # fall back to the dense path's pressure handling (drain/preempt).
+        for seq in batch:
+            if not self._ensure_block(seq, lookahead=len(drafts[seq]) + 1):
+                return False
+        B = self.args.bucket_decode(len(batch))
+        S1 = S + 1
+        W = self.args.bucket_table(max(len(s.block_ids) for s in batch))
+        tokens = np.zeros((B, S1), np.int32)
+        pos0_arr = np.zeros((B,), np.int32)
+        dlen = np.zeros((B,), np.int32)
+        tables = np.zeros((B, W), np.int32)
+        active = np.zeros((B,), bool)
+        fold_slots = np.full((B,), self.args.max_num_seqs, np.int32)
+        temps = np.ones((B,), np.float32)
+        seeds = np.zeros((B,), np.uint32)
+        steps0 = np.zeros((B,), np.int32)
+        pos0: list[int] = []
+        draft_lens: list[int] = []
+        for i, seq in enumerate(batch):
+            d = drafts[seq]
+            tokens[i, 0] = seq.tokens[-1]
+            tokens[i, 1 : 1 + len(d)] = d
+            p0 = seq.next_write_pos
+            pos0.append(p0)
+            pos0_arr[i] = p0
+            dlen[i] = len(d)
+            draft_lens.append(len(d))
+            tables[i, : len(seq.block_ids)] = seq.block_ids
+            active[i] = True
+            fold_slots[i] = seq.slot
+            temps[i] = seq.sampling.temperature
+            seeds[i] = seq.sample_seed
+            steps0[i] = seq.emitted
+        mode = "greedy" if all(t < 1e-5 for t in temps[: len(batch)]) else "simple"
+        top_n = (
+            self.args.top_logprobs_max
+            if any(s.sampling.top_logprobs for s in batch) else 0
+        )
+        ref = self._runner.spec_verify(
+            S1, mode, tokens, pos0_arr, dlen, tables, active,
+            temps, seeds, steps0, fold_slots, top_n,
+        )
+        item = _Spec(batch, pos0, draft_lens, ref, top_n)
+        start_host_fetch(item.fetch_arrays())
+        self._fetchq.append(item)
+        self._phase("spec_dispatch", t0)
+        return True
+
+    def _drain_spec(self, sp: "_Spec", blocked: bool = True) -> None:
+        self.total_spec_passes += 1
+        t0 = time.perf_counter()
+        out_l = np.asarray(sp.ref.arrs[0]).tolist()     # [B][S1]
+        n_emit_l = np.asarray(sp.ref.arrs[1]).tolist()  # [B]
+        logps_l = np.asarray(sp.ref.arrs[2]).tolist()   # [B][S1]
+        tvals_l = tids_l = None
+        if sp.top_n:
+            tvals_l = np.asarray(sp.ref.arrs[3]).tolist()  # [B][S1][n]
+            tids_l = np.asarray(sp.ref.arrs[4]).tolist()
+        t0 = self._phase("drain_sync" if blocked else "drain_ready", t0)
+        alpha = self.args.spec_ema_alpha
+        for i, seq in enumerate(sp.rows):
+            if seq.dead:
+                continue  # finished/cancelled while the pass was in flight
+            n = int(n_emit_l[i])
+            a = n - 1
+            S_i = sp.draft_lens[i]
+            self.total_spec_rows += 1
+            self.total_spec_emitted += n
+            self.total_row_passes += 1
+            self.total_row_tokens += n
+            if S_i > 0:
+                self.total_spec_proposed += S_i
+                self.total_spec_accepted += a
+                seq.spec_ema = (1 - alpha) * seq.spec_ema + alpha * (a / S_i)
+                if seq.spec_ema < self.args.spec_ema_disable:
+                    seq.spec_cool = self.args.spec_probe_every
+            # Positions p0..p0+a hold CORRECT KV ([last, accepted
+            # drafts]); the correction/bonus token's KV lands on the next
+            # dispatch, exactly like a dense window's last sample. Junk
+            # KV past the boundary is never registered and gets rewritten
+            # by the next dispatch (next_write_pos rolls back with the
+            # emitted count).
+            seq.kv_written = sp.pos0[i] + n
+            self._register_written_blocks(seq)
+            tops = None
+            if tids_l is not None and seq.sampling.top_logprobs:
+                tn = seq.sampling.top_logprobs
+                tops = [
+                    [list(p) for p in zip(tids_l[i][j][:tn], tvals_l[i][j][:tn])]
+                    for j in range(n)
+                ]
+            self._emit_tokens(seq, out_l[i][:n], logps_l[i][:n], tops)
         self._phase("emit", t0)
 
     def _decode_single_step(self) -> None:
@@ -1278,6 +1663,8 @@ class TpuEngine:
             active[i] = True
         ref = self._runner.decode_step(tokens, positions, tables, active)
         self.total_decode_steps += 1
+        self.total_row_passes += len(batch)
+        self.total_row_tokens += len(batch)
         # The step just wrote each sequence's KV at `positions[i]`.
         for i, seq in enumerate(batch):
             seq.kv_written = int(positions[i]) + 1

@@ -543,6 +543,159 @@ def multi_decode_impl(
     return toks, logps, top_vals, top_ids, cache  # [num_steps, B(, top_n)]
 
 
+def spec_verify_impl(
+    cfg: ModelConfig,
+    S1: int,                  # static — draft slots + 1 ([last, d1..dS])
+    mode: str,                # static — "greedy" | "simple"
+    top_n: int,               # static — top-n alternative logprobs (0 = off)
+    params: Params,
+    cache: KVCache,
+    tokens: jax.Array,        # [B, S1] int32 — [last_token, draft_1..draft_S]
+    positions0: jax.Array,    # [B] int32 — position of last_token
+    draft_len: jax.Array,     # [B] int32 — true draft length per row (≤ S1-1)
+    block_tables: jax.Array,  # [B, W] int32 (must cover positions0+draft_len)
+    active: jax.Array,        # [B] bool
+    temperature: jax.Array,   # [B] fp32 (<=0 → greedy row)
+    seeds: jax.Array,         # [B] uint32 per-row sample seed
+    steps0: jax.Array,        # [B] int32 per-row emission index of the first token
+    *,
+    fused: bool = True,       # static — single-pass forward vs stepwise scan
+    attn_impl: str = "auto",  # stepwise path's attention backend
+) -> tuple[jax.Array, ...]:
+    """Speculative verify: score S1 consecutive positions per row in one
+    dispatch. Input j writes its KV at positions0+j and position j's
+    logits score the token FOLLOWING input j, exactly as
+    ``decode_step_impl`` would have on the j-th sequential step.
+
+    Two forward shapes behind the same contract:
+
+    - ``fused=True`` (default): ONE forward over all S1 positions — the
+      single weight stream that makes speculation a bandwidth win
+      (tokens-per-weight-pass > 1). Mathematically identical to the
+      stepwise path; floating-point reduction order in the batched
+      matmuls can differ from the dense step's at the last ulp on some
+      backends (greedy token streams match in practice, reported logprob
+      VALUES may differ by ~1e-7).
+    - ``fused=False``: a teacher-forced ``lax.scan`` of the SAME
+      ``decode_step_impl`` the dense path runs — bitwise identical to
+      dense decode on every backend by construction. Weights stream S1
+      times, so this keeps only the dispatch/fetch saving (one host
+      roundtrip per S1 tokens); it is the parity/debug mode and the
+      golden suite's byte-identity anchor.
+
+    Per-position validity: slot j of a row is live when j <= draft_len
+    (slot 0, the last real token, always is). Dead slots and inactive
+    rows scatter their KV to garbage block 0, and causal masking keeps
+    live queries from ever seeing them. KV written for drafts BEYOND the
+    accepted run is junk by construction — the engine rolls
+    ``next_write_pos`` back to the acceptance boundary and the very next
+    dispatch rewrites those positions (block lookahead already covers
+    them), so nothing downstream observes it.
+
+    Returns (out [B, S1] emitted tokens, n_emit [B] = accepted+1,
+    logps [B, S1] raw chosen-token logprobs, top_vals [B, S1, top_n],
+    top_ids [B, S1, top_n], last_tok [B] = out[b, n_emit-1] for the
+    chain-buffer fold, cache)."""
+    from dynamo_tpu.engine.sampler import (
+        spec_acceptance,
+        top_k_logprobs,
+    )
+    from dynamo_tpu.ops.paged_attention import paged_spec_attention_xla
+
+    B, T = tokens.shape
+    bs = cache.k.shape[2]
+    KVH, hd = cfg.num_kv_heads, cfg.head_dim
+    pos = positions0[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    use = active[:, None] & (
+        jnp.arange(T, dtype=jnp.int32)[None, :] <= draft_len[:, None]
+    )                                                                    # [B, T]
+
+    if fused:
+        compute_dtype = params["layers"]["attn_norm"].dtype
+        x = _embed_rows(params, tokens, compute_dtype)  # [B, T, D]
+
+        blk = jnp.where(
+            use, jnp.take_along_axis(block_tables, pos // bs, axis=1), 0
+        )
+        off = jnp.where(use, pos % bs, 0)
+        lengths = jnp.where(use, pos + 1, 0)  # [B, T] — query j attends [0, pos_j]
+
+        G = cfg.num_heads // KVH
+
+        def layer(carry, xs):
+            x, k_cache, v_cache = carry
+            lp, layer_idx = xs
+            h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q, k, v = _qkv(h, lp, cfg)
+            q = q.reshape(B, T, cfg.num_heads, hd)
+            k = k.reshape(B, T, KVH, hd)
+            v = v.reshape(B, T, KVH, hd)
+            q = _rope(q, pos, cfg.rope_theta)
+            k = _rope(k, pos, cfg.rope_theta)
+            qg = q.reshape(B, T, KVH, G, hd)
+
+            # Scatter all T new KV entries, then gather-attend: in-chunk
+            # keys come back out of the pages, so query j sees inputs
+            # 0..j through the same path the dense step does
+            # (write-then-attend).
+            k_cache = k_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                k.reshape(B * T, cfg.kv_size)
+            )
+            v_cache = v_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                v.reshape(B * T, cfg.kv_size)
+            )
+            o = paged_spec_attention_xla(
+                qg, k_cache, v_cache, layer_idx, block_tables, lengths
+            )
+            o = o.reshape(B, T, cfg.q_size)
+            x = x + _dot_q(o, lp, "wo")
+
+            h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _ffn(h, lp, cfg)
+            return (x, k_cache, v_cache), None
+
+        layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        (x, k_cache, v_cache), _ = lax.scan(
+            layer, (x, cache.k, cache.v), (params["layers"], layer_ids)
+        )
+        logits = _logits(cfg, params, x)  # [B, T, V] fp32
+        cache = KVCache(k_cache, v_cache)
+    else:
+        def substep(c, xs):
+            tok_j, pos_j, use_j = xs
+            lg, c = decode_step_impl(
+                cfg, params, c, tok_j, pos_j, block_tables, use_j,
+                attn_impl=attn_impl,
+            )
+            return c, lg
+
+        cache, logits_t = lax.scan(
+            substep, cache,
+            (tokens.T, pos.T, use.T),
+        )
+        logits = jnp.transpose(logits_t, (1, 0, 2))  # [B, T, V] fp32
+
+    drafts = tokens[:, 1:]
+    out, n_emit = spec_acceptance(
+        logits, drafts, draft_len, temperature, seeds, steps0, mode
+    )
+    # Raw-distribution logprobs of the EMITTED tokens (dense parity:
+    # OpenAI reports model logprobs, not sampler-modified ones).
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    logps = (
+        jnp.take_along_axis(logits, out[:, :, None], axis=-1)[..., 0] - logz
+    )                                                      # [B, T]
+    if top_n > 0:
+        flat_vals, flat_ids = top_k_logprobs(logits.reshape(B * T, -1), top_n)
+        top_vals = flat_vals.reshape(B, T, top_n)
+        top_ids = flat_ids.reshape(B, T, top_n)
+    else:
+        top_vals = jnp.zeros((B, T, 0), jnp.float32)
+        top_ids = jnp.zeros((B, T, 0), jnp.int32)
+    last_tok = jnp.take_along_axis(out, (n_emit - 1)[:, None], axis=1)[:, 0]
+    return out, n_emit, logps, top_vals, top_ids, last_tok, cache
+
+
 def embed_impl(
     cfg: ModelConfig,
     params: Params,
@@ -596,4 +749,8 @@ decode_step = functools.partial(
 multi_decode = functools.partial(
     jax.jit, static_argnums=(0, 1, 2, 3), static_argnames=("attn_impl",), donate_argnums=(5,)
 )(multi_decode_impl)
+spec_verify = functools.partial(
+    jax.jit, static_argnums=(0, 1, 2, 3),
+    static_argnames=("fused", "attn_impl"), donate_argnums=(5,)
+)(spec_verify_impl)
 embed = functools.partial(jax.jit, static_argnums=(0,))(embed_impl)

@@ -145,6 +145,116 @@ def top_k_logprobs(logits: jax.Array, n: int) -> tuple[jax.Array, jax.Array]:
     return vals - logz, ids
 
 
+def _spec_uniform(seeds: jax.Array, steps: jax.Array) -> jax.Array:
+    """Per-(row, draft-position) accept uniforms for speculative
+    rejection sampling: key = fold(fold(PRNGKey(seed), step), 1). The
+    extra tag fold keeps the stream disjoint from the dense path's
+    (seed, step) gumbel stream and from the residual gumbels below."""
+
+    def one(s, e):
+        key = jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(s), e), 1)
+        return jax.random.uniform(key, (), jnp.float32, minval=1e-12, maxval=1.0)
+
+    return jax.vmap(jax.vmap(one, in_axes=(None, 0)), in_axes=(0, 0))(seeds, steps)
+
+
+def _spec_gumbel(seeds: jax.Array, steps: jax.Array, dense_stream: jax.Array,
+                 V: int) -> jax.Array:
+    """Per-(row, position) gumbel noise for residual/bonus samples.
+    Rows with ``dense_stream`` True draw from the dense path's exact
+    (seed, step) key — a row that proposed NO draft then samples its one
+    token byte-identically to ``sample_simple`` (speculation is a true
+    no-op for it); drafted rows use a tag-folded key so their residual
+    draws stay disjoint from every dense draw."""
+
+    def one(s, e, dense):
+        base = jax.random.fold_in(jax.random.PRNGKey(s), e)
+        tagged = jax.random.fold_in(base, 2)
+        key = jnp.where(dense, base, tagged)
+        return jax.random.gumbel(key, (V,), jnp.float32)
+
+    return jax.vmap(
+        jax.vmap(one, in_axes=(None, 0, None)), in_axes=(0, 0, 0)
+    )(seeds, steps, dense_stream)
+
+
+def spec_acceptance(
+    logits: jax.Array,       # [B, S1, V] fp32 — raw verify-pass logits
+    drafts: jax.Array,       # [B, S] int32 — proposed draft tokens
+    draft_len: jax.Array,    # [B] int32 — per-row true draft length (≤ S)
+    temperature: jax.Array,  # [B] fp32 (simple mode; <= 0 → greedy row)
+    seeds: jax.Array,        # [B] uint32 per-row sample seed
+    steps0: jax.Array,       # [B] int32 emission index of the pass's first token
+    mode: str,               # static — "greedy" | "simple"
+) -> tuple[jax.Array, jax.Array]:
+    """Speculative acceptance over one verify pass → (out [B, S1] int32,
+    n_emit [B] int32). Position j's logits score the token that FOLLOWS
+    input j, so draft j+1 is checked against position j; the first
+    rejected position (or the bonus position S when everything is
+    accepted) emits a corrected/bonus token instead. ``out[:, :n_emit]``
+    is the emitted run — accepted drafts then exactly one correction.
+
+    - "greedy": accept on exact argmax match; emitted tokens are the
+      argmax chain, byte-identical to the dense greedy path (no RNG).
+    - "simple": Leviathan-style rejection sampling against the point-mass
+      n-gram draft: accept draft d with probability p(d) (one uniform per
+      position); on rejection sample from the residual p restricted to
+      tokens != d (gumbel-argmax with d masked), which for a point-mass
+      proposal leaves the target distribution exactly unchanged. Greedy
+      rows inside a simple batch reduce to the argmax rule."""
+    B, S1, V = logits.shape
+    S = S1 - 1
+    jidx = jnp.arange(S, dtype=jnp.int32)[None, :]           # [1, S]
+    cand_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S1]
+    if mode == "greedy":
+        accept = (drafts == cand_greedy[:, :-1]) & (jidx < draft_len[:, None])
+        out = cand_greedy
+    else:
+        greedy = temperature < _GREEDY_EPS
+        temp = jnp.where(greedy, 1.0, temperature)
+        scaled = logits / temp[:, None, None]
+        logz = jax.nn.logsumexp(scaled, axis=-1)             # [B, S1]
+        d_lp = (
+            jnp.take_along_axis(scaled[:, :-1], drafts[:, :, None], axis=-1)[..., 0]
+            - logz[:, :-1]
+        )                                                    # [B, S]
+        steps = steps0[:, None] + jnp.arange(S1, dtype=jnp.int32)[None, :]
+        u = _spec_uniform(seeds, steps[:, :-1])                    # [B, S]
+        accept = jnp.where(
+            greedy[:, None],
+            drafts == cand_greedy[:, :-1],
+            jnp.log(u) < d_lp,
+        ) & (jidx < draft_len[:, None])
+        # Residual candidates: gumbel-argmax with the rejected draft
+        # masked out — at TRUE proposal positions only (j < draft_len);
+        # the bonus position (all drafts accepted, or no draft at all)
+        # samples the unmasked target distribution. Greedy rows take the
+        # raw argmax (their residual IS the argmax — a greedy rejection
+        # means draft != argmax).
+        gumbel = _spec_gumbel(seeds, steps, draft_len == 0, V)     # [B, S1, V]
+        noisy = scaled + gumbel
+        mask = jnp.zeros((B, S1, V), bool).at[
+            jnp.arange(B)[:, None], jidx, drafts
+        ].set(True)
+        mask = mask & (
+            jnp.arange(S1, dtype=jnp.int32)[None, :] < draft_len[:, None]
+        )[..., None]
+        cand_sampled = jnp.argmax(
+            jnp.where(mask, -jnp.inf, noisy), axis=-1
+        ).astype(jnp.int32)
+        cand = jnp.where(greedy[:, None], cand_greedy, cand_sampled)
+        # Accepted positions emit the draft itself; the first rejection /
+        # bonus position emits the candidate.
+        a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+        out = jnp.where(
+            jnp.arange(S1, dtype=jnp.int32)[None, :] < a[:, None],
+            jnp.pad(drafts, ((0, 0), (0, 1))), cand,
+        )
+        return out, a + 1
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)  # [B]
+    return out, a + 1
+
+
 def row_needs_full(top_k, top_p, freq_penalty, pres_penalty) -> bool:
     """Does one request's sampling config require the full sampler? The
     single source of truth for the simple/full split."""

@@ -98,6 +98,41 @@ def paged_decode_attention_xla(
     return jnp.einsum("bkgc,bckh->bkgh", p, pv)
 
 
+def paged_spec_attention_xla(
+    q: jax.Array,            # [B, T, KVH, G, hd] — T consecutive query positions
+    k_cache: jax.Array,      # [L, N, bs, KVH*hd]
+    v_cache: jax.Array,
+    layer_idx: jax.Array,    # scalar int32
+    block_tables: jax.Array, # [B, W] int32
+    lengths: jax.Array,      # [B, T] int32 — query t attends [0, lengths[b, t])
+) -> jax.Array:
+    """Multi-query generalization of ``paged_decode_attention_xla`` for
+    the speculative verify pass: T consecutive positions per row attend
+    their own causal prefix out of the SAME gathered pages (one gather
+    per layer for all T queries — the single-pass shape that lets a
+    verify step score draft_len+1 logit rows in one weight stream).
+    T=1 reduces exactly to the decode formulation, so CPU/XLA greedy
+    byte-identity between the spec and dense paths holds by construction.
+    Returns [B, T, KVH, G, hd] in q.dtype. (A Pallas multi-query kernel
+    is the TPU upgrade path, same seam as the decode kernel.)"""
+    B, T, KVH, G, hd = q.shape
+    W = block_tables.shape[1]
+    bs = k_cache.shape[2]
+    layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
+    layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
+    pk = layer_k[block_tables].reshape(B, W * bs, KVH, hd)
+    pv = layer_v[block_tables].reshape(B, W * bs, KVH, hd)
+    scale = hd ** -0.5
+    ctx = jnp.arange(W * bs, dtype=jnp.int32)
+    mask = jnp.where(
+        ctx[None, None, :] < lengths[:, :, None], 0.0, jnp.float32(NEG_INF)
+    )                                                       # [B, T, W*bs]
+    s = jnp.einsum("btkgh,bckh->btkgc", q, pk).astype(jnp.float32) * scale
+    s = s + mask[:, :, None, None, :]
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("btkgc,bckh->btkgh", p, pv)
+
+
 # ---------------------------------------------------------------------------
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------

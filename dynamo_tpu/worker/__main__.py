@@ -73,6 +73,20 @@ def parse_args(argv=None):
     # to that much ITL; keep <= one decode step).
     p.add_argument("--delta-max-tokens", type=int, default=64)
     p.add_argument("--delta-max-ms", type=float, default=0.0)
+    # Speculative decoding: n-gram prompt-lookup drafts verified in one
+    # batched forward per pass (engine/drafter.py + model.spec_verify).
+    # 0 = off. Greedy output is byte-identical to the dense path; sampled
+    # requests keep their exact distribution via rejection sampling. A
+    # per-sequence acceptance EMA auto-disables speculation on
+    # incompressible streams.
+    p.add_argument("--spec-tokens", type=int, default=0,
+                   help="max draft tokens verified per speculative pass (0 = off)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="n-gram match length for the prompt-lookup drafter")
+    p.add_argument("--spec-stepwise", action="store_true",
+                   help="verify drafts with a stepwise scan (bitwise parity "
+                        "with the dense path; forfeits the single-weight-"
+                        "stream win) instead of the fused single-pass forward")
     p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
                    default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -384,6 +398,9 @@ def _engine_args(args, model):
         prefill_tail_split=not args.no_prefill_tail_split,
         delta_max_tokens=args.delta_max_tokens,
         delta_max_ms=args.delta_max_ms,
+        spec_tokens=args.spec_tokens,
+        spec_ngram=args.spec_ngram,
+        spec_fused=not args.spec_stepwise,
         attn_impl=args.attn_impl,
         quant=args.quant,
         host_kv_blocks=args.host_kv_blocks,

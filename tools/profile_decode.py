@@ -82,22 +82,33 @@ async def engine_hotloop(
     prompt_len: int = 24,
     gen_len: int = 16,
     seed: int = 0,
+    spec_tokens: int = 0,
+    spec_ngram: int = 3,
+    spec_gate: float | None = None,
+    spec_fused: bool = True,
+    repetitive: bool = False,
 ) -> dict:
     """Drive the real TpuEngine scheduler through a small concurrent
     workload → {tokens (per-request streams), host_blocked_frac,
-    host_phase_s, prefill_pad_ratio, decode_tok_s}."""
+    host_phase_s, prefill_pad_ratio, decode_tok_s} plus the speculation
+    series (accept rate, tokens/pass, draft overhead) when spec_tokens
+    > 0. ``repetitive`` tiles a short pattern into each prompt (the
+    n-gram-overlap shape speculation targets)."""
     from dynamo_tpu.engine.config import EngineArgs, ModelConfig
     from dynamo_tpu.engine.engine import BLOCKING_PHASES, TpuEngine
     from dynamo_tpu.llm.protocols import PreprocessedRequest
     from dynamo_tpu.runtime.engine import Context
 
     cfg = ModelConfig.preset(model)
+    kw = {} if spec_gate is None else {"spec_gate": spec_gate}
     eargs = EngineArgs(
         model=cfg, block_size=4, num_kv_blocks=256, max_num_seqs=8,
         max_model_len=256, max_prefill_tokens=128,
         dtype="float32" if cfg.name == "test-tiny" else "bfloat16",
         decode_steps=decode_steps,
         pipeline_depth=pipeline_depth, pipeline_windows=pipeline_depth > 0,
+        spec_tokens=spec_tokens, spec_ngram=spec_ngram,
+        spec_fused=spec_fused, **kw,
     )
     engine = await TpuEngine(eargs, seed=0).start()
     try:
@@ -105,7 +116,11 @@ async def engine_hotloop(
         reqs = []
         for i in range(n_requests):
             plen = int(prompt_len + (i * 7) % 17)  # mixed lengths, deterministic
-            toks = rng.integers(1, cfg.vocab_size - 1, size=plen).tolist()
+            if repetitive:
+                pat = rng.integers(1, cfg.vocab_size - 1, size=4 + i % 5).tolist()
+                toks = (pat * (plen // len(pat) + 1))[:plen]
+            else:
+                toks = rng.integers(1, cfg.vocab_size - 1, size=plen).tolist()
             req = PreprocessedRequest(model=cfg.name, token_ids=toks)
             req.sampling.temperature = 0.0
             # Explicit per-request seed: unseeded requests draw from the
@@ -130,7 +145,7 @@ async def engine_hotloop(
         blocked = sum(
             engine.phase_s.get(k, 0.0) - phase0.get(k, 0.0) for k in BLOCKING_PHASES
         )
-        return {
+        out = {
             "pipeline_depth": pipeline_depth,
             "tokens": streams,
             "total_tokens": sum(len(s) for s in streams),
@@ -145,13 +160,59 @@ async def engine_hotloop(
                 engine.total_prefill_padded / max(1, engine.total_prefilled), 3
             ),
         }
+        if spec_tokens > 0:
+            out.update({
+                "spec_tokens": spec_tokens,
+                "spec_rows": engine.total_spec_rows,
+                "spec_proposed": engine.total_spec_proposed,
+                "spec_accepted": engine.total_spec_accepted,
+                "spec_accept_rate": round(
+                    engine.total_spec_accepted / max(1, engine.total_spec_proposed), 3
+                ),
+                "spec_tokens_per_pass": round(
+                    engine.total_spec_emitted / max(1, engine.total_spec_rows), 2
+                ),
+                "spec_draft_s": round(engine.phase_s.get("draft", 0.0), 4),
+            })
+        return out
     finally:
         await engine.stop()
 
 
+# Quick-tier spec-sweep shape — shared by run_spec_sweep and run_quick's
+# token-accounting assertion so retuning one can't silently break the other.
+QUICK_SPEC_REQUESTS = 6
+QUICK_SPEC_GEN = 24
+
+
+def run_spec_sweep(*, quick: bool = False, pipeline_depth: int = 2,
+                   decode_steps: int = 4) -> dict:
+    """``--spec`` probe: sweep draft length S ∈ {0, 2, 4, 8} on the real
+    scheduler over a repetitive workload → per-S acceptance rate, tok/s
+    and host overhead. The S=0 row is the dense reference. The quick
+    tier pins the stepwise verify so its byte-equality assertion holds
+    on any backend (the fused forward's reduction order may differ from
+    the dense kernel's at the last ulp); the standalone sweep measures
+    the fused production path."""
+    gen_len = QUICK_SPEC_GEN if quick else 64
+    n_requests = QUICK_SPEC_REQUESTS if quick else 8
+    out = {}
+    for S in (0, 2, 4, 8):
+        r = asyncio.run(engine_hotloop(
+            pipeline_depth, decode_steps=decode_steps,
+            n_requests=n_requests, gen_len=gen_len,
+            spec_tokens=S, spec_gate=0.0, spec_fused=not quick,
+            repetitive=True,
+        ))
+        out[S] = r
+    return out
+
+
 def run_quick() -> int:
     """Tier-1 smoke: ablations at toy shapes + hot-loop probe at depths
-    0/2 with golden token equality. Prints QUICK-OK on success."""
+    0/2 with golden token equality + the --spec sweep with golden
+    S=0-vs-S>0 equality (greedy speculation must be byte-invisible).
+    Prints QUICK-OK on success."""
     gen_len = 16
     n_requests = 6
     results = {}
@@ -166,11 +227,28 @@ def run_quick() -> int:
     assert results[0]["tokens"] == results[2]["tokens"], (
         "pipelined (depth 2) and unpipelined token streams diverged"
     )
+    spec = run_spec_sweep(quick=True)
+    for S, r in spec.items():
+        assert r["total_tokens"] == QUICK_SPEC_REQUESTS * QUICK_SPEC_GEN, (
+            f"spec S={S}: lost tokens — {r['total_tokens']}"
+        )
+        if S > 0:
+            assert r["tokens"] == spec[0]["tokens"], (
+                f"speculative (S={S}) and dense token streams diverged"
+            )
+            assert r["spec_accepted"] <= r["spec_proposed"], "spec accounting"
+    assert any(r.get("spec_rows", 0) > 0 for r in spec.values()), (
+        "spec sweep never dispatched a verify pass — the probe has rotted"
+    )
     out = {
         d: {k: v for k, v in r.items() if k != "tokens"}
         for d, r in results.items()
     }
-    print(json.dumps({"hotloop": out}))
+    spec_out = {
+        S: {k: v for k, v in r.items() if k != "tokens"}
+        for S, r in spec.items()
+    }
+    print(json.dumps({"hotloop": out, "spec": spec_out}))
     print("QUICK-OK")
     return 0
 
@@ -187,6 +265,10 @@ def main():
     p.add_argument("--cpu", action="store_true")
     p.add_argument("--hotloop", action="store_true",
                    help="run the TpuEngine scheduler probe instead of the ablations")
+    p.add_argument("--spec", action="store_true",
+                   help="sweep speculative draft length S in {0,2,4,8} on the "
+                        "real scheduler (repetitive workload): acceptance, "
+                        "tok/s, host overhead per S")
     p.add_argument("--pipeline-depth", type=int, default=2)
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke: CPU tiny shapes + depth-0/2 golden hot-loop probe")
@@ -209,6 +291,14 @@ def main():
         ))
         r.pop("tokens")
         print(json.dumps(r))
+        return 0
+    if args.spec:
+        sweep = run_spec_sweep(
+            pipeline_depth=args.pipeline_depth, decode_steps=args.decode_steps,
+        )
+        for S, r in sweep.items():
+            r.pop("tokens")
+            print(json.dumps({"spec_tokens": S, **r}))
         return 0
 
     from dynamo_tpu.engine import model as M
