@@ -246,6 +246,24 @@ def register_engine_metrics(registry):
 
 
 class TpuEngine:
+    # Scheduler-state ownership manifest, machine-checked by DT001
+    # (tools/analysis — keep the mirror in checkers/dt001 in sync). Every
+    # attribute named here is owned by the scheduler thread (_run/_step):
+    # async-side code may touch one ONLY under `with self._wakeup:` (the
+    # handoff protocol for _submissions/_embed_jobs/_host_jobs and the
+    # cancel flag) or by shipping a closure via run_on_engine_thread.
+    # Deliberately NOT owned: spec_tokens (documented idle-engine toggle,
+    # read once per scheduler iteration), the total_* counters (monotonic
+    # ints read racily by bench/metrics — stale reads are harmless),
+    # _stopping (always mutex-guarded), and pool/tiers (internally
+    # consistent; cross-thread readers get point-in-time values).
+    _SCHED_OWNED = frozenset({
+        "_submissions", "_waiting", "_running", "_fetchq", "_free_slots",
+        "_embed_jobs", "_host_jobs", "_offload_pending", "_exports",
+        "_drafter", "_step_no", "_spec_ticked", "phase_s", "phase_n",
+        "_ctr_pushed",
+    })
+
     def __init__(
         self,
         args: EngineArgs,

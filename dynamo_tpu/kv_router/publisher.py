@@ -41,6 +41,7 @@ async def _next_or_cancelled(q: asyncio.Queue, ctx: Context):
     try:
         done, _ = await asyncio.wait({getter, canceller}, return_when=asyncio.FIRST_COMPLETED)
         if getter in done:
+            # dyntpu: allow[DT002] reason=getter is in asyncio.wait's done set — result() cannot block, it just unwraps
             return getter.result()
         return None
     finally:

@@ -45,7 +45,7 @@ class OpenAIClient:
 
     def _http(self) -> httpx.AsyncClient:
         if self._client is None:
-            raise RuntimeError("use 'async with OpenAIClient(...)'")
+            raise ValueError("use 'async with OpenAIClient(...)'")
         return self._client
 
     async def _post_json(self, path: str, body: dict) -> dict:
@@ -148,12 +148,12 @@ class OpenAIClient:
 def _safe_json(r: httpx.Response) -> Any:
     try:
         return r.json()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — diagnostic helper: a non-JSON error body is data, not a failure
         return r.text
 
 
 def _safe_json_bytes(b: bytes) -> Any:
     try:
         return json.loads(b)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — diagnostic helper: an unparseable SSE payload is surfaced as text
         return b[:500].decode(errors="replace")

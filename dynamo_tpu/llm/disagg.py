@@ -109,6 +109,7 @@ class PrefillPuller:
             self._task.cancel()
             try:
                 await self._task
+            # dyntpu: allow[DT005] reason=stop() awaits its own cancelled task; CancelledError is the expected outcome and a crash that raced the cancel has no caller left to act on it
             except BaseException:  # noqa: BLE001 — cancellation path
                 pass
 
@@ -245,7 +246,7 @@ class DisaggDecodeHandler:
 
                 return KvPagePayload.from_frames(frames).to_dict()
             return frames[-1]  # legacy single-frame payload
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — remote KV reuse is an optimization; ANY fetch failure falls back to local prefill
             log.warning("kv fetch failed (%s); falling back to local", e)
             return None
 
@@ -314,6 +315,6 @@ class DisaggDecodeHandler:
             if not reply.get("handle"):
                 return None  # prefill ran but exported nothing (tiny prompt)
             return reply["handle"], reply["instance_id"]
-        except Exception as e:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001 — disagg is best-effort; any queue/transfer failure degrades to aggregated serving
             log.warning("queued prefill failed (%s); falling back to local", e)
             return None

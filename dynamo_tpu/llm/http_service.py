@@ -152,7 +152,7 @@ class HttpService:
         model's mean-pooled final hidden states."""
         try:
             body = await request.json()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — HTTP boundary: any malformed body maps to a typed 400, never a 500
             return web.json_response(
                 OpenAIError("request body must be JSON").body(), status=400
             )
@@ -218,7 +218,7 @@ class HttpService:
         for name, pipe in self.manager.items():
             try:
                 out[name] = await pipe.clear_kv_blocks()
-            except Exception as e:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 — admin fan-out: one failing worker must not hide the others' results
                 out[name] = {"error": str(e)}
         return web.json_response({"status": "ok", "cleared": out})
 

@@ -21,7 +21,7 @@ from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.llm.preprocessor import DeltaGenerator, OpenAIPreprocessor
-from dynamo_tpu.llm.protocols import ChatCompletionRequest, CompletionRequest
+from dynamo_tpu.llm.protocols import ChatCompletionRequest, CompletionRequest, EngineError
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
 
@@ -155,7 +155,7 @@ class ModelPipeline:
         ):
             out = item
         if not out or "embedding" not in out:
-            raise RuntimeError((out or {}).get("error", "embedding failed"))
+            raise EngineError((out or {}).get("error", "embedding failed"))
         return out["embedding"]
 
     async def clear_kv_blocks(self) -> dict[str, int]:
@@ -223,7 +223,7 @@ class ModelPipeline:
                 # straight to a preserialized SSE frame (EncodedSse).
                 finish = raw.get("finish_reason")
                 if finish == "error":
-                    raise RuntimeError(raw.get("error") or "engine error")
+                    raise EngineError(raw.get("error") or "engine error")
                 token_ids = raw.get("token_ids") or ()
                 text = raw.get("text")
                 if finish is None and raw.get("log_probs") is None:

@@ -218,6 +218,13 @@ class LLMEngineOutput:
 # ---------------------------------------------------------------------------
 
 
+class EngineError(Exception):
+    """A worker/engine-reported stream failure surfaced to the frontend
+    pipeline (the delta carried ``finish_reason=error``). Typed (DT005) so
+    the HTTP boundary can map it deliberately instead of catching a bare
+    RuntimeError."""
+
+
 class OpenAIError(Exception):
     """Maps to an OpenAI-style error JSON body + HTTP status."""
 

@@ -142,6 +142,7 @@ class RawTokenizer:
                     from tokenizers import AddedToken
 
                     tok.add_special_tokens([AddedToken(t, special=True)])
+                # dyntpu: allow[DT005] reason=special-token registration is cosmetic; decode still works with the token unskipped, and raising here would fail model load over it
                 except Exception:  # noqa: BLE001 — decode still works unskipped
                     pass
 

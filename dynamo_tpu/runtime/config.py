@@ -26,6 +26,11 @@ from typing import Any
 _ENV_PREFIX = "DYNTPU"
 
 
+class ConfigError(Exception):
+    """Startup configuration is unusable (missing parser, bad layer) —
+    typed so launchers can distinguish operator error from a crash."""
+
+
 def _coerce(value: str, typ: Any) -> Any:
     if typ is bool:
         return value.strip().lower() in ("1", "true", "yes", "on")
@@ -158,7 +163,7 @@ class Config:
         toml_path = env.get(f"{_ENV_PREFIX}_CONFIG")
         if toml_path and os.path.exists(toml_path):
             if tomllib is None:
-                raise RuntimeError(
+                raise ConfigError(
                     f"{_ENV_PREFIX}_CONFIG={toml_path!r} set but no TOML parser "
                     "available (Python < 3.11 without tomli)"
                 )

@@ -210,7 +210,7 @@ class DistributedRuntime:
                 await asyncio.sleep(interval)
                 try:
                     await self.store.keep_alive(lease_id)
-                except Exception as e:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001 — keepalive must outlive transient store errors; a missed beat only shortens the lease
                     log.warning("lease keepalive failed: %s", e)
         except asyncio.CancelledError:
             pass

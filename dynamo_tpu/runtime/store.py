@@ -62,6 +62,12 @@ class PutMode(Enum):
     CREATE_OR_VALIDATE = "create_or_validate"  # ok if exists with equal value
 
 
+class StoreError(Exception):
+    """Typed wrapper for server-reported store failures that aren't one of
+    the structured kinds below (DT005: untyped RuntimeError can't be
+    routed or retried by callers)."""
+
+
 class KeyExistsError(Exception):
     pass
 

@@ -26,6 +26,7 @@ from dynamo_tpu.runtime.store import (
     LeaseNotFoundError,
     MemoryStore,
     PutMode,
+    StoreError,
     Watch,
     WatchEvent,
 )
@@ -249,7 +250,7 @@ class TcpStoreClient(KeyValueStore):
                 raise KeyExistsError(resp.get("error", ""))
             if kind == "lease_not_found":
                 raise LeaseNotFoundError(resp.get("error", ""))
-            raise RuntimeError(resp.get("error", "store error"))
+            raise StoreError(resp.get("error", "store error"))
         return resp
 
     async def put(self, key, value, lease_id=None, mode=PutMode.OVERWRITE) -> int:
@@ -293,7 +294,7 @@ class TcpStoreClient(KeyValueStore):
             if not self._closed:
                 try:
                     await self._call({"op": "watch_cancel", "watch_id": watch_id})
-                except (ConnectionError, RuntimeError):
+                except (ConnectionError, RuntimeError, StoreError):
                     pass
 
         return Watch(snapshot, queue, cancel)

@@ -258,6 +258,7 @@ class SpanRecorder:
         for sink in sinks:  # histograms lock themselves; don't nest locks
             try:
                 sink(span)
+            # dyntpu: allow[DT005] reason=observer pattern: a throwing sink must not break span recording for every other consumer, and logging here could recurse through a logging sink
             except Exception:  # noqa: BLE001 — a sink must never break tracing
                 pass
 

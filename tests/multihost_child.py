@@ -45,6 +45,7 @@ async def leader_main(step_addr: str, nprocs: int):
     async def one(prompt, n):
         req = PreprocessedRequest(model="mh-test", token_ids=prompt)
         req.sampling.temperature = 0.0
+        req.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
         req.stop.max_tokens = n
         req.stop.ignore_eos = True
         got = []

@@ -1,0 +1,665 @@
+"""Unit tests for the dyntpu-analyze framework (tools/analysis): per-checker
+fixture snippets (positive / negative / suppressed-with-reason /
+suppressed-without-reason), suppression + baseline machinery, and the
+manifest mirror that keeps DT001's cross-module pass honest.
+
+The repo-wide self-run (the repo must be CLEAN, empty baseline) lives in
+tests/test_analysis_repo_clean.py with the tier-1 wiring.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analysis import core
+from tools.analysis.checkers.dt001_thread_ownership import _GLOBAL_OWNED
+
+
+def run_on(tmp_path, files: dict[str, str], checks=None):
+    """Write {relpath: source} under tmp_path and run the analysis."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return core.run_analysis(str(tmp_path), checks=checks)
+
+
+def codes(result):
+    return [f.check for f in result.findings]
+
+
+# ---------------------------------------------------------------------------
+# DT001 thread ownership
+# ---------------------------------------------------------------------------
+
+ENGINE_CLASS = """
+    class Engine:
+        _SCHED_OWNED = frozenset({"_fetchq", "_waiting"})
+
+        def __init__(self):
+            self._fetchq = []
+            self._waiting = []
+            self._mutex = object()
+            self.total = 0
+
+        def _step(self):
+            self._fetchq.append(1)   # sync scheduler code: fine
+
+        async def bad(self):
+            return len(self._fetchq)
+
+        async def good_locked(self):
+            with self._mutex:
+                return len(self._waiting)
+
+        async def good_shipped(self):
+            def _on_thread():
+                return len(self._fetchq)
+            return await self.run_on_engine_thread(_on_thread)
+
+        async def good_unowned(self):
+            return self.total
+
+        async def run_on_engine_thread(self, fn):
+            return fn()
+"""
+
+
+def test_dt001_positive_and_negatives(tmp_path):
+    r = run_on(tmp_path, {"pkg/engine.py": ENGINE_CLASS}, checks=["DT001"])
+    assert codes(r) == ["DT001"]
+    f = r.findings[0]
+    assert "_fetchq" in f.message and "bad" in f.message
+
+
+def test_dt001_reached_through_sync_helper(tmp_path):
+    src = ENGINE_CLASS + """
+        async def outer(self):
+            return self.helper()
+
+        def helper(self):
+            return len(self._waiting)
+    """
+    # indentation: helper methods belong to the class body
+    src = src.replace("\n        async def outer", "\n        async def outer")
+    r = run_on(tmp_path, {"pkg/engine.py": src}, checks=["DT001"])
+    msgs = [f.message for f in r.findings]
+    assert any("helper" in m and "reached from an async def" in m for m in msgs)
+
+
+def test_dt001_owner_comment_annotation(tmp_path):
+    src = """
+    class Eng:
+        def __init__(self):
+            self._steps = []  # owner: engine-thread
+
+        async def bad(self):
+            return len(self._steps)
+    """
+    r = run_on(tmp_path, {"pkg/e.py": src}, checks=["DT001"])
+    assert codes(r) == ["DT001"]
+
+
+def test_dt001_cross_module_engine_receiver(tmp_path):
+    src = """
+    async def probe(engine):
+        return list(engine._fetchq)
+
+    async def fine(engine):
+        return engine.total_generated
+
+    def sync_probe(engine):
+        return list(engine._fetchq)
+    """
+    r = run_on(tmp_path, {"tools/probe.py": src}, checks=["DT001"])
+    assert codes(r) == ["DT001"]
+    assert r.findings[0].message.startswith("engine-thread-owned attribute engine._fetchq")
+
+
+def test_dt001_suppression(tmp_path):
+    src = ENGINE_CLASS.replace(
+        "            return len(self._fetchq)\n",
+        "            return len(self._fetchq)  # dyntpu: allow[DT001] reason=idle-engine probe\n",
+        1,
+    )
+    r = run_on(tmp_path, {"pkg/engine.py": src}, checks=["DT001"])
+    assert codes(r) == []
+    assert len(r.suppressed) == 1
+
+
+def test_dt001_mirror_matches_engine_manifest():
+    """The checker's cross-module mirror must equal TpuEngine._SCHED_OWNED
+    (parsed from source — the checker itself must not import jax)."""
+    path = os.path.join(REPO, "dynamo_tpu", "engine", "engine.py")
+    tree = ast.parse(open(path).read())
+    declared: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "_SCHED_OWNED" for t in node.targets
+        ):
+            declared = {
+                c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            }
+    assert declared == set(_GLOBAL_OWNED)
+
+
+# ---------------------------------------------------------------------------
+# DT002 async blocking
+# ---------------------------------------------------------------------------
+
+
+def test_dt002_positives(tmp_path):
+    src = """
+    import time, queue, subprocess
+
+    q = queue.Queue()
+
+    async def handler():
+        time.sleep(1)
+        subprocess.run(["ls"])
+        open("/tmp/x")
+        q.get()
+        fut.result()
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT002"])
+    assert codes(r) == ["DT002"] * 5
+
+
+def test_dt002_negatives(tmp_path):
+    src = """
+    import asyncio, time, queue
+
+    q = queue.Queue()
+
+    async def handler(aq: asyncio.Queue):
+        await asyncio.sleep(1)       # async sleep: fine
+        item = await aq.get()        # awaited queue: fine
+        q.get(timeout=1.0)           # bounded: fine
+        q.get_nowait()               # non-blocking: fine
+        return item
+
+    def sync_helper():
+        time.sleep(1)                # not in async def: fine
+
+    async def ships_closure():
+        def _worker():
+            time.sleep(1)            # nested sync def runs elsewhere
+        return _worker
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT002"])
+    assert codes(r) == []
+
+
+def test_dt002_scope_excludes_engine(tmp_path):
+    src = """
+    import time
+
+    async def warmup():
+        time.sleep(0.1)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/engine/x.py": src}, checks=["DT002"])
+    assert codes(r) == []
+
+
+def test_dt002_suppressed_without_reason_is_dt000(tmp_path):
+    src = """
+    import time
+
+    async def handler():
+        time.sleep(1)  # dyntpu: allow[DT002]
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT002"])
+    got = sorted(codes(r))
+    # The DT002 finding still stands AND the malformed allow is DT000.
+    assert got == ["DT000", "DT002"]
+
+
+# ---------------------------------------------------------------------------
+# DT003 trace safety
+# ---------------------------------------------------------------------------
+
+
+def test_dt003_coercion_branch_numpy(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x, n: int):
+        if x:                 # tracer branch
+            pass
+        v = float(x)          # tracer coercion
+        w = np.abs(x)         # numpy on tracer
+        k = float(n)          # static param: fine
+        if x is None:         # structure check: fine
+            pass
+        b = x.shape[0]        # metadata: fine
+        return v, w, k, b
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/ops/k.py": src}, checks=["DT003"])
+    assert codes(r) == ["DT003"] * 3
+
+
+def test_dt003_reaches_scan_body_and_helpers(tmp_path):
+    src = """
+    import jax
+    from jax import lax
+
+    def helper(h):
+        return float(h)
+
+    def outer(x):
+        def body(carry, xs):
+            return helper(carry), None
+        return lax.scan(body, x, None)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/ops/k.py": src}, checks=["DT003"])
+    assert codes(r) == ["DT003"]
+    assert "helper" in r.findings[0].message
+
+
+def test_dt003_nested_name_shadowing(tmp_path):
+    """A module-level fn sharing a name with a jit-internal nested fn must
+    not be swept in (the quant.py `q` case)."""
+    src = """
+    import jax
+    import numpy as np
+
+    def q(shape):
+        return np.zeros(shape)    # host code, same name as nested fn
+
+    @jax.jit
+    def build(x):
+        def q(v):
+            return v * 2
+        return q(x)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/ops/k.py": src}, checks=["DT003"])
+    assert codes(r) == []
+
+
+def test_dt003_module_helper_shadowed_by_scan_body(tmp_path):
+    """A nested scan body must not resolve against a shadowed module-level
+    host helper (review finding: un-pruned ast.walk in root collection)."""
+    src = """
+    import numpy as np
+    from jax import lax
+
+    def body(h):
+        return float(np.asarray(h))   # host code, same name as scan body
+
+    def outer(x):
+        def body(carry, xs):
+            return carry, None
+        return lax.scan(body, x, None)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/ops/k.py": src}, checks=["DT003"])
+    assert codes(r) == []
+
+
+def test_dt003_donated_arg_reuse(tmp_path):
+    model = """
+    import functools, jax
+
+    def prefill_impl(cfg, params, cache, tokens):
+        return tokens, cache
+
+    prefill = functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))(prefill_impl)
+    """
+    bad = """
+    from dynamo_tpu.fake.model import prefill
+
+    def use(cfg, params, cache, toks):
+        logits, cache2 = prefill(cfg, params, cache, toks)
+        return cache.sum()        # donated buffer reused
+    """
+    good = """
+    from dynamo_tpu.fake.model import prefill
+
+    def use(cfg, params, cache, toks):
+        logits, cache = prefill(cfg, params, cache, toks)
+        return cache.sum()        # rebound result: fine
+    """
+    r = run_on(tmp_path, {
+        "dynamo_tpu/fake/model.py": model,
+        "dynamo_tpu/a.py": bad,
+        "dynamo_tpu/b.py": good,
+    }, checks=["DT003"])
+    assert [f.path for f in r.findings if f.check == "DT003"] == ["dynamo_tpu/a.py"]
+    assert "donated" in r.findings[0].message
+
+
+def test_dt003_static_argnums_respected(tmp_path):
+    src = """
+    import functools, jax
+
+    def run_impl(mode, x):
+        k = int(mode)             # static via static_argnums: fine
+        return x * k
+
+    run = functools.partial(jax.jit, static_argnums=(0,))(run_impl)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/ops/k.py": src}, checks=["DT003"])
+    assert codes(r) == []
+
+
+def test_dt003_suppression(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return float(x)  # dyntpu: allow[DT003] reason=interpret-mode-only debug path
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/ops/k.py": src}, checks=["DT003"])
+    assert codes(r) == [] and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# DT004 test RNG discipline
+# ---------------------------------------------------------------------------
+
+DT004_POS = """
+    import random
+    import numpy as np
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+    def test_things():
+        n = random.randint(0, 10)          # bare global draw
+        v = np.random.rand(3)              # bare global draw
+        req = PreprocessedRequest(model="t", token_ids=[1])   # unseeded
+        return n, v, req
+"""
+
+DT004_NEG = """
+    import random
+    import numpy as np
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest, SamplingOptions
+
+    def test_things():
+        rng = random.Random(0)
+        nrng = np.random.default_rng(1)
+        a = PreprocessedRequest(model="t", token_ids=[1],
+                                sampling=SamplingOptions(seed=7))
+        b = PreprocessedRequest(model="t", token_ids=[2])
+        b.sampling.seed = 3                 # builder style
+        return rng.random(), nrng.normal(), a, b
+"""
+
+
+def test_dt004_positive(tmp_path):
+    r = run_on(tmp_path, {"tests/test_x.py": DT004_POS}, checks=["DT004"])
+    assert codes(r) == ["DT004"] * 3
+
+
+def test_dt004_negative(tmp_path):
+    r = run_on(tmp_path, {"tests/test_x.py": DT004_NEG}, checks=["DT004"])
+    assert codes(r) == []
+
+
+def test_dt004_mocker_only_module_exempt(tmp_path):
+    src = """
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+    def test_mock():
+        return PreprocessedRequest(model="m", token_ids=[1])  # no TpuEngine here
+    """
+    r = run_on(tmp_path, {"tests/test_m.py": src}, checks=["DT004"])
+    assert codes(r) == []
+
+
+def test_dt004_outside_tests_exempt(tmp_path):
+    src = """
+    import random
+
+    def sample():
+        return random.random()   # production code is DT004-exempt
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/kv_router/s.py": src}, checks=["DT004"])
+    assert codes(r) == []
+
+
+def test_dt004_suppression_requires_reason(tmp_path):
+    ok = DT004_POS.replace(
+        "        n = random.randint(0, 10)          # bare global draw\n",
+        "        n = random.randint(0, 10)  # dyntpu: allow[DT004] reason=nondeterminism is the point of this fuzz test\n",
+    )
+    r = run_on(tmp_path, {"tests/test_x.py": ok}, checks=["DT004"])
+    assert codes(r) == ["DT004"] * 2 and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# DT005 typed errors
+# ---------------------------------------------------------------------------
+
+
+def test_dt005_rules(tmp_path):
+    src = """
+    class StoreError(Exception):
+        pass
+
+    def a():
+        raise RuntimeError("nope")          # untyped
+
+    def b():
+        raise StoreError("typed: fine")
+
+    def c():
+        raise ValueError("contract: fine")
+
+    def d():
+        try:
+            pass
+        except Exception:                   # silent swallow
+            pass
+
+    def e():
+        try:
+            pass
+        except Exception:  # noqa: BLE001 — boundary: errors map to a typed reply
+            return None
+
+    def f():
+        try:
+            pass
+        except Exception:  # noqa: BLE001
+            return None                     # no reason: flagged
+
+    def g():
+        try:
+            pass
+        except ValueError:
+            pass                            # narrow: fine
+
+    def h():
+        try:
+            pass
+        except BaseException:
+            raise                           # re-raise cleanup seam: fine
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT005"])
+    got = codes(r)
+    assert got == ["DT005"] * 3
+    msgs = " | ".join(f.message for f in r.findings)
+    assert "raise RuntimeError" in msgs
+    assert "pass" in msgs and "without a stated reason" in msgs
+
+
+def test_dt005_scope_excludes_engine_and_tools(tmp_path):
+    src = """
+    def a():
+        raise RuntimeError("engine internals may use RuntimeError")
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/engine/x.py": src, "tools/y.py": src},
+               checks=["DT005"])
+    assert codes(r) == []
+
+
+def test_dt005_suppression(tmp_path):
+    src = """
+    def a():
+        # dyntpu: allow[DT005] reason=legacy wire compat until v2 frames land
+        raise RuntimeError("nope")
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT005"])
+    assert codes(r) == [] and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# Framework: suppressions, baseline, reporters, CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_without_reason_is_always_dt000(tmp_path):
+    src = """
+    X = 1  # dyntpu: allow[DT001,DT002]
+    """
+    r = run_on(tmp_path, {"pkg/x.py": src}, checks=["DT005"])
+    assert codes(r) == ["DT000"]
+    # ...and DT000 cannot itself be suppressed.
+    src2 = """
+    X = 1  # dyntpu: allow[DT000] reason=meta
+    Y = 2  # dyntpu: allow[DT001]
+    """
+    r2 = run_on(tmp_path / "b", {"pkg/x.py": src2}, checks=["DT005"])
+    assert "DT000" in codes(r2)
+
+
+def test_multi_code_suppression_covers_both(tmp_path):
+    src = """
+    import time
+
+    async def h():
+        time.sleep(1)  # dyntpu: allow[DT001,DT002] reason=startup-only path, loop not serving yet
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT002"])
+    assert codes(r) == [] and len(r.suppressed) == 1
+
+
+def test_stacked_suppressions_merge(tmp_path):
+    """Two own-line allows over the same code line both apply (review
+    finding: dict overwrite dropped all but the last)."""
+    src = """
+    import time
+
+    async def h():
+        # dyntpu: allow[DT002] reason=startup-only stall
+        # dyntpu: allow[DT005] reason=separate invariant, separate justification
+        time.sleep(1)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT002"])
+    assert codes(r) == [] and len(r.suppressed) == 1
+    assert "startup-only" in r.suppressed[0][1].reason
+
+
+def test_dt005_naked_noqa_not_excused_by_unrelated_comment(tmp_path):
+    """`# noqa: BLE001` with a random comment on the NEXT line is still a
+    reasonless broad handler (review finding)."""
+    src = """
+    def f():
+        try:
+            pass
+        except Exception:  # noqa: BLE001
+            # TODO: tighten this later
+            return None
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT005"])
+    assert codes(r) == ["DT005"]
+
+
+def test_dt005_nested_def_raise_does_not_exempt(tmp_path):
+    """A bare `raise` inside a nested def is deferred code — the broad
+    handler still swallows (review finding)."""
+    src = """
+    def f():
+        try:
+            pass
+        except Exception:
+            def _later():
+                raise
+            return None
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT005"])
+    assert codes(r) == ["DT005"]
+
+
+def test_comment_above_line_suppresses_next_code_line(tmp_path):
+    src = """
+    import time
+
+    async def h():
+        # dyntpu: allow[DT002] reason=documented startup stall
+        time.sleep(1)
+    """
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": src}, checks=["DT002"])
+    assert codes(r) == [] and len(r.suppressed) == 1
+
+
+def test_baseline_grandfathers_by_content_not_line(tmp_path):
+    files = {"dynamo_tpu/runtime/x.py": """
+    import time
+
+    async def h():
+        time.sleep(1)
+    """}
+    r = run_on(tmp_path, files, checks=["DT002"])
+    assert codes(r) == ["DT002"]
+    bl = tmp_path / "bl.json"
+    core.save_baseline(str(bl), r.findings)
+    r2 = core.run_analysis(str(tmp_path), checks=["DT002"], baseline_path=str(bl))
+    assert codes(r2) == [] and len(r2.baselined) == 1
+    # Prepend a line: the finding moves but its fingerprint (content hash)
+    # still matches the baseline.
+    p = tmp_path / "dynamo_tpu/runtime/x.py"
+    p.write_text("import os\n" + p.read_text())
+    r3 = core.run_analysis(str(tmp_path), checks=["DT002"], baseline_path=str(bl))
+    assert codes(r3) == [] and len(r3.baselined) == 1
+
+
+def test_json_reporter_shape(tmp_path):
+    import json
+
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": """
+    import time
+
+    async def h():
+        time.sleep(1)
+    """}, checks=["DT002"])
+    data = json.loads(core.render_json(r))
+    assert data["exit_code"] == 1
+    (f,) = data["findings"]
+    assert f["check"] == "DT002" and f["path"] == "dynamo_tpu/runtime/x.py"
+    assert f["fingerprint"].startswith("DT002:")
+
+
+def test_unknown_check_raises():
+    with pytest.raises(KeyError):
+        core.run_analysis(REPO, checks=["DT999"])
+
+
+def test_all_checkers_registered():
+    checkers = core.all_checkers()
+    assert set(checkers) >= {"DT001", "DT002", "DT003", "DT004", "DT005", "DT006"}
+    assert checkers["DT006"].dynamic
+    assert not any(checkers[c].dynamic for c in ("DT001", "DT002", "DT003", "DT004", "DT005"))
+
+
+def test_repo_self_run_is_clean():
+    """API-level self-run over the real repo: zero findings, and every
+    suppression carries its reason (the subprocess/timing variant lives in
+    test_analysis_repo_clean.py)."""
+    r = core.run_analysis(REPO)
+    assert r.findings == [], "\n".join(f.render() for f in r.findings)
+    assert all(sup.reason for _, sup in r.suppressed)

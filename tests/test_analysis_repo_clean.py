@@ -1,0 +1,57 @@
+"""Tier-1 wiring for dyntpu-analyze: the full static pass over the repo
+must report ZERO findings against an EMPTY baseline (clean, not
+grandfathered — deliberate exceptions carry `# dyntpu: allow[...]`
+comments with reasons), and must stay fast enough to run on every CI
+pass (< 30s on CPU; in practice it is sub-10s).
+
+Pattern-matches the tests/test_profile_*_smoke.py approach: subprocess
+invocation of the real CLI entry point, so the `python -m tools.analysis`
+packaging (tools/__init__.py on Python 3.10) is exercised too.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_analysis_repo_is_clean_and_fast():
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--json"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout[-8000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    data = json.loads(proc.stdout)
+    assert data["findings"] == [], data["findings"]
+    # The static checkers all ran (DT006 is dynamic and excluded by default).
+    assert set(data["checks_run"]) == {"DT001", "DT002", "DT003", "DT004", "DT005"}
+    assert data["files_scanned"] > 100  # the sweep actually walked the repo
+    # Every suppression in the tree carries a reason (DT000 would be a
+    # finding) — and the repo stays CLEAN, not grandfathered: baseline empty.
+    assert data["baselined"] == []
+    with open(os.path.join(REPO, "tools", "analysis", "baseline.json")) as f:
+        assert json.load(f) == {}
+    assert elapsed < 30.0, f"static pass took {elapsed:.1f}s (budget 30s)"
+
+
+def test_analysis_exit_code_discipline():
+    """--list-checks exits 0; an unknown check exits 2 (usage error)."""
+    ok = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--list-checks"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert ok.returncode == 0 and "DT001" in ok.stdout and "DT006" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--check", "DT999"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert bad.returncode == 2

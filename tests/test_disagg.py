@@ -46,6 +46,7 @@ def make_args(**kw) -> EngineArgs:
 def greedy_request(prompt, max_tokens=8, **ktp) -> PreprocessedRequest:
     req = PreprocessedRequest(model="t", token_ids=list(prompt))
     req.sampling.temperature = 0.0
+    req.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
     req.stop.max_tokens = max_tokens
     req.stop.ignore_eos = True
     if ktp:

@@ -167,6 +167,7 @@ def test_engine_greedy_generation_matches_hf(tmp_path):
         engine = await TpuEngine(eargs, params=params).start()
         req = PreprocessedRequest(model=cfg.name, token_ids=prompt.tolist())
         req.sampling.temperature = 0.0
+        req.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
         req.stop.max_tokens = N
         req.stop.ignore_eos = True
         out = []

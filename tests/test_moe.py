@@ -90,6 +90,7 @@ def test_moe_engine_e2e_greedy_deterministic():
         try:
             req = PreprocessedRequest(model="moe", token_ids=[5, 6, 7, 8])
             req.sampling.temperature = 0.0
+            req.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
             req.stop.max_tokens = 8
             req.stop.ignore_eos = True
             got = []

@@ -166,6 +166,7 @@ def test_sharded_engine_matches_unsharded_greedy():
     def req():
         r = PreprocessedRequest(model="t", token_ids=[1, 2, 3, 4, 5])
         r.sampling.temperature = 0.0
+        r.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
         r.stop.max_tokens = 8
         return r
 

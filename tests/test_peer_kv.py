@@ -62,6 +62,7 @@ PROMPT = [7 * i % 500 + 1 for i in range(23)]  # 5 matchable blocks + suffix
 def make_request(prompt=PROMPT, max_tokens=8, **ktp):
     r = PreprocessedRequest(model="tiny", token_ids=list(prompt))
     r.sampling.temperature = 0.0
+    r.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
     r.stop.max_tokens = max_tokens
     r.stop.ignore_eos = True
     d = r.to_dict()

@@ -116,6 +116,7 @@ def test_engine_runs_with_int8_quant():
         try:
             req = PreprocessedRequest(model="t", token_ids=[1, 2, 3, 4, 5])
             req.sampling.temperature = 0.0
+            req.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
             req.stop.max_tokens = 8
             req.stop.ignore_eos = True
             got = []

@@ -138,6 +138,7 @@ def test_soak_engine_many_waves_no_leak():
                     model="tiny", token_ids=[(i * 13 + j) % 500 + 1 for j in range(1, 18)]
                 )
                 r.sampling.temperature = 0.0
+                r.sampling.seed = i  # greedy, but unseeded requests draw global RNG (DT004)
                 r.stop.max_tokens = 8
                 r.stop.ignore_eos = True
                 n = 0

@@ -89,6 +89,7 @@ def test_engine_onboards_evicted_prefix_instead_of_recompute(tmp_path):
         async def run(prompt, n=4):
             req = PreprocessedRequest(model="t", token_ids=list(prompt))
             req.sampling.temperature = 0.0
+            req.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
             req.stop.max_tokens = n
             req.stop.ignore_eos = True
             out = []

@@ -28,6 +28,7 @@ def make_engine(**kw):
 def make_request(n_top=3, max_tokens=6):
     r = PreprocessedRequest(model="tiny", token_ids=[5, 9, 13, 17, 21])
     r.sampling.temperature = 0.0
+    r.sampling.seed = 0  # greedy, but unseeded requests draw global RNG (DT004)
     r.sampling.logprobs = True
     r.sampling.top_logprobs = n_top
     r.stop.max_tokens = max_tokens

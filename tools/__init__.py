@@ -1,0 +1,2 @@
+"""Repo tooling: profilers, CI guards, and the static-analysis framework
+(``python -m tools.analysis``)."""

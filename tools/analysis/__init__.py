@@ -1,0 +1,33 @@
+"""dyntpu-analyze: AST-based, repo-aware static analysis.
+
+The Rust reference gets data-race freedom, typed errors, and deterministic
+cleanup from its compiler; this Python/JAX reproduction gets none of that
+for free. This package machine-checks the project invariants that past PRs
+paid for the hard way (see docs/static-analysis.md for the war stories):
+
+- DT001 thread-ownership: engine-scheduler state touched off the engine
+  thread without the handoff mutex
+- DT002 blocking-call-in-async: sync sleeps/IO/futures on the async
+  serving path
+- DT003 JAX trace-safety: tracer coercion / numpy-on-tracer / tracer
+  branching / donated-buffer reuse in jit-reachable code
+- DT004 test-RNG discipline: unseeded engine requests and bare global
+  RNG draws in tests
+- DT005 typed-error discipline: untyped raises and unexplained broad
+  ``except`` on the serving path
+- DT006 metrics catalog (dynamic; folded in from tools/check_metrics.py)
+
+Run ``python -m tools.analysis`` from the repo root. Suppress a deliberate
+finding with ``# dyntpu: allow[DT00N] reason=<why>`` — the reason is
+mandatory.
+"""
+
+from tools.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    SourceModule,
+    all_checkers,
+    collect_modules,
+    register,
+    run_analysis,
+)

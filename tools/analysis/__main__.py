@@ -1,0 +1,81 @@
+"""CLI: ``python -m tools.analysis [paths...] [options]`` from the repo root.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Allow `python tools/analysis/__main__.py` too, not just -m.
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analysis import core  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="dyntpu-analyze: project-invariant static analysis",
+    )
+    ap.add_argument("paths", nargs="*", help="repo-relative path prefixes to sweep "
+                    "(default: whole repo)")
+    ap.add_argument("--check", action="append", default=None, metavar="DT00N",
+                    help="run only these checks (repeatable / comma-separated); "
+                    "naming a dynamic check (DT006) runs it")
+    ap.add_argument("--dynamic", action="store_true",
+                    help="include dynamic checkers (DT006 metrics catalog — "
+                    "boots the serving components, pulls jax)")
+    ap.add_argument("--json", action="store_true", help="JSON report on stdout")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also list suppressed/baselined findings")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline file (default: {core.DEFAULT_BASELINE})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file and exit 0 "
+                    "(adopting a checker over legacy findings; this repo keeps "
+                    "the baseline EMPTY)")
+    ap.add_argument("--list-checks", action="store_true")
+    ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for code, ch in core.all_checkers().items():
+            tag = " (dynamic)" if ch.dynamic else ""
+            print(f"{code}  {ch.name}{tag}: {ch.description}")
+        return 0
+
+    checks = None
+    if args.check:
+        checks = []
+        for c in args.check:
+            checks.extend(x.strip().upper() for x in c.split(",") if x.strip())
+
+    try:
+        result = core.run_analysis(
+            args.root,
+            paths=args.paths or None,
+            checks=checks,
+            baseline_path=args.baseline,
+            include_dynamic=args.dynamic,
+        )
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(args.root, core.DEFAULT_BASELINE)
+    if args.write_baseline:
+        core.save_baseline(baseline_path, result.findings)
+        print(f"wrote {len(result.findings)} fingerprint(s) to {baseline_path}")
+        return 0
+
+    print(core.render_json(result) if args.json else core.render_text(result, args.verbose))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,10 @@
+"""Checker modules self-register on import (tools.analysis.core.register)."""
+
+from tools.analysis.checkers import (  # noqa: F401
+    dt001_thread_ownership,
+    dt002_async_blocking,
+    dt003_trace_safety,
+    dt004_test_rng,
+    dt005_typed_errors,
+    dt006_metrics_catalog,
+)

@@ -1,0 +1,137 @@
+"""DT002 — blocking calls inside ``async def`` on the serving path.
+
+One synchronous stall inside a coroutine freezes the whole event loop —
+every in-flight stream, not just the offender. The PR 3 streaming fast
+path (5.3k tok/s through ONE loop) lives or dies on this. Flagged inside
+``async def`` bodies under the serving packages:
+
+- ``time.sleep(...)``
+- ``subprocess.run/call/check_call/check_output/Popen`` and ``os.system``
+- builtin ``open(...)`` (sync file I/O; use asyncio.to_thread or accept
+  the stall explicitly with an allow)
+- ``socket.create_connection`` / ``socket.socket(...)`` construction
+- sync ``requests.*`` / ``urllib.request.urlopen`` HTTP
+- ``.get()`` / ``.put(...)`` (un-awaited) on a name bound to
+  ``queue.Queue(...)`` in the same file, without a ``timeout=``
+- ``.result()`` with no timeout on anything — a concurrent Future blocks;
+  an asyncio Future raises unless done. Either way the non-blocking form
+  is ``await``. If the future is provably done (asyncio.wait), suppress
+  with a reason.
+
+Nested sync ``def``s are skipped: they execute wherever they're shipped
+(thread pools, the engine thread), not necessarily on the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.analysis.core import (
+    Checker,
+    Finding,
+    SourceModule,
+    dotted,
+    register,
+    walk_function_body,
+)
+
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep blocks the event loop — use asyncio.sleep",
+    "os.system": "os.system blocks the event loop",
+    "subprocess.run": "subprocess.run blocks — use asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess.call blocks — use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "subprocess.check_call blocks",
+    "subprocess.check_output": "subprocess.check_output blocks",
+    "subprocess.Popen": "Popen in a coroutine invites sync .wait()/.communicate()",
+    "socket.create_connection": "sync socket connect blocks — use asyncio.open_connection",
+    "urllib.request.urlopen": "sync HTTP blocks — use an async client",
+}
+REQUESTS_METHODS = {"get", "post", "put", "delete", "head", "patch", "request"}
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _sync_queue_names(module: SourceModule) -> set[str]:
+    """Names (incl. 'self.x') bound to queue.Queue(...) anywhere in the file."""
+    names: set[str] = set()
+    assert module.tree is not None
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign)) and node.value is not None):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        ctor = dotted(call.func)
+        if ctor not in {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                        "queue.SimpleQueue"}:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            d = dotted(t)
+            if d:
+                names.add(d)
+    return names
+
+
+@register
+class AsyncBlockingChecker(Checker):
+    code = "DT002"
+    name = "async-blocking"
+    description = "blocking calls inside async def on the serving path"
+    scope = (
+        "dynamo_tpu/frontend", "dynamo_tpu/runtime", "dynamo_tpu/router",
+        "dynamo_tpu/llm", "dynamo_tpu/kv_router",
+    )
+
+    def run(self, module: SourceModule) -> Iterable[Finding]:
+        assert module.tree is not None
+        qnames = _sync_queue_names(module)
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            awaited = {
+                n.value for n in ast.walk(fn) if isinstance(n, ast.Await)
+            }
+            for node in walk_function_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._blocking_reason(node, qnames, node in awaited)
+                if msg:
+                    yield Finding(
+                        check=self.code, path=module.path, line=node.lineno,
+                        message=f"in async def {fn.name}: {msg}",
+                        snippet=module.line_text(node.lineno),
+                    )
+
+    def _blocking_reason(
+        self, call: ast.Call, qnames: set[str], is_awaited: bool
+    ) -> str | None:
+        d = dotted(call.func)
+        if d in BLOCKING_DOTTED:
+            return BLOCKING_DOTTED[d]
+        if d is not None:
+            head, _, tail = d.partition(".")
+            if head == "requests" and tail in REQUESTS_METHODS:
+                return "sync requests.* blocks — use an async client"
+            if d == "socket.socket":
+                return "raw socket in a coroutine invites sync I/O"
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            return "sync open() in a coroutine — file I/O stalls the loop"
+        if isinstance(call.func, ast.Attribute) and not is_awaited:
+            attr = call.func.attr
+            if attr == "result" and not call.args and not _has_timeout(call):
+                return (
+                    ".result() without timeout can block the loop — await the "
+                    "future instead"
+                )
+            if attr in {"get", "put"} and not _has_timeout(call):
+                recv = dotted(call.func.value)
+                if recv in qnames:
+                    return (
+                        f"queue.Queue {attr}() without timeout blocks the loop — "
+                        "use asyncio.Queue or add a timeout"
+                    )
+        return None

@@ -1,0 +1,162 @@
+"""DT004 — test-RNG discipline (the PR 4 lesson, codified).
+
+An unseeded engine request draws ``random.getrandbits(31)`` from the
+GLOBAL stdlib RNG (engine.py ``_Seq.__init__``) to mint its sample seed.
+In a single-process pytest run, every such draw shifts the global stream
+for every later test: PR 4's new (seeded!) pipeline tests merely stopped
+consuming draws and that alone flipped the sampling-dependent
+``test_frontend_e2e`` chat assertion. The invariant: tests never touch
+the global RNG stream — directly or through the engine.
+
+Flagged in ``tests/``:
+
+- bare module-RNG draws: ``random.random()``, ``random.randint(...)``,
+  ``np.random.rand(...)``, … — anything on the MODULE-level generator.
+  Seeded instances (``random.Random(0)``, ``np.random.default_rng(0)``,
+  ``jax.random.PRNGKey``) are the sanctioned forms; ``random.seed`` is
+  allowed but pointless next to them.
+- ``PreprocessedRequest(...)`` constructed in a module that uses
+  ``TpuEngine`` without a ``sampling=`` argument that pins a seed
+  (``SamplingOptions(seed=...)``, a ``**``-splat, or a helper whose name
+  mentions seed). Mocker-only test modules are exempt — MockerEngine
+  never draws host RNG.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tools.analysis.core import Checker, Finding, SourceModule, dotted, register
+
+# Constructors/seeders on the random modules that are fine to call.
+SANCTIONED = {
+    "random.Random", "random.SystemRandom", "random.seed",
+    "random.getstate", "random.setstate",
+    "np.random.default_rng", "numpy.random.default_rng",
+    "np.random.RandomState", "numpy.random.RandomState",
+    "np.random.Generator", "numpy.random.Generator",
+    "np.random.seed", "numpy.random.seed",
+}
+
+
+def _uses_tpu_engine(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(a.name == "TpuEngine" for a in node.names):
+                return True
+        elif isinstance(node, ast.Name) and node.id == "TpuEngine":
+            return True
+        elif isinstance(node, ast.Attribute) and node.attr == "TpuEngine":
+            return True
+    return False
+
+
+def _seeds_sampling(call: ast.Call) -> bool:
+    """Does this PreprocessedRequest(...) call pin a sample seed?"""
+    for kw in call.keywords:
+        if kw.arg == "sampling":
+            # SamplingOptions(seed=...) inline, or any expression that
+            # names a seed (a fixture/helper like seeded_sampling(i)).
+            for inner in ast.walk(kw.value):
+                if isinstance(inner, ast.keyword) and inner.arg == "seed":
+                    return inner.value is not None and not (
+                        isinstance(inner.value, ast.Constant)
+                        and inner.value.value is None
+                    )
+                if isinstance(inner, ast.Constant) and inner.value == "seed":
+                    return True  # dict form {"seed": ...}
+                if isinstance(inner, ast.Name) and "seed" in inner.id.lower():
+                    return True
+                if (
+                    isinstance(inner, ast.Call)
+                    and (dotted(inner.func) or "").lower().find("seed") >= 0
+                ):
+                    return True
+            return False
+        if kw.arg is None:
+            return True  # **kwargs splat: can't see inside; trust it
+    return False
+
+
+def _builder_seeded_lines(tree: ast.Module) -> set[int]:
+    """Lines of `name = PreprocessedRequest(...)` whose enclosing function
+    also assigns `name.sampling.seed = <non-None>`."""
+    out: set[int] = set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        ctor_lines: dict[str, list[int]] = {}
+        seeded: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "PreprocessedRequest"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        ctor_lines.setdefault(t.id, []).append(node.value.lineno)
+            for t in node.targets:
+                d = dotted(t)
+                if d and d.endswith(".sampling.seed") and not (
+                    isinstance(node.value, ast.Constant) and node.value.value is None
+                ):
+                    seeded.add(d[: -len(".sampling.seed")])
+        for name in seeded:
+            out.update(ctor_lines.get(name, []))
+    return out
+
+
+@register
+class TestRngChecker(Checker):
+    code = "DT004"
+    name = "test-rng-discipline"
+    description = "unseeded engine requests / bare global RNG draws in tests"
+    scope = ("tests",)
+
+    def run(self, module: SourceModule) -> Iterable[Finding]:
+        assert module.tree is not None
+        engine_module = _uses_tpu_engine(module.tree)
+        # Builder-style seeding: `req = PreprocessedRequest(...)` followed
+        # (anywhere in the same function) by `req.sampling.seed = ...` is
+        # the other sanctioned shape.
+        seeded_lines = _builder_seeded_lines(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d and d not in SANCTIONED:
+                head, _, _ = d.partition(".")
+                if d.startswith("random.") and d.count(".") == 1:
+                    yield self._finding(
+                        module, node.lineno,
+                        f"bare global-RNG draw {d}(...) — use random.Random(seed)",
+                    )
+                elif d.startswith(("np.random.", "numpy.random.")) and d.count(".") == 2:
+                    yield self._finding(
+                        module, node.lineno,
+                        f"bare global-RNG draw {d}(...) — use np.random.default_rng(seed)",
+                    )
+            if (
+                engine_module
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "PreprocessedRequest"
+                and not _seeds_sampling(node)
+                and node.lineno not in seeded_lines
+            ):
+                yield self._finding(
+                    module, node.lineno,
+                    "engine-bound request without an explicit sampling seed — "
+                    "unseeded requests draw random.getrandbits from the global "
+                    "stream and perturb every later test; pass "
+                    "sampling=SamplingOptions(seed=...)",
+                )
+
+    def _finding(self, module: SourceModule, line: int, message: str) -> Finding:
+        return Finding(
+            check=self.code, path=module.path, line=line,
+            message=message, snippet=module.line_text(line),
+        )

@@ -1,0 +1,140 @@
+"""DT005 — typed-error discipline on the serving path.
+
+The reference's request plane wires TYPED errors end to end (deadline →
+504, overload → 429/503, stream death → migration); an untyped
+``RuntimeError`` can't be routed, retried, or mapped to a status code —
+it collapses to a generic 500 at the HTTP boundary and defeats PR 1's
+whole retry/shedding design. And a silent ``except Exception: pass``
+erases the failure entirely (PR 2 existed because spans were dying at
+async-GC time with nobody noticing).
+
+Flagged under the serving packages:
+
+- ``raise Exception(...)`` / ``raise BaseException(...)`` /
+  ``raise RuntimeError(...)`` — raise one of the protocol's typed errors
+  (anything named ``*Error``: DeadlineExceededError, OverloadedError,
+  StoreError, …) or a builtin contract error (ValueError/TypeError).
+- broad handlers (``except Exception``, ``except BaseException``, bare
+  ``except:``) whose body is only ``pass``/``...`` — silent swallow;
+  needs an explicit ``# dyntpu: allow[DT005] reason=...``.
+- broad handlers WITHOUT a stated reason. The repo convention
+  ``# noqa: BLE001 — <why this boundary must be broad>`` satisfies this;
+  a naked ``# noqa: BLE001`` does not.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from tools.analysis.core import Checker, Finding, SourceModule, register, walk_function_body
+
+UNTYPED_RAISES = {"Exception", "BaseException", "RuntimeError", "SystemError"}
+BROAD = {"Exception", "BaseException"}
+NOQA_RE = re.compile(r"#\s*noqa:\s*BLE001\b(?P<rest>[^#]*)")
+
+
+def _handler_reason(module: SourceModule, lineno: int) -> str | None:
+    """Reason text attached to a broad handler via the repo's
+    ``# noqa: BLE001 — reason`` convention. The reason must start ON the
+    noqa line (it may wrap onto following comment lines, but a naked
+    ``# noqa: BLE001`` is not retroactively excused by an unrelated
+    comment below it)."""
+    m = NOQA_RE.search(module.line_text(lineno))
+    if not m:
+        return None
+    reason = m.group("rest").strip().lstrip("—-–: ").strip()
+    return reason or None
+
+
+@register
+class TypedErrorChecker(Checker):
+    code = "DT005"
+    name = "typed-errors"
+    description = (
+        "untyped raises and unexplained broad except handlers on the "
+        "serving path"
+    )
+    scope = (
+        "dynamo_tpu/frontend", "dynamo_tpu/runtime", "dynamo_tpu/router",
+        "dynamo_tpu/llm", "dynamo_tpu/kv_router",
+    )
+
+    def run(self, module: SourceModule) -> Iterable[Finding]:
+        assert module.tree is not None
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Raise):
+                yield from self._check_raise(module, node)
+            elif isinstance(node, ast.ExceptHandler):
+                yield from self._check_handler(module, node)
+
+    def _check_raise(self, module: SourceModule, node: ast.Raise) -> Iterable[Finding]:
+        exc = node.exc
+        if isinstance(exc, ast.Call):
+            exc = exc.func
+        name = None
+        if isinstance(exc, ast.Name):
+            name = exc.id
+        elif isinstance(exc, ast.Attribute):
+            name = exc.attr
+        if name in UNTYPED_RAISES:
+            yield Finding(
+                check=self.code, path=module.path, line=node.lineno,
+                message=(
+                    f"raise {name} on the serving path — use a typed error "
+                    "(*Error) the protocol can route, or a builtin contract "
+                    "error (ValueError/TypeError)"
+                ),
+                snippet=module.line_text(node.lineno),
+            )
+
+    def _check_handler(
+        self, module: SourceModule, node: ast.ExceptHandler
+    ) -> Iterable[Finding]:
+        names: list[str] = []
+        if node.type is None:
+            names = ["<bare>"]
+        else:
+            for t in ast.walk(node.type):
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+        if not any(n in BROAD or n == "<bare>" for n in names):
+            return
+        # Broad catch that RE-RAISES is a cleanup seam (span bookkeeping,
+        # resource release), not error handling — nothing is swallowed.
+        # Only the handler's own statements count: a bare `raise` inside a
+        # nested def is deferred code, not a re-raise of THIS exception.
+        for stmt in walk_function_body(node):
+            if isinstance(stmt, ast.Raise) and stmt.exc is None:
+                return
+        label = "bare except:" if node.type is None else f"except {names[0]}"
+        body = [s for s in node.body]
+        silent = all(
+            isinstance(s, ast.Pass)
+            or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+            for s in body
+        )
+        if silent:
+            yield Finding(
+                check=self.code, path=module.path, line=node.lineno,
+                message=(
+                    f"{label}: pass — silently swallows every failure on the "
+                    "serving path; handle, log, or narrow the type "
+                    "(contextlib.suppress(SpecificError) if truly intended)"
+                ),
+                snippet=module.line_text(node.lineno),
+            )
+            return
+        if _handler_reason(module, node.lineno) is None:
+            yield Finding(
+                check=self.code, path=module.path, line=node.lineno,
+                message=(
+                    f"{label} without a stated reason — broad handlers on the "
+                    "serving path must justify themselves: "
+                    "`# noqa: BLE001 — <why this boundary must be broad>`"
+                ),
+                snippet=module.line_text(node.lineno),
+            )
+
+    # Suppression comments and the baseline are applied by the driver.

@@ -1,0 +1,160 @@
+"""DT006 — metrics-catalog guard (dynamic), folded in from
+``tools/check_metrics.py``.
+
+Unlike DT001–DT005 this checker EXECUTES the serving components (on
+in-memory runtimes, CPU JAX) rather than reading source: every metric
+registration path actually runs, then the catalog is validated — help
+text present, one TYPE per metric name across every scope and process
+registry, and a renderable exposition. That boot pulls jax and takes
+seconds, so DT006 is ``dynamic``: it runs under ``--dynamic`` /
+``--check DT006`` (and keeps its own tier-1 wiring via
+``tests/test_check_metrics.py`` through the ``tools/check_metrics.py``
+shim) instead of slowing the sub-second AST pass.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable
+
+from tools.analysis.core import Checker, Finding, register
+
+CATALOG_PATH = "dynamo_tpu/runtime/metrics.py"  # where findings anchor
+
+
+async def build_registries():
+    """Instantiate the serving components; → ([(label, MetricsRegistry)],
+    async cleanup). Every registration path executes: frontend HTTP
+    service (+ admission, ledger, tracing sink), worker endpoint server
+    (+ chaos injector), routers (retry counter), discovery (breaker
+    gauge), and the fleet metrics exporter."""
+    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+    from dynamo_tpu.llm.pipeline import RouterSettings
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.metrics_exporter import MetricsExporter
+    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+    from dynamo_tpu.runtime.chaos import ChaosConfig
+    from dynamo_tpu.runtime.config import Config
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.push_router import RouterMode
+
+    url = "memory://check_metrics"
+    # Worker with chaos enabled so the injector's counter registers too.
+    wcfg = Config.from_env({})
+    wcfg.chaos = ChaosConfig(enabled=True, seed=1)
+    wrt = await DistributedRuntime.create(store_url=url, config=wcfg)
+    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=64, speedup=1000.0))
+    broadcaster = KvEventBroadcaster(engine.pool)
+    # TPU-engine hot-loop gauges (what worker/__main__ binds for
+    # engine=tpu): register via the shared path so the catalog guard
+    # covers them without booting a real engine. Lazy import — pulls jax.
+    from dynamo_tpu.engine.engine import register_engine_metrics
+
+    register_engine_metrics(wrt.metrics)
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    comp = wrt.namespace("check").component("backend")
+    await comp.endpoint("generate").serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    card = ModelDeploymentCard(
+        name="check-model", kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS], context_length=128,
+    )
+    await register_model(wrt, "check", card)
+
+    # Frontend: KV mode registers the router hit-rate series as well.
+    frt = await DistributedRuntime.create(store_url=url)
+    manager = ModelManager(frt, RouterSettings(mode=RouterMode.KV))
+    watcher = await ModelWatcher(frt, manager).start()
+    http = await HttpService(manager, frt.metrics, health=frt.health,
+                             host="127.0.0.1", port=0).start()
+    for _ in range(100):
+        if manager.list_names():
+            break
+        await asyncio.sleep(0.05)
+
+    # Exporter gauges on their own registry (as the CLI runs them); the
+    # constructor alone registers the full fleet series.
+    ert = await DistributedRuntime.create(store_url=url)
+    MetricsExporter(ert, "check", "backend")
+    ep = ert.namespace("check").component("backend").endpoint("generate")
+    await ep.router(RouterMode.ROUND_ROBIN)  # retries counter + breaker gauge
+
+    registries = [
+        ("worker", wrt.metrics),
+        ("frontend", frt.metrics),
+        ("exporter", ert.metrics),
+    ]
+
+    async def cleanup():
+        await http.close()
+        await watcher.close()
+        await manager.close()
+        for rt in (frt, ert, wrt):
+            await rt.shutdown()
+
+    return registries, cleanup
+
+
+def check(registries) -> list[str]:
+    problems: list[str] = []
+    kinds: dict[str, tuple[str, str]] = {}  # name -> (kind, where first seen)
+    for label, registry in registries:
+        root = registry._root
+        with root._lock:
+            metrics = list(root._metrics.values())
+        if not metrics:
+            problems.append(f"{label}: registry is empty — registration paths not exercised")
+        for metric in metrics:
+            where = f"{label}:{metric.name}"
+            if not metric.help.strip():
+                problems.append(f"{where}: missing help text")
+            seen = kinds.get(metric.name)
+            if seen is None:
+                kinds[metric.name] = (metric.kind, label)
+            elif seen[0] != metric.kind:
+                problems.append(
+                    f"{metric.name}: type collision — {seen[0]} in {seen[1]}, "
+                    f"{metric.kind} in {label}"
+                )
+        # The renderer must also produce a parseable exposition.
+        try:
+            registry.render()
+        except Exception as e:  # noqa: BLE001 — a broken renderer IS the finding
+            problems.append(f"{label}: render() failed: {e}")
+    return problems
+
+
+async def collect_problems() -> tuple[list[str], int]:
+    """→ (problems, total registrations)."""
+    registries, cleanup = await build_registries()
+    try:
+        problems = check(registries)
+    finally:
+        await cleanup()
+    total = sum(len(reg._root._metrics) for _, reg in registries)
+    return problems, total
+
+
+@register
+class MetricsCatalogChecker(Checker):
+    code = "DT006"
+    name = "metrics-catalog"
+    description = (
+        "every registered metric has help text and ONE type across all "
+        "registries (dynamic: boots the serving components)"
+    )
+    dynamic = True
+
+    def run_repo(self, modules) -> Iterable[Finding]:
+        problems, _total = asyncio.run(collect_problems())
+        for p in problems:
+            yield Finding(
+                check=self.code, path=CATALOG_PATH, line=1, message=p,
+            )

@@ -1,0 +1,395 @@
+"""Framework core: module walker, checker registry, suppression comments,
+baseline, and reporters.
+
+Design notes
+------------
+- Pure ``ast`` + ``tokenize`` — importing a swept module is never required
+  (the AST pass must not pull in jax), so a repo-wide run is sub-second.
+- Every file parses ONCE into a :class:`SourceModule` shared by all
+  checkers; a checker is a visitor over that parse, not a regex.
+- Suppressions are *scoped and audited*: ``# dyntpu: allow[DT002]
+  reason=future is in the done set`` on (or immediately above) the flagged
+  line. A missing/empty reason is itself a finding (DT000) that cannot be
+  suppressed — the whole point is that exceptions to an invariant carry
+  their justification in the diff.
+- The baseline file exists for *adopting* a new checker against legacy
+  findings without blocking CI; this repo ships with it EMPTY (clean, not
+  grandfathered). Fingerprints hash the flagged line's content, not its
+  number, so unrelated edits don't invalidate a grandfathered entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator
+
+SUPPRESS_RE = re.compile(
+    r"#\s*dyntpu:\s*allow\[(?P<codes>[A-Z0-9,\s]+)\]\s*(?:reason=(?P<reason>.*))?$"
+)
+
+# Directories never swept, wherever they appear.
+SKIP_DIRS = {".git", "__pycache__", ".venv", "node_modules", ".claude"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str          # "DT001"
+    path: str           # repo-relative, forward slashes
+    line: int           # 1-based
+    message: str
+    snippet: str = ""   # stripped source of the flagged line
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(self.snippet.encode("utf-8", "replace")).hexdigest()[:12]
+        return f"{self.check}:{self.path}:{h}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+@dataclass
+class Suppression:
+    codes: tuple[str, ...]
+    reason: str
+    line: int           # line the allow applies to (the comment's own line)
+
+
+class SourceModule:
+    """One parsed file: source text, AST, and suppression comments."""
+
+    def __init__(self, abspath: str, relpath: str, text: str):
+        self.abspath = abspath
+        self.path = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(text, filename=relpath)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line -> Suppression; a comment alone on its line covers the next
+        # non-comment line, a trailing comment covers its own line.
+        self.suppressions: dict[int, Suppression] = {}
+        self.bad_suppressions: list[Suppression] = []
+        self._collect_suppressions()
+
+    def _collect_suppressions(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            codes = tuple(c.strip() for c in m.group("codes").split(",") if c.strip())
+            reason = (m.group("reason") or "").strip()
+            lineno = tok.start[0]
+            own_line = self.lines[lineno - 1].strip().startswith("#")
+            target = self._next_code_line(lineno) if own_line else lineno
+            sup = Suppression(codes=codes, reason=reason, line=target)
+            if not reason:
+                self.bad_suppressions.append(sup)
+            elif target in self.suppressions:
+                # Stacked allows over one code line (one comment per check)
+                # merge rather than overwrite.
+                prev = self.suppressions[target]
+                self.suppressions[target] = Suppression(
+                    codes=prev.codes + tuple(c for c in codes if c not in prev.codes),
+                    reason=f"{prev.reason}; {reason}",
+                    line=target,
+                )
+            else:
+                self.suppressions[target] = sup
+
+    def _next_code_line(self, lineno: int) -> int:
+        for i in range(lineno, len(self.lines)):
+            stripped = self.lines[i].strip()
+            if stripped and not stripped.startswith("#"):
+                return i + 1
+        return lineno
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, check: str, lineno: int) -> bool:
+        sup = self.suppressions.get(lineno)
+        return sup is not None and check in sup.codes
+
+
+class Checker:
+    """Base class. Subclasses set ``code``/``name``/``description`` and
+    override :meth:`run`; ``dynamic=True`` checkers (DT006) execute code
+    instead of reading it and only run when explicitly requested."""
+
+    code: str = "DT000"
+    name: str = "base"
+    description: str = ""
+    dynamic: bool = False
+    # Repo-relative path prefixes this checker sweeps ((), ) = everything.
+    scope: tuple[str, ...] = ()
+
+    def applies(self, module: SourceModule) -> bool:
+        if not self.scope:
+            return True
+        return any(module.path.startswith(p) for p in self.scope)
+
+    def run(self, module: SourceModule) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def run_repo(self, modules: list[SourceModule]) -> Iterable[Finding]:
+        """Repo-wide pass; default fans out to per-module :meth:`run`."""
+        for module in modules:
+            if module.tree is not None and self.applies(module):
+                yield from self.run(module)
+
+
+_REGISTRY: dict[str, Checker] = {}
+
+
+def register(cls: type[Checker]) -> type[Checker]:
+    inst = cls()
+    if inst.code in _REGISTRY:
+        raise ValueError(f"duplicate checker code {inst.code}")
+    _REGISTRY[inst.code] = inst
+    return cls
+
+
+def all_checkers() -> dict[str, Checker]:
+    # Import for side effect: checker modules self-register.
+    import tools.analysis.checkers  # noqa: F401
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+# ---------------------------------------------------------------------------
+# Walker
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(root: str) -> Iterator[tuple[str, str]]:
+    """Yield (abspath, relpath) for every .py under root, skipping vendored
+    and VCS dirs."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            ap = os.path.join(dirpath, fn)
+            yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
+
+
+def collect_modules(root: str, paths: Iterable[str] | None = None) -> list[SourceModule]:
+    mods: list[SourceModule] = []
+    wanted = [p.rstrip("/") for p in paths] if paths else None
+    for ap, rel in iter_py_files(root):
+        if wanted is not None and not any(
+            rel == w or rel.startswith(w + "/") for w in wanted
+        ):
+            continue
+        try:
+            with open(ap, encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        mods.append(SourceModule(ap, rel, text))
+    return mods
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+DEFAULT_BASELINE = "tools/analysis/baseline.json"
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: set[str] = set()
+    for fps in data.values():
+        out.update(fps)
+    return out
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    by_check: dict[str, list[str]] = {}
+    for f in findings:
+        by_check.setdefault(f.check, []).append(f.fingerprint())
+    for fps in by_check.values():
+        fps.sort()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(by_check, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding] = field(default_factory=list)        # actionable
+    suppressed: list[tuple[Finding, Suppression]] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    checks_run: tuple[str, ...] = ()
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def run_analysis(
+    root: str,
+    paths: Iterable[str] | None = None,
+    checks: Iterable[str] | None = None,
+    baseline_path: str | None = None,
+    include_dynamic: bool = False,
+) -> AnalysisResult:
+    checkers = all_checkers()
+    if checks is not None:
+        unknown = sorted(set(checks) - set(checkers))
+        if unknown:
+            raise KeyError(f"unknown check(s): {', '.join(unknown)}")
+        selected = {c: checkers[c] for c in checks}
+    else:
+        selected = {
+            c: ch for c, ch in checkers.items() if include_dynamic or not ch.dynamic
+        }
+
+    modules = collect_modules(root, paths)
+    result = AnalysisResult(files_scanned=len(modules), checks_run=tuple(selected))
+
+    raw: list[Finding] = []
+    for module in modules:
+        # Malformed suppressions are findings regardless of which checks run:
+        # an unexplained allow is a hole in every invariant it names.
+        for sup in module.bad_suppressions:
+            raw.append(Finding(
+                check="DT000", path=module.path, line=sup.line,
+                message=(
+                    f"suppression allow[{','.join(sup.codes)}] has no reason= — "
+                    "a reason is mandatory"
+                ),
+                snippet=module.line_text(sup.line),
+            ))
+        if module.parse_error and module.path.rsplit("/", 1)[-1] != "conftest.py":
+            raw.append(Finding(
+                check="DT000", path=module.path, line=1,
+                message=f"file does not parse: {module.parse_error}",
+            ))
+
+    for code, checker in selected.items():
+        raw.extend(checker.run_repo(modules))
+
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None else os.path.join(root, DEFAULT_BASELINE)
+    )
+    by_path = {m.path: m for m in modules}
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.check)):
+        mod = by_path.get(f.path)
+        sup = mod.suppressions.get(f.line) if mod else None
+        if f.check != "DT000" and sup is not None and f.check in sup.codes:
+            result.suppressed.append((f, sup))
+        elif f.check != "DT000" and f.fingerprint() in baseline:
+            result.baselined.append(f)
+        else:
+            result.findings.append(f)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Reporters
+# ---------------------------------------------------------------------------
+
+
+def render_text(result: AnalysisResult, verbose: bool = False) -> str:
+    out: list[str] = []
+    for f in result.findings:
+        out.append(f.render())
+        if f.snippet:
+            out.append(f"    {f.snippet}")
+    if verbose:
+        for f, sup in result.suppressed:
+            out.append(f"suppressed: {f.render()}  (reason: {sup.reason})")
+        for f in result.baselined:
+            out.append(f"baselined:  {f.render()}")
+    out.append(
+        f"dyntpu-analyze: {len(result.findings)} finding(s), "
+        f"{len(result.suppressed)} suppressed, {len(result.baselined)} baselined "
+        f"across {result.files_scanned} files "
+        f"({', '.join(result.checks_run)})"
+    )
+    return "\n".join(out)
+
+
+def render_json(result: AnalysisResult) -> str:
+    def enc(f: Finding) -> dict:
+        return {
+            "check": f.check, "path": f.path, "line": f.line,
+            "message": f.message, "snippet": f.snippet,
+            "fingerprint": f.fingerprint(),
+        }
+
+    return json.dumps({
+        "findings": [enc(f) for f in result.findings],
+        "suppressed": [
+            {**enc(f), "reason": s.reason} for f, s in result.suppressed
+        ],
+        "baselined": [enc(f) for f in result.baselined],
+        "files_scanned": result.files_scanned,
+        "checks_run": list(result.checks_run),
+        "exit_code": result.exit_code,
+    }, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers (used by several checkers)
+# ---------------------------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def walk_function_body(fn: ast.AST, into_nested: bool = False) -> Iterator[ast.AST]:
+    """Walk a function's statements WITHOUT descending into nested
+    function/class definitions (their bodies execute in a different
+    context — e.g. a closure handed to run_on_engine_thread)."""
+    stack: list[ast.AST] = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not into_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,16 +1,14 @@
-"""CI guard for the metrics catalog: every registered metric must carry
-help text, and a metric name must have ONE type across every scope and
-process registry (Prometheus emits one TYPE header per name — a collision
-renders the exposition invalid).
+"""CI guard for the metrics catalog — thin shim over the analysis
+framework's DT006 checker (tools/analysis/checkers/dt006_metrics_catalog.py),
+kept so ``python tools/check_metrics.py``, tests/test_check_metrics.py, and
+the docs' invocations keep working.
 
-Instantiates the real serving components on in-memory runtimes so every
-registration path actually executes: frontend HTTP service (+ admission,
-ledger, tracing sink), worker endpoint server (+ chaos injector), routers
-(retry counter), discovery (breaker gauge), and the fleet metrics exporter.
+Every registered metric must carry help text, and a metric name must have
+ONE type across every scope and process registry (Prometheus emits one
+TYPE header per name — a collision renders the exposition invalid).
 
-Exit 0 = catalog clean; exit 1 = violations printed. Wired as a tier-1
-test (tests/test_check_metrics.py); run directly with
-``python tools/check_metrics.py``.
+Exit 0 = catalog clean; exit 1 = violations printed. Equivalent:
+``python -m tools.analysis --check DT006``.
 """
 
 from __future__ import annotations
@@ -23,126 +21,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-
-async def build_registries():
-    """Instantiate the serving components; → [(label, MetricsRegistry)]."""
-    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
-    from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
-    from dynamo_tpu.llm.http_service import HttpService
-    from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
-    from dynamo_tpu.llm.pipeline import RouterSettings
-    from dynamo_tpu.llm.tokenizer import ByteTokenizer
-    from dynamo_tpu.metrics_exporter import MetricsExporter
-    from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
-    from dynamo_tpu.runtime.chaos import ChaosConfig
-    from dynamo_tpu.runtime.config import Config
-    from dynamo_tpu.runtime.distributed import DistributedRuntime
-    from dynamo_tpu.runtime.push_router import RouterMode
-
-    url = "memory://check_metrics"
-    # Worker with chaos enabled so the injector's counter registers too.
-    wcfg = Config.from_env({})
-    wcfg.chaos = ChaosConfig(enabled=True, seed=1)
-    wrt = await DistributedRuntime.create(store_url=url, config=wcfg)
-    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=64, speedup=1000.0))
-    broadcaster = KvEventBroadcaster(engine.pool)
-    # TPU-engine hot-loop gauges (what worker/__main__ binds for
-    # engine=tpu): register via the shared path so the catalog guard
-    # covers them without booting a real engine. Lazy import — pulls jax.
-    from dynamo_tpu.engine.engine import register_engine_metrics
-
-    register_engine_metrics(wrt.metrics)
-
-    async def gen_handler(payload, ctx):
-        async for item in engine.generate(payload, ctx):
-            yield item
-
-    comp = wrt.namespace("check").component("backend")
-    await comp.endpoint("generate").serve(gen_handler)
-    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
-    card = ModelDeploymentCard(
-        name="check-model", kv_cache_block_size=4,
-        eos_token_ids=[ByteTokenizer.EOS], context_length=128,
-    )
-    await register_model(wrt, "check", card)
-
-    # Frontend: KV mode registers the router hit-rate series as well.
-    frt = await DistributedRuntime.create(store_url=url)
-    manager = ModelManager(frt, RouterSettings(mode=RouterMode.KV))
-    watcher = await ModelWatcher(frt, manager).start()
-    http = await HttpService(manager, frt.metrics, health=frt.health,
-                             host="127.0.0.1", port=0).start()
-    for _ in range(100):
-        if manager.list_names():
-            break
-        await asyncio.sleep(0.05)
-
-    # Exporter gauges on their own registry (as the CLI runs them); the
-    # constructor alone registers the full fleet series.
-    ert = await DistributedRuntime.create(store_url=url)
-    MetricsExporter(ert, "check", "backend")
-    ep = ert.namespace("check").component("backend").endpoint("generate")
-    await ep.router(RouterMode.ROUND_ROBIN)  # retries counter + breaker gauge
-
-    registries = [
-        ("worker", wrt.metrics),
-        ("frontend", frt.metrics),
-        ("exporter", ert.metrics),
-    ]
-
-    async def cleanup():
-        await http.close()
-        await watcher.close()
-        await manager.close()
-        for rt in (frt, ert, wrt):
-            await rt.shutdown()
-
-    return registries, cleanup
-
-
-def check(registries) -> list[str]:
-    problems: list[str] = []
-    kinds: dict[str, tuple[str, str]] = {}  # name -> (kind, where first seen)
-    for label, registry in registries:
-        root = registry._root
-        with root._lock:
-            metrics = list(root._metrics.values())
-        if not metrics:
-            problems.append(f"{label}: registry is empty — registration paths not exercised")
-        for metric in metrics:
-            where = f"{label}:{metric.name}"
-            if not metric.help.strip():
-                problems.append(f"{where}: missing help text")
-            seen = kinds.get(metric.name)
-            if seen is None:
-                kinds[metric.name] = (metric.kind, label)
-            elif seen[0] != metric.kind:
-                problems.append(
-                    f"{metric.name}: type collision — {seen[0]} in {seen[1]}, "
-                    f"{metric.kind} in {label}"
-                )
-        # The renderer must also produce a parseable exposition.
-        try:
-            registry.render()
-        except Exception as e:  # noqa: BLE001
-            problems.append(f"{label}: render() failed: {e}")
-    return problems
+from tools.analysis.checkers.dt006_metrics_catalog import (  # noqa: E402,F401
+    build_registries,  # re-exported: pre-shim importers used these
+    check,
+    collect_problems,
+)
 
 
 async def amain() -> int:
-    registries, cleanup = await build_registries()
-    try:
-        problems = check(registries)
-    finally:
-        await cleanup()
-    total = sum(len(reg._root._metrics) for _, reg in registries)
+    problems, total = await collect_problems()
     if problems:
         print(f"check_metrics: {len(problems)} problem(s) in {total} metrics:")
         for p in problems:
             print(f"  - {p}")
         return 1
-    names = sorted({m.name for _, reg in registries for m in reg._root._metrics.values()})
-    print(f"check_metrics: OK — {total} registrations, {len(names)} metric names, "
+    print(f"check_metrics: OK — {total} registrations, "
           f"all with help text, no type collisions")
     return 0
 

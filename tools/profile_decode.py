@@ -138,12 +138,15 @@ async def engine_hotloop(
                 toks.extend(item.get("token_ids") or [])
             return toks
 
-        phase0 = dict(engine.phase_s)
+        # phase_s is scheduler-thread-owned (DT001): snapshot it ON that
+        # thread between steps rather than racing the hot loop's dict.
+        phase0 = await engine.run_on_engine_thread(lambda: dict(engine.phase_s))
         t0 = time.perf_counter()
         streams = await asyncio.gather(*(run_one(r) for r in reqs))
         elapsed = time.perf_counter() - t0
+        phase1 = await engine.run_on_engine_thread(lambda: dict(engine.phase_s))
         blocked = sum(
-            engine.phase_s.get(k, 0.0) - phase0.get(k, 0.0) for k in BLOCKING_PHASES
+            phase1.get(k, 0.0) - phase0.get(k, 0.0) for k in BLOCKING_PHASES
         )
         out = {
             "pipeline_depth": pipeline_depth,
@@ -152,9 +155,9 @@ async def engine_hotloop(
             "decode_tok_s": round(sum(len(s) for s in streams) / elapsed, 1),
             "host_blocked_frac": round(blocked / elapsed, 3) if elapsed else 0.0,
             "host_phase_s": {
-                k: round(engine.phase_s[k] - phase0.get(k, 0.0), 4)
-                for k in sorted(set(engine.phase_s) | set(phase0))
-                if engine.phase_s[k] - phase0.get(k, 0.0) > 1e-4
+                k: round(phase1[k] - phase0.get(k, 0.0), 4)
+                for k in sorted(set(phase1) | set(phase0))
+                if phase1.get(k, 0.0) - phase0.get(k, 0.0) > 1e-4
             },
             "prefill_pad_ratio": round(
                 engine.total_prefill_padded / max(1, engine.total_prefilled), 3
@@ -172,7 +175,7 @@ async def engine_hotloop(
                 "spec_tokens_per_pass": round(
                     engine.total_spec_emitted / max(1, engine.total_spec_rows), 2
                 ),
-                "spec_draft_s": round(engine.phase_s.get("draft", 0.0), 4),
+                "spec_draft_s": round(phase1.get("draft", 0.0), 4),
             })
         return out
     finally:
