@@ -63,6 +63,10 @@ def parse_args():
                    help="device HBM budget for auto KV sizing (v5e = 16)")
     p.add_argument("--quant", choices=["none", "int8"], default="int8",
                    help="weight format (int8 halves weight bandwidth; 8B needs it on one 16GB chip)")
+    p.add_argument("--kv-quant", choices=["none", "int8"], default="none",
+                   help="paged KV storage format (int8 pages + per-position "
+                        "scales → ~2x num_kv_blocks in the same HBM budget, "
+                        "so ~2x max-resident sequences; docs/performance.md)")
     p.add_argument("--block-size", type=int, default=16,
                    help="KV page size; 16 = 32KB pages at 8B geometry, already "
                         "DMA-efficient (ops/paged_attention.py header)")
@@ -173,7 +177,14 @@ async def bench(args) -> dict:
     # Fit weights + KV in HBM (8B-class models leave far less KV room):
     # cap the pool and shrink concurrency to what the pool can hold.
     weight_bytes = model.param_count() * (1 if args.quant == "int8" else 2)
-    kv_block_bytes = 2 * model.num_layers * block_size * model.kv_size * 2
+    # Real per-block cost from the engine's own capacity math (storage
+    # dtype + scale sidecars) — the int8-KV pool fits ~2x the blocks.
+    # Probe with the SAME dtype the engine below runs: dense f32 pages
+    # under --cpu cost 2x the bf16 default.
+    dtype = "float32" if args.cpu else "bfloat16"
+    kv_block_bytes = EngineArgs(
+        model=model, block_size=block_size, kv_quant=args.kv_quant, dtype=dtype,
+    ).kv_bytes_per_block()
     budget = args.hbm_gb * 1e9 * 0.92 - weight_bytes - 1.2e9
     if budget < kv_block_bytes * blocks_per_seq * 2:
         fixes = "a smaller model or tp>=2 (multi-chip)"
@@ -193,12 +204,13 @@ async def bench(args) -> dict:
         max_num_seqs=max_num_seqs,
         max_model_len=(blocks_per_seq + 1) * block_size,
         max_prefill_tokens=max(512, int(prompt_lens.max())),
-        dtype="float32" if args.cpu else "bfloat16",
+        dtype=dtype,
         decode_steps=args.decode_steps,
         pipeline_depth=args.pipeline_depth,
         pipeline_windows=args.pipeline_depth > 0,
         prefill_buckets_spec=args.prefill_buckets,
         quant=args.quant,
+        kv_quant=args.kv_quant,
         spec_tokens=spec_tokens,
         spec_ngram=args.spec_ngram,
     )
@@ -557,11 +569,23 @@ async def bench(args) -> dict:
         "vs_baseline_raw_ratio": round(decode_tok_s / REF_DECODE_TOK_S_PER_GPU, 2),
         "model": model.name,
         "quant": args.quant,
+        "kv_quant": args.kv_quant,
         "params": model.param_count(),
         "device": device,
         "num_requests": n,
         "max_num_seqs": max_num_seqs,
+        # KV capacity accounting (the int8-KV win is visible here across
+        # BENCH_r* rounds): per-token page cost, the pool's block count,
+        # and how many max_model_len sequences could be resident at once
+        # vs the concurrency cap actually configured.
         "num_kv_blocks": num_kv_blocks,
+        "kv_bytes_per_token": round(kv_block_bytes / block_size, 1),
+        "kv_pool_gb": round(num_kv_blocks * kv_block_bytes / 1e9, 2),
+        # A max_model_len sequence occupies blocks_per_seq + 1 blocks
+        # (max_model_len = (blocks_per_seq + 1) * block_size above), and
+        # block 0 is the reserved pad/garbage sink.
+        "max_resident_seqs": (num_kv_blocks - 1) // (blocks_per_seq + 1),
+        "seq_headroom": (num_kv_blocks - 1) // (blocks_per_seq + 1) - max_num_seqs,
         "workload": workload,
         "prompt_len_median": int(np.median(prompt_lens)),
         "gen_len_median": int(np.median(gen_lens)),

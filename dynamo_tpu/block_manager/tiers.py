@@ -26,13 +26,18 @@ import numpy as np
 
 
 class HostBlockPool:
-    """G2: host-RAM pages keyed by sequence hash, LRU-bounded."""
+    """G2: host-RAM pages keyed by sequence hash, LRU-bounded.
+
+    A "page" is the tuple of per-block arrays the engine extracts:
+    ``(k, v)`` for full-precision caches, ``(k, v, k_scale, v_scale)``
+    for int8 KV — the pools are format-agnostic, so the same
+    ``capacity_blocks`` budget holds ~2x the tokens under int8."""
 
     def __init__(self, capacity_blocks: int, spill=None):
         self.capacity = capacity_blocks
-        self._pages: OrderedDict[int, tuple[np.ndarray, np.ndarray]] = OrderedDict()
+        self._pages: OrderedDict[int, tuple[np.ndarray, ...]] = OrderedDict()
         self._lock = threading.Lock()
-        self._spill = spill  # callable(hash, k, v) — e.g. DiskBlockPool.put
+        self._spill = spill  # callable(hash, *pages) — e.g. DiskBlockPool.put
         self.hits = 0
         self.misses = 0
 
@@ -40,29 +45,26 @@ class HostBlockPool:
         with self._lock:
             return len(self._pages)
 
-    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, seq_hash: int, *pages: np.ndarray) -> None:
         spilled = []
         # Own the storage: callers pass views into shared batch buffers
         # (engine extracts up to 64 blocks per DMA and slices per block);
         # retaining a view would pin the whole batch buffer and break the
         # capacity accounting.
-        if k.base is not None:
-            k = k.copy()
-        if v.base is not None:
-            v = v.copy()
+        pages = tuple(a.copy() if a.base is not None else a for a in pages)
         with self._lock:
             if seq_hash in self._pages:
                 self._pages.move_to_end(seq_hash)
                 return
-            self._pages[seq_hash] = (k, v)
+            self._pages[seq_hash] = pages
             while len(self._pages) > self.capacity:
-                h, pages = self._pages.popitem(last=False)
-                spilled.append((h, pages))
-        for h, (sk, sv) in spilled:
+                h, pgs = self._pages.popitem(last=False)
+                spilled.append((h, pgs))
+        for h, pgs in spilled:
             if self._spill is not None:
-                self._spill(h, sk, sv)
+                self._spill(h, *pgs)
 
-    def get(self, seq_hash: int) -> tuple[np.ndarray, np.ndarray] | None:
+    def get(self, seq_hash: int) -> tuple[np.ndarray, ...] | None:
         with self._lock:
             pages = self._pages.get(seq_hash)
             if pages is not None:
@@ -112,7 +114,8 @@ class DiskBlockPool:
         with self._lock:
             return len(self._order)
 
-    def put(self, seq_hash: int, k: np.ndarray, v: np.ndarray) -> None:
+    def put(self, seq_hash: int, *pages: np.ndarray) -> None:
+        k, v = pages[0], pages[1]
         evict: list[int] = []
         with self._lock:
             if seq_hash in self._order:
@@ -125,9 +128,12 @@ class DiskBlockPool:
         kind = str(k.dtype)
         if kind == "bfloat16":
             k, v = k.view(np.uint16), v.view(np.uint16)
+        extra = {}
+        if len(pages) == 4:  # int8 pages carry fp32 scale sidecars
+            extra = {"k_scale": pages[2], "v_scale": pages[3]}
         tmp = self._path(seq_hash) + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, k=k, v=v, dtype=np.bytes_(kind))
+            np.savez(f, k=k, v=v, dtype=np.bytes_(kind), **extra)
         os.replace(tmp, self._path(seq_hash))
         for h in evict:
             try:
@@ -135,11 +141,14 @@ class DiskBlockPool:
             except OSError:
                 pass
 
-    def get(self, seq_hash: int) -> tuple[np.ndarray, np.ndarray] | None:
+    def get(self, seq_hash: int) -> tuple[np.ndarray, ...] | None:
         path = self._path(seq_hash)
         try:
             with np.load(path) as z:
                 k, v, kind = z["k"], z["v"], bytes(z["dtype"]).decode()
+                scales = (
+                    (z["k_scale"], z["v_scale"]) if "k_scale" in z.files else ()
+                )
         except (OSError, KeyError, ValueError):
             self.misses += 1
             return None
@@ -151,7 +160,7 @@ class DiskBlockPool:
             if seq_hash in self._order:
                 self._order.move_to_end(seq_hash)
         self.hits += 1
-        return k, v
+        return (k, v, *scales)
 
     def contains(self, seq_hash: int) -> bool:
         with self._lock:
@@ -194,14 +203,16 @@ class TierStack:
     def enabled(self) -> bool:
         return self.host is not None or self.disk is not None
 
-    def offload(self, pairs: list[tuple[int, np.ndarray, np.ndarray]]) -> int:
-        """pairs: (seq_hash, k_page, v_page). → number offloaded."""
+    def offload(self, pairs: list[tuple]) -> int:
+        """pairs: (seq_hash, *page_arrays) — (hash, k, v) for dense
+        caches, (hash, k, v, k_scale, v_scale) for int8. → number
+        offloaded."""
         n = 0
-        for seq_hash, k, v in pairs[: self.MAX_OFFLOAD_PER_STEP]:
+        for seq_hash, *pages in pairs[: self.MAX_OFFLOAD_PER_STEP]:
             if self.host is not None:
-                self.host.put(seq_hash, k, v)
+                self.host.put(seq_hash, *pages)
             elif self.disk is not None:
-                self.disk.put(seq_hash, k, v)
+                self.disk.put(seq_hash, *pages)
             n += 1
         self.offloaded_blocks += n
         return n
@@ -219,8 +230,8 @@ class TierStack:
             n += 1
         return n
 
-    def lookup_run(self, hashes: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
-        out: list[tuple[np.ndarray, np.ndarray]] = []
+    def lookup_run(self, hashes: list[int]) -> list[tuple[np.ndarray, ...]]:
+        out: list[tuple[np.ndarray, ...]] = []
         for h in hashes:
             pages = self.host.get(h) if self.host is not None else None
             if pages is None and self.disk is not None:
@@ -233,12 +244,12 @@ class TierStack:
         self.onboarded_blocks += len(out)
         return out
 
-    def read_run(self, hashes: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
+    def read_run(self, hashes: list[int]) -> list[tuple[np.ndarray, ...]]:
         """Non-promoting ``lookup_run``: G3 hits are NOT copied into G2 and
         the onboard counter is untouched. For serving a PEER's fetch
         (llm/peer_kv.py) — exporting a block must not evict this worker's
         own hot pages or masquerade as a local onboard."""
-        out: list[tuple[np.ndarray, np.ndarray]] = []
+        out: list[tuple[np.ndarray, ...]] = []
         for h in hashes:
             pages = self.host.get(h) if self.host is not None else None
             if pages is None and self.disk is not None:

@@ -171,6 +171,21 @@ class EngineArgs:
     # with per-output-channel scales (engine/quant.py) — halves weight
     # bandwidth (the decode bottleneck) and fits llama-8b on one v5e.
     quant: str = "none"
+    # KV cache storage format: "none" = pages in ``dtype``; "int8" =
+    # pages stored int8 with per-position-per-head fp32 scales riding a
+    # parallel array alongside the cache (model.KVCache.k_scale/v_scale,
+    # same symmetric absmax scheme as engine/quant.py). Near-halves
+    # kv_bytes_per_block, so auto_kv_blocks fits ~2x the sequences in
+    # the same HBM budget — a capacity AND batch-size win in the weight-
+    # bandwidth-bound decode regime. Every consumer dequantizes at read
+    # (XLA gather paths and the Pallas kernels, in-register); every
+    # tier/transfer hop (G2/G3 offload, disagg export, peer fetch) moves
+    # int8+scale payloads, halving those bytes too. Scales are per
+    # WRITTEN POSITION (not per sealed block) so a token's stored value
+    # never depends on which path wrote it (prefill / decode window /
+    # spec verify) or on later writes — the property that keeps greedy
+    # streams byte-stable across pipeline depths and spec modes.
+    kv_quant: str = "none"
     # Attention backend (ops/paged_attention.py): "auto" → Pallas kernel
     # on TPU (single-device), XLA gather on CPU. Forced to "xla" under a
     # tp/dp mesh (pallas_call is opaque to GSPMD partitioning).
@@ -293,6 +308,10 @@ class EngineArgs:
         # the first bucket_prefill call.
         if self.prefill_buckets_spec not in ("fine", "coarse"):
             self._parse_bucket_list(self.prefill_buckets_spec)
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant must be 'none' or 'int8'; got {self.kv_quant!r}"
+            )
         if self.max_model_len % self.block_size:
             self.max_model_len = ((self.max_model_len // self.block_size) + 1) * self.block_size
         if self.max_prefill_tokens % self.block_size:
@@ -444,9 +463,21 @@ class EngineArgs:
         return max(0, self.pipeline_depth) if self.pipeline_windows else 0
 
     def kv_bytes_per_block(self) -> int:
+        """HBM bytes one block costs across all layers, k+v, derived
+        from the KV STORAGE dtype — not ``dtype`` alone, which silently
+        mis-sized ``auto_kv_blocks`` 2x under kv_quant=int8. int8 pages
+        carry a per-position-per-head fp32 scale array (model.KVCache),
+        so the real cost is 1 byte/elem + 4/head_dim bytes/elem of scale
+        overhead (~3% at head_dim=128 → ~1.94x more blocks per byte)."""
         m = self.model
-        itemsize = 2 if self.dtype == "bfloat16" else 4
-        return 2 * m.num_layers * self.block_size * m.num_kv_heads * m.head_dim * itemsize
+        elems = self.block_size * m.num_kv_heads * m.head_dim
+        if self.kv_quant == "int8":
+            # int8 page + fp32 scale per (position, kv head).
+            per_layer = elems + self.block_size * m.num_kv_heads * 4
+        else:
+            itemsize = 2 if self.dtype == "bfloat16" else 4
+            per_layer = elems * itemsize
+        return 2 * m.num_layers * per_layer
 
     def replace(self, **kw) -> "EngineArgs":
         return dataclasses.replace(self, **kw)

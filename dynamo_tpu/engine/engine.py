@@ -242,6 +242,16 @@ def register_engine_metrics(registry):
             "Decode tokens sampled per per-sequence weight stream "
             "(1.0 = dense; >1.0 = speculation paying off)",
         ),
+        registry.gauge(
+            "engine_kv_cache_bytes",
+            "HBM bytes of the G1 paged KV pool (pages + quantization "
+            "scales, num_kv_blocks x kv_bytes_per_block)",
+        ),
+        registry.gauge(
+            "engine_kv_quant_enabled",
+            "1 when the paged KV cache stores int8 pages (kv_quant), "
+            "0 for full-precision storage",
+        ),
     )
 
 
@@ -376,7 +386,10 @@ class TpuEngine:
     def _update_gauges(self) -> None:
         if self._gauges is None:
             return
-        g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp = self._gauges
+        (g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp,
+         g_kvb, g_kvq) = self._gauges
+        g_kvb.set(self.args.kv_bytes_per_block() * self.args.num_kv_blocks)
+        g_kvq.set(1 if self.args.kv_quant == "int8" else 0)
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
         g_first.set(sum(1 for it in self._fetchq if isinstance(it, _First)))
         g_pad.set(self.total_prefill_padded / max(1, self.total_prefilled))
@@ -895,9 +908,12 @@ class TpuEngine:
             return
         batch = self._offload_pending[: self.tiers.MAX_OFFLOAD_PER_STEP]
         del self._offload_pending[: len(batch)]
-        pk, pv = self._runner.extract_pages([b for b, _ in batch])
+        pages = self._runner.extract_pages([b for b, _ in batch])
         self.tiers.offload(
-            [(h, pk[:, i : i + 1], pv[:, i : i + 1]) for i, (_, h) in enumerate(batch)]
+            [
+                (h, *(a[:, i : i + 1] for a in pages))
+                for i, (_, h) in enumerate(batch)
+            ]
         )
 
     def _reap_cancelled(self) -> None:
@@ -942,10 +958,18 @@ class TpuEngine:
         if self.tiers.enabled and n_hit < max_hit:
             run = self.tiers.lookup_run(hashes_matchable[n_hit:])
             if run:
-                pk = np.concatenate([k for k, _ in run], axis=1)
-                pv = np.concatenate([v for _, v in run], axis=1)
+                # Per-block page tuples → one batched inject; int8 pages
+                # carry their scale sidecars through the same stack, and
+                # blocks a persistent disk dir stored under a different
+                # kv_quant setting are bridged to the current format.
+                pages = kv_transfer.concat_page_run(
+                    run,
+                    quantized=self.args.kv_quant == "int8",
+                    num_kv_heads=self.args.model.num_kv_heads,
+                    dtype=self.args.dtype,
+                )
                 n_onb = n_hit + len(run)
-                self._runner.inject_pages(seq.block_ids[n_hit:n_onb], pk, pv)
+                self._runner.inject_pages(seq.block_ids[n_hit:n_onb], *pages)
                 n_hit = n_onb
                 start = n_hit * bs
                 seq.prefix_hit_blocks = n_hit
@@ -1094,8 +1118,7 @@ class TpuEngine:
             return n_hit * bs, n_hit
         self._runner.inject_pages(
             seq.block_ids[n_hit:n_inj],
-            payload.k[:, n_hit - off : n_inj - off],
-            payload.v[:, n_hit - off : n_inj - off],
+            *(a[:, n_hit - off : n_inj - off] for a in payload.pages()),
         )
         seq.inject = None  # free host pages promptly
         return n_inj * bs, n_inj
@@ -1105,8 +1128,9 @@ class TpuEngine:
         n_exp = (plen - 1) // bs  # full blocks only; suffix recomputed remotely
         meta = {"remote_handle": seq.request_id, "num_tokens": n_exp * bs, "num_blocks": n_exp}
         if n_exp > 0:
-            pk, pv = self._runner.extract_pages(seq.block_ids[:n_exp])
-            payload = kv_transfer.KvPagePayload(k=pk, v=pv, num_tokens=n_exp * bs)
+            pages = self._runner.extract_pages(seq.block_ids[:n_exp])
+            # int8 KV: scale sidecars ride the same payload.
+            payload = kv_transfer.KvPagePayload.from_pages(pages, n_exp * bs)
             with self._mutex:
                 self._exports[seq.request_id] = (payload, time.monotonic() + self.export_ttl_s)
         seq.export_meta = meta

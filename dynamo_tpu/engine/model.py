@@ -44,13 +44,47 @@ Params = dict[str, Any]
 
 
 class KVCache(NamedTuple):
+    """Paged KV storage. With ``kv_quant="int8"`` the pages hold int8
+    and a parallel per-position-per-head fp32 scale array rides along
+    (``k_scale``/``v_scale`` are None for full-precision caches) — the
+    same symmetric absmax scheme as engine/quant.py, at the granularity
+    that keeps writes path-independent: a token's stored bytes depend
+    only on its own K/V vector, never on its block's other occupants, so
+    speculative-rollback junk and partial blocks cannot perturb already-
+    written positions and greedy streams stay byte-stable across
+    prefill/decode/spec write orders."""
+
     k: jax.Array  # [L, N, bs, KVH*hd]
     v: jax.Array
+    k_scale: jax.Array | None = None  # [L, N, bs, KVH] fp32 — int8 only
+    v_scale: jax.Array | None = None
 
 
-def init_kv_cache(cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16) -> KVCache:
+def init_kv_cache(
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
+    kv_quant: str = "none",
+) -> KVCache:
     shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads * cfg.head_dim)
+    if kv_quant == "int8":
+        sshape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads)
+        return KVCache(
+            jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+            jnp.zeros(sshape, jnp.float32), jnp.zeros(sshape, jnp.float32),
+        )
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization of fresh K/V rows along the
+    head dim: x [..., KVH, hd] float → (int8 [..., KVH, hd], fp32 scale
+    [..., KVH]). Mirrors quant.py's per-channel scheme (all-zero rows
+    get scale 1.0 so dequant is exact zero). Deterministic per written
+    vector — the invariant every golden-stability guarantee rests on."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax, 127.0) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
@@ -269,8 +303,10 @@ def prefill_batch_impl(
     scale = hd ** -0.5
     G = cfg.num_heads // KVH
 
+    from dynamo_tpu.ops.paged_attention import gather_dequant_pages
+
     def layer(carry, xs):
-        x, k_cache, v_cache = carry
+        x, k_cache, v_cache, k_scale, v_scale = carry
         lp, layer_idx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, lp, cfg)
@@ -282,18 +318,42 @@ def prefill_batch_impl(
 
         # Write all rows' suffix KV pages in one scatter (rows own
         # disjoint blocks; duplicates only at garbage block 0).
-        k_cache = k_cache.at[layer_idx, flat_ids].set(
-            k.reshape(Bp * nb, bs, KVH * hd)
-        )
-        v_cache = v_cache.at[layer_idx, flat_ids].set(
-            v.reshape(Bp * nb, bs, KVH * hd)
-        )
+        # int8 storage: quantize at page-write time, scales ride a
+        # parallel scatter; the suffix still self-attends its exact
+        # register values below (only LATER readers see the rounding).
+        if k_scale is not None:
+            kq, ksc = kv_quantize(k)
+            vq, vsc = kv_quantize(v)
+            k_cache = k_cache.at[layer_idx, flat_ids].set(
+                kq.reshape(Bp * nb, bs, KVH * hd)
+            )
+            v_cache = v_cache.at[layer_idx, flat_ids].set(
+                vq.reshape(Bp * nb, bs, KVH * hd)
+            )
+            k_scale = k_scale.at[layer_idx, flat_ids].set(
+                ksc.reshape(Bp * nb, bs, KVH)
+            )
+            v_scale = v_scale.at[layer_idx, flat_ids].set(
+                vsc.reshape(Bp * nb, bs, KVH)
+            )
+        else:
+            k_cache = k_cache.at[layer_idx, flat_ids].set(
+                k.reshape(Bp * nb, bs, KVH * hd)
+            )
+            v_cache = v_cache.at[layer_idx, flat_ids].set(
+                v.reshape(Bp * nb, bs, KVH * hd)
+            )
 
-        # Prefix pages (gathered dense) + suffix (already in registers).
+        # Prefix pages (gathered dense, dequantized for int8 storage) +
+        # suffix (already in registers).
         layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
         layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
-        pk = layer_k[block_tables].reshape(Bp, W * bs, KVH, hd)
-        pv = layer_v[block_tables].reshape(Bp, W * bs, KVH, hd)
+        sk = sv = None
+        if k_scale is not None:
+            sk = lax.dynamic_index_in_dim(k_scale, layer_idx, 0, keepdims=False)
+            sv = lax.dynamic_index_in_dim(v_scale, layer_idx, 0, keepdims=False)
+        pk = gather_dequant_pages(layer_k, sk, block_tables, KVH, hd, x.dtype)
+        pv = gather_dequant_pages(layer_v, sv, block_tables, KVH, hd, x.dtype)
 
         qg = q.reshape(Bp, T, KVH, G, hd)
         # scores vs prefix pages / vs own suffix
@@ -313,15 +373,18 @@ def prefill_batch_impl(
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(h, lp, cfg)
-        return (x, k_cache, v_cache), None
+        return (x, k_cache, v_cache, k_scale, v_scale), None
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-    (x, k_cache, v_cache), _ = lax.scan(layer, (x, cache.k, cache.v), (params["layers"], layer_ids))
+    (x, k_cache, v_cache, k_scale, v_scale), _ = lax.scan(
+        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (params["layers"], layer_ids),
+    )
 
     last = jnp.clip(true_len - start_pos - 1, 0, T - 1)      # [Bp]
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]  # [Bp, D]
     logits = _logits(cfg, params, x_last)
-    return logits, KVCache(k_cache, v_cache)
+    return logits, KVCache(k_cache, v_cache, k_scale, v_scale)
 
 
 def prefill_impl(
@@ -389,7 +452,7 @@ def decode_step_impl(
     G = cfg.num_heads // cfg.num_kv_heads
 
     def layer(carry, xs):
-        x, k_cache, v_cache = carry
+        x, k_cache, v_cache, k_scale, v_scale = carry
         lp, layer_idx = xs
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, lp, cfg)
@@ -401,16 +464,29 @@ def decode_step_impl(
         qg = q.reshape(B, cfg.num_kv_heads, G, cfg.head_dim)
 
         # In-place scatter of the new token's KV (inactive rows → garbage
-        # block 0), then paged attention over [0, positions].
-        k_cache = k_cache.at[layer_idx, blk, off].set(k.reshape(B, cfg.kv_size))
-        v_cache = v_cache.at[layer_idx, blk, off].set(v.reshape(B, cfg.kv_size))
+        # block 0), then paged attention over [0, positions]. int8
+        # storage quantizes the fresh row at write time, so this step's
+        # OWN token is read back dequantized — exactly what any later
+        # step would see, keeping the math write-order-independent.
+        if k_scale is not None:
+            kq, ksc = kv_quantize(k)
+            vq, vsc = kv_quantize(v)
+            k_cache = k_cache.at[layer_idx, blk, off].set(kq.reshape(B, cfg.kv_size))
+            v_cache = v_cache.at[layer_idx, blk, off].set(vq.reshape(B, cfg.kv_size))
+            k_scale = k_scale.at[layer_idx, blk, off].set(ksc)
+            v_scale = v_scale.at[layer_idx, blk, off].set(vsc)
+        else:
+            k_cache = k_cache.at[layer_idx, blk, off].set(k.reshape(B, cfg.kv_size))
+            v_cache = v_cache.at[layer_idx, blk, off].set(v.reshape(B, cfg.kv_size))
         if impl == "xla":
             o = paged_decode_attention_xla(
-                qg, k_cache, v_cache, layer_idx, block_tables, lengths
+                qg, k_cache, v_cache, layer_idx, block_tables, lengths,
+                k_scale, v_scale,
             )
         else:
             o = paged_decode_attention(
                 qg, k_cache, v_cache, layer_idx, block_tables, lengths,
+                k_scale, v_scale,
                 interpret=(impl == "pallas_interpret"),
             )
         o = o.reshape(B, cfg.q_size)
@@ -418,13 +494,16 @@ def decode_step_impl(
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(h, lp, cfg)
-        return (x, k_cache, v_cache), None
+        return (x, k_cache, v_cache, k_scale, v_scale), None
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-    (x, k_cache, v_cache), _ = lax.scan(layer, (x, cache.k, cache.v), (params["layers"], layer_ids))
+    (x, k_cache, v_cache, k_scale, v_scale), _ = lax.scan(
+        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+        (params["layers"], layer_ids),
+    )
 
     logits = _logits(cfg, params, x)  # [B, V]
-    return logits, KVCache(k_cache, v_cache)
+    return logits, KVCache(k_cache, v_cache, k_scale, v_scale)
 
 
 def multi_decode_impl(
@@ -560,7 +639,9 @@ def spec_verify_impl(
     steps0: jax.Array,        # [B] int32 per-row emission index of the first token
     *,
     fused: bool = True,       # static — single-pass forward vs stepwise scan
-    attn_impl: str = "auto",  # stepwise path's attention backend
+    attn_impl: str = "auto",  # attention backend: stepwise decode steps AND
+                              # the fused path's gather (Pallas fused-gather
+                              # kernel on TPU, XLA gather otherwise)
 ) -> tuple[jax.Array, ...]:
     """Speculative verify: score S1 consecutive positions per row in one
     dispatch. Input j writes its KV at positions0+j and position j's
@@ -600,7 +681,11 @@ def spec_verify_impl(
         spec_acceptance,
         top_k_logprobs,
     )
-    from dynamo_tpu.ops.paged_attention import paged_spec_attention_xla
+    from dynamo_tpu.ops.paged_attention import (
+        paged_spec_attention,
+        paged_spec_attention_xla,
+        resolve_attn_impl,
+    )
 
     B, T = tokens.shape
     bs = cache.k.shape[2]
@@ -621,9 +706,17 @@ def spec_verify_impl(
         lengths = jnp.where(use, pos + 1, 0)  # [B, T] — query j attends [0, pos_j]
 
         G = cfg.num_heads // KVH
+        # Fused spec-verify gather (ops.paged_spec_attention): one Pallas
+        # kernel walks each row's true pages for all T queries and
+        # dequantizes in-register — no materialized relayout copy of the
+        # gathered table (the ~9ms/layer XLA tax). Falls back to the XLA
+        # gather when the query columns exceed the 128-lane budget or the
+        # backend is not TPU-like.
+        impl = resolve_attn_impl(attn_impl)
+        use_kernel = impl in ("pallas", "pallas_interpret") and KVH * T * G <= 128
 
         def layer(carry, xs):
-            x, k_cache, v_cache = carry
+            x, k_cache, v_cache, k_scale, v_scale = carry
             lp, layer_idx = xs
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q, k, v = _qkv(h, lp, cfg)
@@ -637,29 +730,55 @@ def spec_verify_impl(
             # Scatter all T new KV entries, then gather-attend: in-chunk
             # keys come back out of the pages, so query j sees inputs
             # 0..j through the same path the dense step does
-            # (write-then-attend).
-            k_cache = k_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
-                k.reshape(B * T, cfg.kv_size)
-            )
-            v_cache = v_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
-                v.reshape(B * T, cfg.kv_size)
-            )
-            o = paged_spec_attention_xla(
-                qg, k_cache, v_cache, layer_idx, block_tables, lengths
-            )
+            # (write-then-attend) — including the same quantization
+            # rounding when the cache is int8.
+            if k_scale is not None:
+                kq, ksc = kv_quantize(k)
+                vq, vsc = kv_quantize(v)
+                k_cache = k_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                    kq.reshape(B * T, cfg.kv_size)
+                )
+                v_cache = v_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                    vq.reshape(B * T, cfg.kv_size)
+                )
+                k_scale = k_scale.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                    ksc.reshape(B * T, KVH)
+                )
+                v_scale = v_scale.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                    vsc.reshape(B * T, KVH)
+                )
+            else:
+                k_cache = k_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                    k.reshape(B * T, cfg.kv_size)
+                )
+                v_cache = v_cache.at[layer_idx, blk.reshape(-1), off.reshape(-1)].set(
+                    v.reshape(B * T, cfg.kv_size)
+                )
+            if use_kernel:
+                o = paged_spec_attention(
+                    qg, k_cache, v_cache, layer_idx, block_tables, lengths,
+                    k_scale, v_scale,
+                    interpret=(impl == "pallas_interpret"),
+                )
+            else:
+                o = paged_spec_attention_xla(
+                    qg, k_cache, v_cache, layer_idx, block_tables, lengths,
+                    k_scale, v_scale,
+                )
             o = o.reshape(B, T, cfg.q_size)
             x = x + _dot_q(o, lp, "wo")
 
             h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             x = x + _ffn(h, lp, cfg)
-            return (x, k_cache, v_cache), None
+            return (x, k_cache, v_cache, k_scale, v_scale), None
 
         layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
-        (x, k_cache, v_cache), _ = lax.scan(
-            layer, (x, cache.k, cache.v), (params["layers"], layer_ids)
+        (x, k_cache, v_cache, k_scale, v_scale), _ = lax.scan(
+            layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
+            (params["layers"], layer_ids),
         )
         logits = _logits(cfg, params, x)  # [B, T, V] fp32
-        cache = KVCache(k_cache, v_cache)
+        cache = KVCache(k_cache, v_cache, k_scale, v_scale)
     else:
         def substep(c, xs):
             tok_j, pos_j, use_j = xs

@@ -162,7 +162,7 @@ class LocalRunner:
             self.params = M.init_params(self.cfg, key, jnp.dtype(self.args.dtype))
         self.cache = M.init_kv_cache(
             self.cfg, self.args.num_kv_blocks, self.args.block_size,
-            jnp.dtype(self.args.dtype),
+            jnp.dtype(self.args.dtype), kv_quant=self.args.kv_quant,
         )
         if self.sharding is None and self.args.tp > 1:
             from dynamo_tpu.parallel.mesh import ModelSharding, build_mesh
@@ -170,6 +170,9 @@ class LocalRunner:
             self.sharding = ModelSharding(build_mesh(tp=self.args.tp, cfg=self.cfg), self.cfg)
         if self.sharding is not None:
             self.params = self.sharding.shard_params(self.params)
+            # Scale arrays shard over the same kv-head axis as the cache
+            # lanes, so the (mesh-forced) XLA attention paths dequantize
+            # with co-sharded scales — int8 KV composes with tp.
             self.cache = M.KVCache(*self.sharding.shard_cache(self.cache))
         elif isinstance(jax.tree.leaves(self.params)[0], np.ndarray):
             self.params = jax.tree.map(jnp.asarray, self.params)
@@ -333,12 +336,15 @@ class LocalRunner:
         emb = M.embed(self.cfg, self.params, jnp.asarray(toks), jnp.int32(tlen))
         return self._new_ref((emb,), rid)
 
-    def extract_pages(self, block_ids: list[int]):
-        pk, pv = kv_transfer.extract_pages(self.cache, block_ids, replicate=self.sharding)
-        return pk, pv
+    def extract_pages(self, block_ids: list[int]) -> tuple:
+        """→ (k, v) page arrays, plus (k_scale, v_scale) under int8 KV."""
+        return kv_transfer.extract_pages(
+            self.cache, block_ids, replicate=self.sharding
+        )
 
-    def inject_pages(self, block_ids: list[int], pk, pv) -> None:
-        self.cache = kv_transfer.inject_pages(self.cache, block_ids, pk, pv)
+    def inject_pages(self, block_ids: list[int], *pages) -> None:
+        pages = kv_transfer.adapt_pages(pages, self.cache, self.cfg.num_kv_heads)
+        self.cache = kv_transfer.inject_pages(self.cache, block_ids, *pages)
 
     def clear_cache_refs(self) -> None:
         """Drop chain/sample refs (admin /clear_kv_blocks support)."""
@@ -501,12 +507,15 @@ class LeaderRunner(LocalRunner):
         self._cast({"op": "extract_pages", "ids": list(map(int, block_ids))})
         return super().extract_pages(block_ids)
 
-    def inject_pages(self, block_ids: list[int], pk, pv) -> None:
+    def inject_pages(self, block_ids: list[int], *pages) -> None:
+        def pack(a):
+            a = np.asarray(a)
+            return _pack_np(a.view(np.uint16) if str(a.dtype) == "bfloat16" else a)
+
         self._cast({"op": "inject_pages", "ids": list(map(int, block_ids)),
-                    "pk": _pack_np(np.asarray(pk).view(np.uint16) if str(np.asarray(pk).dtype) == "bfloat16" else np.asarray(pk)),
-                    "pv": _pack_np(np.asarray(pv).view(np.uint16) if str(np.asarray(pv).dtype) == "bfloat16" else np.asarray(pv)),
-                    "bf16": str(np.asarray(pk).dtype) == "bfloat16"})
-        super().inject_pages(block_ids, pk, pv)
+                    "pages": [pack(p) for p in pages],
+                    "bf16": str(np.asarray(pages[0]).dtype) == "bfloat16"})
+        super().inject_pages(block_ids, *pages)
 
 
 def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0,
@@ -597,9 +606,12 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
         elif op == "extract_pages":
             runner.extract_pages(desc["ids"])
         elif op == "inject_pages":
-            pk, pv = _unpack_np(desc["pk"]), _unpack_np(desc["pv"])
+            pages = [_unpack_np(d) for d in desc["pages"]]
             if desc["bf16"]:
-                pk, pv = pk.view(ml_dtypes.bfloat16), pv.view(ml_dtypes.bfloat16)
-            runner.inject_pages(desc["ids"], pk, pv)
+                # Only the k/v pages travel as uint16 views; scale
+                # sidecars (if present) are fp32 and pass through.
+                pages[0] = pages[0].view(ml_dtypes.bfloat16)
+                pages[1] = pages[1].view(ml_dtypes.bfloat16)
+            runner.inject_pages(desc["ids"], *pages)
         else:
             raise RuntimeError(f"unknown dispatch op {op!r}")

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from typing import Any, AsyncIterator
 
-from dynamo_tpu.engine.kv_transfer import KvPagePayload
+from dynamo_tpu.engine.kv_transfer import KvPagePayload, concat_page_run
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.tokens import compute_block_hashes
@@ -53,13 +53,18 @@ def make_kv_prefix_handler(engine, frame_bytes: int = KvPagePayload.DEFAULT_FRAM
         if not run:
             yield {"error": "prefix not resident"}
             return
-        import numpy as np
-
         bs = engine.args.block_size
-        pk = np.concatenate([k for k, _ in run], axis=1)
-        pv = np.concatenate([v for _, v in run], axis=1)
-        for frame in KvPagePayload(
-            k=pk, v=pv, num_tokens=len(run) * bs
+        # Normalize to this worker's storage format before shipping —
+        # a run can mix arities when a persistent disk dir predates the
+        # current kv_quant setting; int8 scales ride the same stream.
+        pages = concat_page_run(
+            run,
+            quantized=engine.args.kv_quant == "int8",
+            num_kv_heads=engine.args.model.num_kv_heads,
+            dtype=engine.args.dtype,
+        )
+        for frame in KvPagePayload.from_pages(
+            pages, len(run) * bs
         ).to_frames(frame_bytes):
             yield frame
 

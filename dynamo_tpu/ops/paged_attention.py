@@ -72,6 +72,31 @@ def resolve_attn_impl(requested: str = "auto") -> str:
 # ---------------------------------------------------------------------------
 
 
+def gather_dequant_pages(
+    layer_cache: jax.Array,   # [N, bs, KVH*hd] — one layer's pages
+    layer_scale: jax.Array | None,  # [N, bs, KVH] fp32 | None
+    block_tables: jax.Array,  # [B, W] int32
+    KVH: int, hd: int, dtype,
+):
+    """Gather a batch's pages out of the pool and (for int8 storage)
+    dequantize with the per-position-per-head scales → [B, W*bs, KVH, hd]
+    in ``dtype``. The int8→float convert rides the gather output, so the
+    materialized copy stays half the bf16 path's bytes on the read side
+    (the write side — the gather itself — is what the Pallas kernels
+    remove entirely)."""
+    B, W = block_tables.shape
+    bs = layer_cache.shape[1]
+    pages = layer_cache[block_tables].reshape(B, W * bs, KVH, hd)
+    if layer_scale is None:
+        return pages
+    sc = layer_scale[block_tables].reshape(B, W * bs, KVH)
+    # Dequantize in f32 and round ONCE into ``dtype`` — multiplying in
+    # bf16 would read the same stored byte back as a different value
+    # than the Pallas kernel / host adapters (which also widen to f32),
+    # breaking cross-path consistency for the same block.
+    return (pages.astype(jnp.float32) * sc[..., None]).astype(dtype)
+
+
 def paged_decode_attention_xla(
     q: jax.Array,            # [B, KVH, G, hd]
     k_cache: jax.Array,      # [L, N, bs, KVH*hd]
@@ -79,18 +104,24 @@ def paged_decode_attention_xla(
     layer_idx: jax.Array,    # scalar int32
     block_tables: jax.Array, # [B, W] int32
     lengths: jax.Array,      # [B] int32 — attend positions [0, length)
+    k_scale: jax.Array | None = None,  # [L, N, bs, KVH] fp32 — int8 cache only
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
-    """Gather-based formulation (the r3 path, hoisted here).  Returns
-    [B, KVH, G, hd] in q.dtype."""
+    """Gather-based formulation (the r3 path, hoisted here).  With
+    ``k_scale``/``v_scale`` the cache holds int8 pages and the gather
+    dequantizes in the same fused expression.  Returns [B, KVH, G, hd]
+    in q.dtype."""
     B, KVH, G, hd = q.shape
-    W = block_tables.shape[1]
-    bs = k_cache.shape[2]
     layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
     layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
-    pk = layer_k[block_tables].reshape(B, W * bs, KVH, hd)
-    pv = layer_v[block_tables].reshape(B, W * bs, KVH, hd)
+    sk = sv = None
+    if k_scale is not None:
+        sk = lax.dynamic_index_in_dim(k_scale, layer_idx, 0, keepdims=False)
+        sv = lax.dynamic_index_in_dim(v_scale, layer_idx, 0, keepdims=False)
+    pk = gather_dequant_pages(layer_k, sk, block_tables, KVH, hd, q.dtype)
+    pv = gather_dequant_pages(layer_v, sv, block_tables, KVH, hd, q.dtype)
     scale = hd ** -0.5
-    ctx = jnp.arange(W * bs, dtype=jnp.int32)
+    ctx = jnp.arange(pk.shape[1], dtype=jnp.int32)
     mask = jnp.where(ctx[None, :] < lengths[:, None], 0.0, jnp.float32(NEG_INF))
     s = jnp.einsum("bkgh,bckh->bkgc", q, pk).astype(jnp.float32) * scale
     s = s + mask[:, None, None, :]
@@ -105,6 +136,8 @@ def paged_spec_attention_xla(
     layer_idx: jax.Array,    # scalar int32
     block_tables: jax.Array, # [B, W] int32
     lengths: jax.Array,      # [B, T] int32 — query t attends [0, lengths[b, t])
+    k_scale: jax.Array | None = None,  # [L, N, bs, KVH] fp32 — int8 cache only
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Multi-query generalization of ``paged_decode_attention_xla`` for
     the speculative verify pass: T consecutive positions per row attend
@@ -113,17 +146,21 @@ def paged_spec_attention_xla(
     verify step score draft_len+1 logit rows in one weight stream).
     T=1 reduces exactly to the decode formulation, so CPU/XLA greedy
     byte-identity between the spec and dense paths holds by construction.
-    Returns [B, T, KVH, G, hd] in q.dtype. (A Pallas multi-query kernel
-    is the TPU upgrade path, same seam as the decode kernel.)"""
+    With scales the gathered pages dequantize in the same expression.
+    Returns [B, T, KVH, G, hd] in q.dtype. (``paged_spec_attention`` is
+    the Pallas upgrade: the gather+dequant happen in-register, no
+    materialized relayout copy.)"""
     B, T, KVH, G, hd = q.shape
-    W = block_tables.shape[1]
-    bs = k_cache.shape[2]
     layer_k = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, keepdims=False)
     layer_v = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, keepdims=False)
-    pk = layer_k[block_tables].reshape(B, W * bs, KVH, hd)
-    pv = layer_v[block_tables].reshape(B, W * bs, KVH, hd)
+    sk = sv = None
+    if k_scale is not None:
+        sk = lax.dynamic_index_in_dim(k_scale, layer_idx, 0, keepdims=False)
+        sv = lax.dynamic_index_in_dim(v_scale, layer_idx, 0, keepdims=False)
+    pk = gather_dequant_pages(layer_k, sk, block_tables, KVH, hd, q.dtype)
+    pv = gather_dequant_pages(layer_v, sv, block_tables, KVH, hd, q.dtype)
     scale = hd ** -0.5
-    ctx = jnp.arange(W * bs, dtype=jnp.int32)
+    ctx = jnp.arange(pk.shape[1], dtype=jnp.int32)
     mask = jnp.where(
         ctx[None, None, :] < lengths[:, :, None], 0.0, jnp.float32(NEG_INF)
     )                                                       # [B, T, W*bs]
@@ -134,33 +171,49 @@ def paged_spec_attention_xla(
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel
+# Pallas TPU kernel — ONE multi-query kernel for both consumers.
+#
+# Decode is the T=1 case; the speculative verify pass runs T = S+1 query
+# positions per row through the SAME kernel (the "fused gather": the
+# [last, d1..dS] rows attend straight out of the page pool — no
+# materialized `layer_k[block_tables]` relayout copy, which costs
+# ~9ms/layer at 8B geometry, the header's XLA gather tax). With int8
+# cache storage the per-page DMAs move HALF the bytes and the dequant
+# happens in-register right after the page lands in VMEM, using
+# per-position-per-head scales prefetched per row block.
 # ---------------------------------------------------------------------------
 
 
-def _decode_kernel(
+def _mq_kernel(
     # scalar prefetch
     layer_ref,    # [1] int32
-    lengths_ref,  # [B] int32
+    rowlen_ref,   # [B] int32 — max attend length per row (chunk walk bound)
     tables_ref,   # [B, W] int32
-    # operands
-    qbd_ref,      # VMEM [1, KVH*hd, KVH*G] — block-diag q, scale folded in
-    k_hbm,        # ANY  [L, N, bs, KVH*hd] (bitcast view of the cache)
-    v_hbm,
-    # outputs
-    o_ref,        # VMEM [1, KVH*hd, KVH*G] — attention out, transposed
-    # scratch
-    kbuf,         # VMEM [2, P, bs, KVH*hd]
-    vbuf,
-    m_scr,        # VMEM [8, 128] f32 — row 0, first KVH*G lanes live
-    l_scr,        # VMEM [8, 128] f32
-    acc_scr,      # VMEM [KVH*hd, KVH*G] f32
-    slot_ref,     # SMEM [1] int32 — DMA double-buffer cursor
-    started_ref,  # SMEM [1] int32 — global warmup flag
-    sem,          # DMA sems [2, 2, P]
-    *,
+    # operands (kscale/vscale present only when quantized)
+    *refs,
+    # static
     pages_per_chunk: int,
+    head_dim: int,
+    quantized: bool,
 ):
+    if quantized:
+        (qbd_ref, lenvec_ref, kscale_ref, vscale_ref, k_hbm, v_hbm,
+         o_ref, kbuf, vbuf, m_scr, l_scr, acc_scr, slot_ref, started_ref,
+         sem) = refs
+    else:
+        (qbd_ref, lenvec_ref, k_hbm, v_hbm,
+         o_ref, kbuf, vbuf, m_scr, l_scr, acc_scr, slot_ref, started_ref,
+         sem) = refs
+        kscale_ref = vscale_ref = None
+    # qbd_ref    VMEM [1, KVH*hd, H] — block-diag q, softmax scale folded in
+    # lenvec_ref VMEM [1, H] int32 — per query COLUMN attend length
+    # kscale_ref VMEM [1, W, bs, KVH] f32 — per-position-per-head scales
+    # k_hbm      ANY  [L, N, bs, KVH*hd]
+    # o_ref      VMEM [1, KVH*hd, H] — attention out, transposed
+    # kbuf/vbuf  VMEM [2, P, bs, KVH*hd] (cache dtype; int8 when quantized)
+    # m/l        VMEM [8, 128] f32 — row 0, first H lanes live
+    # acc        VMEM [KVH*hd, H] f32
+    # slot/started SMEM [1] int32; sem DMA sems [2, 2, P]
     P = pages_per_chunk
     b = pl.program_id(0)
     c = pl.program_id(1)
@@ -168,10 +221,12 @@ def _decode_kernel(
     layer = layer_ref[0]
     bs = kbuf.shape[2]
     D = kbuf.shape[3]       # KVH*hd
-    H = qbd_ref.shape[2]    # KVH*G (total query heads)
+    H = qbd_ref.shape[2]    # KVH*T*G (total query columns)
+    hd = head_dim
+    KVH = D // hd
     CH = P * bs             # tokens per chunk
 
-    length = lengths_ref[b]
+    length = rowlen_ref[b]
     nchunks = lax.div(length + CH - 1, CH)
     live = c < nchunks
 
@@ -183,7 +238,7 @@ def _decode_kernel(
     def chunk_dmas(row, chunk, slot):
         """DMA descriptors for (row, chunk) into buffer `slot`; page p is
         guarded by the row's true page count."""
-        rem = lengths_ref[row] - chunk * CH
+        rem = rowlen_ref[row] - chunk * CH
         npages = jnp.minimum(lax.div(rem + bs - 1, bs), P)
         out = []
         for p in range(P):
@@ -224,11 +279,15 @@ def _decode_kernel(
 
         @pl.when(~row_continues)
         def _():
-            nxt_row = lax.while_loop(
-                lambda r: (r < B) & (lengths_ref[jnp.minimum(r, B - 1)] == 0),
-                lambda r: r + 1,
-                b + 1,
-            )
+            # First non-empty row after b (B if none). A fori_loop, not a
+            # while_loop: the scan is O(B) scalar work either way, and a
+            # while cond that reads a ref has no interpret-mode discharge
+            # rule — this form keeps the kernel CPU-interpret-testable.
+            def scan_row(r, best):
+                cand = (r > b) & (rowlen_ref[r] > 0) & (r < best)
+                return jnp.where(cand, r, best)
+
+            nxt_row = lax.fori_loop(0, B, scan_row, B)
 
             @pl.when(nxt_row < B)
             def _():
@@ -255,6 +314,19 @@ def _decode_kernel(
 
         k_chunk = kbuf[cur].reshape(P * bs, D)
         v_chunk = vbuf[cur].reshape(P * bs, D)
+        if quantized:
+            # In-register dequant of the just-landed int8 pages: expand
+            # this chunk's [P, bs, KVH] scales across the head lanes and
+            # multiply — the DMA moved half the bytes, the float page
+            # never exists outside VMEM.
+            ksc = jnp.broadcast_to(
+                kscale_ref[0, pl.ds(c * P, P)][..., None], (P, bs, KVH, hd)
+            ).reshape(P * bs, D)
+            vsc = jnp.broadcast_to(
+                vscale_ref[0, pl.ds(c * P, P)][..., None], (P, bs, KVH, hd)
+            ).reshape(P * bs, D)
+            k_chunk = (k_chunk.astype(jnp.float32) * ksc).astype(qbd_ref.dtype)
+            v_chunk = (v_chunk.astype(jnp.float32) * vsc).astype(qbd_ref.dtype)
         # Unfetched tail pages hold garbage (possibly NaN): k is
         # neutralized by the score mask, v must be zeroed (0*NaN=NaN).
         v_chunk = jnp.where(valid, v_chunk, 0)
@@ -264,7 +336,10 @@ def _decode_kernel(
             k_chunk, qbd_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                                  # [P*bs, H]
-        s = jnp.where(valid, s, NEG_INF)
+        # Per-COLUMN causal horizon: column (k, t, g) attends positions
+        # [0, lengths[b, t]) — for decode (T=1) every column carries the
+        # row length and this is exactly the old row mask.
+        s = jnp.where(pos < lenvec_ref[0:1, :], s, NEG_INF)
 
         m_prev = m_scr[0:1, :H]                            # [1, H]
         l_prev = l_scr[0:1, :H]
@@ -296,29 +371,31 @@ def _decode_kernel(
         o_ref[0] = jnp.zeros_like(o_ref[0])
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("pages_per_chunk", "interpret"),
-)
-def paged_decode_attention(
-    q: jax.Array,            # [B, KVH, G, hd]
+def _paged_attention_mq(
+    q: jax.Array,            # [B, T, KVH, G, hd]
     k_cache: jax.Array,      # [L, N, bs, KVH*hd] — dense pages, no
     v_cache: jax.Array,      #   per-call layout conversion
     layer_idx: jax.Array,    # scalar int32
     block_tables: jax.Array, # [B, W] int32
-    lengths: jax.Array,      # [B] int32
-    *,
-    pages_per_chunk: int = 0,  # 0 → auto (~512 tokens per chunk)
-    interpret: bool = False,
+    lengths: jax.Array,      # [B, T] int32
+    k_scale: jax.Array | None,  # [L, N, bs, KVH] fp32 | None
+    v_scale: jax.Array | None,
+    pages_per_chunk: int,
+    interpret: bool,
 ) -> jax.Array:
-    B, KVH, G, hd = q.shape
-    L, N, bs = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
+    """Shared Pallas driver: T query positions per row walk the row's
+    true pages once. Returns [B, T, KVH, G, hd] in q.dtype."""
+    B, T, KVH, G, hd = q.shape
+    bs = k_cache.shape[2]
     assert k_cache.shape[3] == KVH * hd, "cache must be [L, N, bs, KVH*hd]"
     W = block_tables.shape[1]
-    if KVH * G > 128:
+    H = KVH * T * G
+    if H > 128:
         raise NotImplementedError(
-            f"{KVH * G} query heads > 128 lanes; shard heads (tp) first"
+            f"{H} query columns (KVH*T*G) > 128 lanes; shard heads (tp) "
+            f"or fall back to the XLA gather path"
         )
+    quantized = k_scale is not None
     P = pages_per_chunk or max(1, 512 // bs)
     P = min(P, W)
     if W % P:  # pad the table so chunks tile it exactly
@@ -328,27 +405,53 @@ def paged_decode_attention(
     chunks_max = W // P
 
     # Block-diagonal q with the softmax scale folded in:
-    # qbd[b, j*hd+h, k*G+g] = q[b,k,g,h] * scale * (j==k).
+    # qbd[b, j*hd+h, k*(T*G)+t*G+g] = q[b,t,k,g,h] * scale * (j==k).
     eye = jnp.eye(KVH, dtype=q.dtype)
-    qbd = jnp.einsum("bkgh,jk->bjhkg", q * (hd ** -0.5), eye)
-    qbd = qbd.reshape(B, KVH * hd, KVH * G)
+    qbd = jnp.einsum("btkgh,jk->bjhktg", q * (hd ** -0.5), eye)
+    qbd = qbd.reshape(B, KVH * hd, H)
+    # Per-column attend horizon, same (k, t, g) column order as qbd.
+    lengths = jnp.asarray(lengths, jnp.int32)
+    lenvec = jnp.broadcast_to(
+        lengths[:, None, :, None], (B, KVH, T, G)
+    ).reshape(B, H)
+    rowlen = jnp.max(lengths, axis=1)  # chunk-walk bound per row
 
-    kernel = functools.partial(_decode_kernel, pages_per_chunk=P)
+    operands = [qbd, lenvec]
+    in_specs = [
+        pl.BlockSpec((1, KVH * hd, H), lambda b, c, *_: (b, 0, 0)),
+        pl.BlockSpec((1, H), lambda b, c, *_: (b, 0)),
+    ]
+    if quantized:
+        # Scales ride as per-row VMEM blocks gathered OUTSIDE the kernel:
+        # [B, W, bs, KVH] fp32 is 1/head_dim the page bytes, so the XLA
+        # gather here is noise next to the page traffic the kernel saves.
+        sk = lax.dynamic_index_in_dim(k_scale, layer_idx, 0, keepdims=False)
+        sv = lax.dynamic_index_in_dim(v_scale, layer_idx, 0, keepdims=False)
+        operands += [sk[block_tables], sv[block_tables]]
+        in_specs += [
+            pl.BlockSpec((1, W, bs, KVH), lambda b, c, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, W, bs, KVH), lambda b, c, *_: (b, 0, 0, 0)),
+        ]
+    operands += [k_cache, v_cache]
+    in_specs += [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+
+    kernel = functools.partial(
+        _mq_kernel, pages_per_chunk=P, head_dim=hd, quantized=quantized
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, chunks_max),
-        in_specs=[
-            pl.BlockSpec((1, KVH * hd, KVH * G), lambda b, c, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, KVH * hd, KVH * G), lambda b, c, *_: (b, 0, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, KVH * hd, H), lambda b, c, *_: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, P, bs, KVH * hd), k_cache.dtype),
             pltpu.VMEM((2, P, bs, KVH * hd), v_cache.dtype),
             pltpu.VMEM((8, 128), jnp.float32),
             pltpu.VMEM((8, 128), jnp.float32),
-            pltpu.VMEM((KVH * hd, KVH * G), jnp.float32),
+            pltpu.VMEM((KVH * hd, H), jnp.float32),
             pltpu.SMEM((1,), jnp.int32),
             pltpu.SMEM((1,), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 2, P)),
@@ -357,16 +460,74 @@ def paged_decode_attention(
     o_t = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KVH * hd, KVH * G), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KVH * hd, H), q.dtype),
         interpret=interpret,
     )(
         jnp.asarray(layer_idx, jnp.int32).reshape(1),
-        jnp.asarray(lengths, jnp.int32),
+        rowlen,
         jnp.asarray(block_tables, jnp.int32),
-        qbd,
-        k_cache,
-        v_cache,
+        *operands,
     )
-    # [B, KVH*hd, KVH*G] → per-head diagonal → [B, KVH, G, hd].
-    o5 = o_t.reshape(B, KVH, hd, KVH, G)
-    return jnp.einsum("bkhkg->bkgh", o5)
+    # [B, KVH*hd, KVH*T*G] → per-head diagonal → [B, T, KVH, G, hd].
+    o6 = o_t.reshape(B, KVH, hd, KVH, T, G)
+    return jnp.einsum("bkhktg->btkgh", o6)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pages_per_chunk", "interpret"),
+)
+def paged_decode_attention(
+    q: jax.Array,            # [B, KVH, G, hd]
+    k_cache: jax.Array,      # [L, N, bs, KVH*hd]
+    v_cache: jax.Array,
+    layer_idx: jax.Array,    # scalar int32
+    block_tables: jax.Array, # [B, W] int32
+    lengths: jax.Array,      # [B] int32
+    k_scale: jax.Array | None = None,  # [L, N, bs, KVH] fp32 — int8 cache only
+    v_scale: jax.Array | None = None,
+    *,
+    pages_per_chunk: int = 0,  # 0 → auto (~512 tokens per chunk)
+    interpret: bool = False,
+) -> jax.Array:
+    B, KVH, G, hd = q.shape
+    if KVH * G > 128:
+        raise NotImplementedError(
+            f"{KVH * G} query heads > 128 lanes; shard heads (tp) first"
+        )
+    o = _paged_attention_mq(
+        q[:, None], k_cache, v_cache, layer_idx, block_tables,
+        jnp.asarray(lengths, jnp.int32)[:, None], k_scale, v_scale,
+        pages_per_chunk, interpret,
+    )
+    return o[:, 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("pages_per_chunk", "interpret"),
+)
+def paged_spec_attention(
+    q: jax.Array,            # [B, T, KVH, G, hd]
+    k_cache: jax.Array,      # [L, N, bs, KVH*hd]
+    v_cache: jax.Array,
+    layer_idx: jax.Array,    # scalar int32
+    block_tables: jax.Array, # [B, W] int32
+    lengths: jax.Array,      # [B, T] int32
+    k_scale: jax.Array | None = None,  # [L, N, bs, KVH] fp32 — int8 cache only
+    v_scale: jax.Array | None = None,
+    *,
+    pages_per_chunk: int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused spec-verify gather: the [last, d1..dS] multi-query rows
+    attend straight out of the page pool in ONE kernel — per-page DMAs,
+    in-register dequant when the cache is int8, online softmax — instead
+    of the XLA path's materialized (dequantized) relayout copy of the
+    whole gathered table (the ~9ms/layer tax in the module header).
+    Requires KVH*T*G ≤ 128 lanes; callers fall back to
+    ``paged_spec_attention_xla`` beyond that (model.spec_verify does)."""
+    return _paged_attention_mq(
+        q, k_cache, v_cache, layer_idx, block_tables, lengths,
+        k_scale, v_scale, pages_per_chunk, interpret,
+    )

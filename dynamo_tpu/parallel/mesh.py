@@ -187,6 +187,17 @@ class ModelSharding:
             params = jax.tree.map(np.asarray, params)
         return jax.device_put(params, self.param_shardings(params))
 
-    def shard_cache(self, cache) -> tuple[jax.Array, jax.Array]:
+    def shard_cache(self, cache) -> tuple:
+        """→ the cache's arrays, sharded, in KVCache field order. int8
+        caches carry [L, N, bs, KVH] scale arrays whose last axis is the
+        kv-head axis — the same tp_kv split as the merged page lanes, so
+        each shard dequantizes its own heads locally."""
         ns = self._ns(*self.cache_spec())
-        return jax.device_put(cache.k, ns), jax.device_put(cache.v, ns)
+        out = [jax.device_put(cache.k, ns), jax.device_put(cache.v, ns)]
+        k_scale = getattr(cache, "k_scale", None)
+        if k_scale is not None:
+            out += [
+                jax.device_put(k_scale, ns),
+                jax.device_put(cache.v_scale, ns),
+            ]
+        return tuple(out)

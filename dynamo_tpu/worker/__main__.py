@@ -91,6 +91,10 @@ def parse_args(argv=None):
                    default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
                    help="weight format (int8 = weight-only quantization, engine/quant.py)")
+    p.add_argument("--kv-quant", choices=["none", "int8"], default="none",
+                   help="paged KV cache storage (int8 = quantized pages + "
+                        "per-position-per-head scales; ~2x num_kv_blocks in "
+                        "the same HBM, half the tier/transfer bytes)")
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="G2 host-RAM KV tier capacity in blocks (0 = off)")
     p.add_argument("--disk-kv-dir", default=None, help="G3 disk KV tier directory")
@@ -403,6 +407,7 @@ def _engine_args(args, model):
         spec_fused=not args.spec_stepwise,
         attn_impl=args.attn_impl,
         quant=args.quant,
+        kv_quant=args.kv_quant,
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_dir=args.disk_kv_dir,
         disk_kv_blocks=args.disk_kv_blocks,

@@ -87,6 +87,9 @@ async def engine_hotloop(
     spec_gate: float | None = None,
     spec_fused: bool = True,
     repetitive: bool = False,
+    kv_quant: str = "none",
+    max_num_seqs: int = 8,
+    num_kv_blocks: int = 256,
 ) -> dict:
     """Drive the real TpuEngine scheduler through a small concurrent
     workload → {tokens (per-request streams), host_blocked_frac,
@@ -102,13 +105,14 @@ async def engine_hotloop(
     cfg = ModelConfig.preset(model)
     kw = {} if spec_gate is None else {"spec_gate": spec_gate}
     eargs = EngineArgs(
-        model=cfg, block_size=4, num_kv_blocks=256, max_num_seqs=8,
+        model=cfg, block_size=4, num_kv_blocks=num_kv_blocks,
+        max_num_seqs=max_num_seqs,
         max_model_len=256, max_prefill_tokens=128,
         dtype="float32" if cfg.name == "test-tiny" else "bfloat16",
         decode_steps=decode_steps,
         pipeline_depth=pipeline_depth, pipeline_windows=pipeline_depth > 0,
         spec_tokens=spec_tokens, spec_ngram=spec_ngram,
-        spec_fused=spec_fused, **kw,
+        spec_fused=spec_fused, kv_quant=kv_quant, **kw,
     )
     engine = await TpuEngine(eargs, seed=0).start()
     try:
@@ -150,6 +154,9 @@ async def engine_hotloop(
         )
         out = {
             "pipeline_depth": pipeline_depth,
+            "kv_quant": kv_quant,
+            "max_num_seqs": max_num_seqs,
+            "kv_pool_bytes": eargs.kv_bytes_per_block() * eargs.num_kv_blocks,
             "tokens": streams,
             "total_tokens": sum(len(s) for s in streams),
             "decode_tok_s": round(sum(len(s) for s in streams) / elapsed, 1),
@@ -186,6 +193,41 @@ async def engine_hotloop(
 # token-accounting assertion so retuning one can't silently break the other.
 QUICK_SPEC_REQUESTS = 6
 QUICK_SPEC_GEN = 24
+
+
+def run_kv_quant_sweep(*, quick: bool = False, pipeline_depth: int = 2,
+                       decode_steps: int = 4) -> dict:
+    """``--kv-quant`` probe: none vs int8 KV storage on the real
+    scheduler — int8 at the MATCHED batch (isolates the dequant cost on
+    this backend) and at the ~2x batch the same HBM budget now fits
+    (the capacity→throughput win in the bandwidth-bound regime). Each
+    row reports tok/s and the pool's HBM footprint; the f32 row and the
+    2x row hold the SAME kv_pool byte budget by construction."""
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+
+    gen_len = 16 if quick else 64
+    n_requests = 6 if quick else 8
+    base_blocks = 256
+    # Blocks the f32 pool's byte budget buys under int8 storage.
+    probe = lambda kvq: EngineArgs(
+        model=ModelConfig(), block_size=4, dtype="float32", kv_quant=kvq
+    ).kv_bytes_per_block()  # f32 on CPU, same dtype engine_hotloop runs
+    int8_blocks = base_blocks * probe("none") // probe("int8")
+    runs = [
+        ("none", 8, n_requests, base_blocks),
+        ("int8", 8, n_requests, base_blocks),
+        ("int8_2x", 16, 2 * n_requests, int8_blocks),
+    ]
+    out = {}
+    for label, seqs, reqs, blocks in runs:
+        kvq = "int8" if label.startswith("int8") else "none"
+        r = asyncio.run(engine_hotloop(
+            pipeline_depth, decode_steps=decode_steps,
+            n_requests=reqs, gen_len=gen_len,
+            kv_quant=kvq, max_num_seqs=seqs, num_kv_blocks=blocks,
+        ))
+        out[label] = r
+    return out
 
 
 def run_spec_sweep(*, quick: bool = False, pipeline_depth: int = 2,
@@ -243,6 +285,27 @@ def run_quick() -> int:
     assert any(r.get("spec_rows", 0) > 0 for r in spec.values()), (
         "spec sweep never dispatched a verify pass — the probe has rotted"
     )
+    # int8-KV sweep: every configuration keeps full token accounting
+    # (quantization must never lose or duplicate tokens), the 2x-batch
+    # pool fits in the f32 pool's byte budget, and the capacity math
+    # yields >= 1.9x blocks at the 8B serving geometry.
+    kvq = run_kv_quant_sweep(quick=True)
+    for label, r in kvq.items():
+        want = r["max_num_seqs"] // 8 * 6 * 16
+        assert r["total_tokens"] == want, (
+            f"kv_quant {label}: lost tokens — {r['total_tokens']} != {want}"
+        )
+    assert kvq["int8_2x"]["kv_pool_bytes"] <= kvq["none"]["kv_pool_bytes"], (
+        "int8 2x-batch pool exceeds the f32 byte budget"
+    )
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+
+    cap = lambda q: EngineArgs.auto_kv_blocks(
+        8 << 30,
+        EngineArgs(model=ModelConfig.preset("llama-8b"), kv_quant=q),
+    )
+    ratio = cap("int8") / cap("none")
+    assert ratio >= 1.9, f"int8 KV capacity ratio {ratio:.2f} < 1.9x"
     out = {
         d: {k: v for k, v in r.items() if k != "tokens"}
         for d, r in results.items()
@@ -251,7 +314,12 @@ def run_quick() -> int:
         S: {k: v for k, v in r.items() if k != "tokens"}
         for S, r in spec.items()
     }
-    print(json.dumps({"hotloop": out, "spec": spec_out}))
+    kvq_out = {
+        kq: {k: v for k, v in r.items() if k != "tokens"}
+        for kq, r in kvq.items()
+    }
+    print(json.dumps({"hotloop": out, "spec": spec_out,
+                      "kv_quant": kvq_out, "kv_capacity_ratio_8b": round(ratio, 3)}))
     print("QUICK-OK")
     return 0
 
@@ -272,6 +340,10 @@ def main():
                    help="sweep speculative draft length S in {0,2,4,8} on the "
                         "real scheduler (repetitive workload): acceptance, "
                         "tok/s, host overhead per S")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="sweep KV storage none vs int8 (matched batch and the "
+                        "2x batch the same HBM budget fits): tok/s + pool "
+                        "footprint per configuration")
     p.add_argument("--pipeline-depth", type=int, default=2)
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke: CPU tiny shapes + depth-0/2 golden hot-loop probe")
@@ -302,6 +374,14 @@ def main():
         for S, r in sweep.items():
             r.pop("tokens")
             print(json.dumps({"spec_tokens": S, **r}))
+        return 0
+    if args.kv_quant:
+        sweep = run_kv_quant_sweep(
+            pipeline_depth=args.pipeline_depth, decode_steps=args.decode_steps,
+        )
+        for label, r in sweep.items():
+            r.pop("tokens")
+            print(json.dumps({"config": label, **r}))
         return 0
 
     from dynamo_tpu.engine import model as M
@@ -373,7 +453,7 @@ def main():
 
     # -- ablation: attention only (gather + attend + cache write) -----------
     def attn_only_step(c, tok, pos):  # no params needed
-        k_cache, v_cache = c
+        k_cache, v_cache = c.k, c.v
         blk = tables[jnp.arange(B), pos // bs]
         off = pos % bs
         G = cfg.num_heads // cfg.num_kv_heads
