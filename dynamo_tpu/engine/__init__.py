@@ -15,6 +15,17 @@ is built in-repo, TPU-first:
 """
 
 from dynamo_tpu.engine.config import EngineArgs, ModelConfig
-from dynamo_tpu.engine.engine import TpuEngine
 
 __all__ = ["EngineArgs", "ModelConfig", "TpuEngine"]
+
+
+def __getattr__(name: str):
+    # Deferred (PEP 562): engine/engine.py imports transfer.stream, and
+    # transfer.stream imports engine.kv_transfer — an eager TpuEngine
+    # import here closes that loop and makes `import dynamo_tpu.transfer`
+    # (or llm.disagg) fail unless the engine was imported first.
+    if name == "TpuEngine":
+        from dynamo_tpu.engine.engine import TpuEngine
+
+        return TpuEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -293,6 +293,12 @@ class EngineArgs:
     # only the one-dispatch/one-fetch saving. Parity/debug mode and the
     # golden suite's byte-identity anchor.
     spec_fused: bool = True
+    # Streaming KV export flow control (dynamo_tpu/transfer): max host
+    # bytes of published-but-unacked chunks one export may buffer. A
+    # consumer that stops pulling aborts the stream at this budget (the
+    # decode side falls back to local prefill) instead of growing the
+    # prefill worker's heap without bound.
+    transfer_buffer_bytes: int = 256 << 20
     # Batch-level dispatch gate: speculate only when the EMA-weighted
     # expected tokens per row-pass, mean(1 + ema_i * draft_len_i),
     # clears this threshold. Protects mixed batches (a few drafting rows

@@ -54,6 +54,7 @@ from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
+from dynamo_tpu.transfer.stream import KvChunk, KvStreamExport
 
 log = get_logger("engine")
 
@@ -72,6 +73,7 @@ class _Seq:
         "kv_written", "export", "export_meta", "inject", "dead",
         "slot", "first_pend", "t_admit",
         "spec_ema", "spec_cool", "draft_state",
+        "export_handle", "export_stream", "export_pub_blocks",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -124,6 +126,13 @@ class _Seq:
         self.export = bool(ktp.get("do_remote_decode"))  # prefill-only + export KV
         self.export_meta: dict | None = None             # filled at prefill time
         self.inject = ktp.get("inject")                  # KvPagePayload dict to pre-load
+        # Streaming export (dynamo_tpu/transfer): with a decode-worker-
+        # minted stream_handle, KV chunks publish DURING prefill instead
+        # of one payload after it. export_pub_blocks tracks contiguous
+        # published coverage.
+        self.export_handle = ktp.get("stream_handle") if self.export else None
+        self.export_stream: KvStreamExport | None = None
+        self.export_pub_blocks = 0
 
     @property
     def next_write_pos(self) -> int:
@@ -270,8 +279,8 @@ class TpuEngine:
     _SCHED_OWNED = frozenset({
         "_submissions", "_waiting", "_running", "_fetchq", "_free_slots",
         "_embed_jobs", "_host_jobs", "_offload_pending", "_exports",
-        "_drafter", "_step_no", "_spec_ticked", "phase_s", "phase_n",
-        "_ctr_pushed",
+        "_export_fetches", "_drafter", "_step_no", "_spec_ticked",
+        "phase_s", "phase_n", "_ctr_pushed",
     })
 
     def __init__(
@@ -326,10 +335,17 @@ class TpuEngine:
         # steps — the device-dispatch-affinity seam for out-of-band work
         # like AOT-warming the spec_verify compile lattice (bench).
         self._host_jobs: collections.deque = collections.deque()
-        # Disagg exports: handle → (KvPagePayload, deadline). Host copies,
-        # so they survive cache donation; reaped after export_ttl_s.
+        # Disagg exports: handle → (KvPagePayload | KvStreamExport,
+        # deadline). Host copies, so they survive cache donation; reaped
+        # after export_ttl_s (unsealed streams abort at reap time).
         self._exports: dict[str, tuple[Any, float]] = {}
         self.export_ttl_s = 60.0
+        # Streaming-export page fetches in flight: (seq, lo, hi, device
+        # arrays, bucket n). Dispatched per prefill chunk with async D2H
+        # (start_host_fetch); harvested opportunistically between chunk
+        # dispatches and in _step, forced at seal — so page copies and
+        # wire sends overlap the remaining prefill chunks.
+        self._export_fetches: list = []
         # Speculative decoding: host-side drafter + a runtime-togglable
         # draft length (initialized from args; bench/tests flip it on an
         # idle engine to compare dense vs speculative on one warmed
@@ -624,6 +640,12 @@ class TpuEngine:
             # Flip stopping FIRST so late generate() calls are rejected
             # instead of queueing onto a dead thread.
             self._fetchq.clear()  # drop; leftovers get terminal posts below
+            self._export_fetches.clear()
+            with self._mutex:
+                exports = [item for item, _dl in self._exports.values()]
+            for item in exports:
+                if isinstance(item, KvStreamExport):
+                    item.abort("engine_stopped")  # no-op when sealed
             with self._wakeup:
                 self._stopping = True
                 leftovers = list(self._running) + list(self._waiting) + list(self._submissions)
@@ -653,6 +675,8 @@ class TpuEngine:
         # frees slots/KV and discovers stops as early as possible, and
         # costs nothing when the head of the queue is still in flight.
         self._drain_completed()
+        if self._export_fetches:
+            self._drain_export_fetches()
         self._reap_cancelled()
         while self._embed_jobs:
             self._serve_embed(*self._embed_jobs.popleft())
@@ -981,6 +1005,25 @@ class TpuEngine:
         if seq.inject is not None:
             start, n_hit = self._inject_kv(seq, n_hit, max_hit)
             seq.prefix_hit_blocks = n_hit
+
+        # Streaming disagg export: register the stream at ADMISSION so
+        # the decode worker's kv_fetch can start pulling while prefill
+        # is still running; locally prefix-hit blocks are already in
+        # cache, so they publish as chunk 0 right now.
+        if seq.export and seq.export_handle:
+            exp = KvStreamExport(
+                seq.export_handle,
+                max_buffer_bytes=self.args.transfer_buffer_bytes,
+            )
+            seq.export_stream = exp
+            with self._mutex:
+                self._exports[seq.export_handle] = (
+                    exp, time.monotonic() + self.export_ttl_s
+                )
+            n_exp = (plen - 1) // bs  # full blocks only, like _export_kv
+            hit = min(start // bs, n_exp)
+            if hit > 0:
+                self._start_export_extract(seq, 0, hit)
         return start
 
     def _dispatch_prefills(
@@ -1083,6 +1126,18 @@ class TpuEngine:
             )
             self.total_prefill_padded += t_pad
             pos += len(chunk)
+            # Streaming export: the blocks this chunk completed can ship
+            # while the NEXT chunks compute — dispatch their gather with
+            # an async D2H now, and harvest whatever earlier gathers
+            # already landed (non-blocking), so the data plane overlaps
+            # the remaining prefill instead of serializing after it.
+            if (seq.export_stream is not None
+                    and seq.export_stream.abort_reason is None):
+                bs = self.args.block_size
+                done = min(pos // bs, (plen - 1) // bs)
+                if done > seq.export_pub_blocks:
+                    self._start_export_extract(seq, seq.export_pub_blocks, done)
+                self._drain_export_fetches()
         self._finish_prefill_bookkeeping(seq, start)
         assert logits is not None  # plen >= 1 → at least one chunk ran
         return logits
@@ -1105,6 +1160,8 @@ class TpuEngine:
         block ``block_offset`` (0 for disagg exports; >0 for peer delta
         fetches, llm/peer_kv.py). → (new start position, new hit count)."""
         payload = seq.inject
+        if isinstance(payload, dict) and payload.get("chunks") is not None:
+            return self._inject_kv_chunks(seq, payload["chunks"], n_hit, max_hit)
         off = 0
         if isinstance(payload, dict):
             off = int(payload.get("block_offset") or 0)
@@ -1123,9 +1180,51 @@ class TpuEngine:
         seq.inject = None  # free host pages promptly
         return n_inj * bs, n_inj
 
+    def _inject_kv_chunks(
+        self, seq: _Seq, chunks: list, n_hit: int, max_hit: int
+    ) -> tuple[int, int]:
+        """Incremental inject of a streamed chunk list (dynamo_tpu/
+        transfer): each contiguous page run scatters separately — no
+        monolithic host concat — and format bridging (adapt_pages)
+        happens per chunk, so a float-prefill → int8-decode stream
+        quantizes run by run. Coverage must stay contiguous from the
+        local hit boundary; a gap stops injection (the rest recomputes)."""
+        bs = self.args.block_size
+        n_cur = n_hit
+        for ch in chunks:
+            off = int(ch.get("block_offset") or 0)
+            payload = kv_transfer.KvPagePayload.from_dict(ch)
+            end = min(off + payload.k.shape[1], max_hit)
+            if end <= n_cur:
+                continue  # fully covered locally already
+            if off > n_cur:
+                break  # gap — injecting past it would leave a KV hole
+            self._runner.inject_pages(
+                seq.block_ids[n_cur:end],
+                *(a[:, n_cur - off : end - off] for a in payload.pages()),
+            )
+            n_cur = end
+        seq.inject = None  # free host chunk buffers promptly
+        return n_cur * bs, n_cur
+
     def _export_kv(self, seq: _Seq, plen: int) -> None:
         bs = self.args.block_size
         n_exp = (plen - 1) // bs  # full blocks only; suffix recomputed remotely
+        if seq.export_stream is not None:
+            # Streaming export: publish the remainder (everything for a
+            # single-dispatch packed prefill; the final partial run for a
+            # chunked one), drain this stream's in-flight page fetches
+            # (blocking is fine — prefill is done, nothing left to
+            # overlap) and seal.
+            meta = {"remote_handle": seq.export_handle, "stream": True,
+                    "num_tokens": n_exp * bs, "num_blocks": n_exp}
+            if (n_exp > seq.export_pub_blocks
+                    and seq.export_stream.abort_reason is None):
+                self._start_export_extract(seq, seq.export_pub_blocks, n_exp)
+            self._drain_export_fetches(force_seq=seq)
+            seq.export_stream.seal(num_blocks=n_exp, num_tokens=n_exp * bs)
+            seq.export_meta = meta
+            return
         meta = {"remote_handle": seq.request_id, "num_tokens": n_exp * bs, "num_blocks": n_exp}
         if n_exp > 0:
             pages = self._runner.extract_pages(seq.block_ids[:n_exp])
@@ -1134,6 +1233,43 @@ class TpuEngine:
             with self._mutex:
                 self._exports[seq.request_id] = (payload, time.monotonic() + self.export_ttl_s)
         seq.export_meta = meta
+
+    def _start_export_extract(self, seq: _Seq, lo: int, hi: int) -> None:
+        """Dispatch the gather for blocks [lo, hi) of a streaming export
+        and start its async D2H copy; harvested by _drain_export_fetches."""
+        arrs, n = self._runner.start_extract_pages(seq.block_ids[lo:hi])
+        start_host_fetch(arrs)
+        self._export_fetches.append((seq, lo, hi, arrs, n))
+        seq.export_pub_blocks = hi
+
+    def _drain_export_fetches(self, force_seq: _Seq | None = None) -> None:
+        """Harvest streaming-export page fetches whose D2H copy landed
+        (never blocking), publishing each as one chunk. ``force_seq``
+        additionally block-drains THAT sequence's fetches (seal time).
+        Fetches whose stream died (abort/preempt) are dropped."""
+        keep: list = []
+        blocked: set[int] = set()
+        bs = self.args.block_size
+        for item in self._export_fetches:
+            seq, lo, hi, arrs, n = item
+            exp = seq.export_stream
+            if exp is None or exp.abort_reason is not None:
+                continue  # stream gone — release the device arrays
+            # Publish strictly in dispatch order per sequence: host_ready
+            # is per-array, and a later run landing before an earlier one
+            # would punch a gap in the consumer's contiguous chunk stream
+            # (its injector stops at the first gap and recomputes).
+            if id(seq) in blocked or (
+                seq is not force_seq and not host_ready(arrs)
+            ):
+                keep.append(item)
+                blocked.add(id(seq))
+                continue
+            pages = self._runner.finish_extract_pages(arrs, n)
+            exp.publish(KvChunk(
+                block_offset=lo, pages=pages, num_tokens=(hi - lo) * bs,
+            ))
+        self._export_fetches = keep
 
     def prefix_hit_length(self, token_ids: list[int]) -> int:
         """Tokens of this prompt already resident in the local prefix
@@ -1145,17 +1281,50 @@ class TpuEngine:
         return len(self.pool.match_prefix(hashes)) * bs
 
     def take_export(self, handle: str):
-        """→ KvPagePayload | None. One-shot: the caller owns the pages."""
+        """→ KvPagePayload | None. One-shot: the caller owns the pages.
+        Streaming exports are not served here (get_stream_export)."""
         with self._mutex:
+            item = self._exports.get(handle)
+            if item is not None and isinstance(item[0], KvStreamExport):
+                return None
             item = self._exports.pop(handle, None)
         return item[0] if item else None
+
+    def get_stream_export(self, handle: str) -> KvStreamExport | None:
+        """→ the live streaming export for ``handle`` (non-popping — the
+        consumer pulls windows against it), or None. Each lookup refreshes
+        the reap deadline: the TTL bounds time since the consumer LAST
+        pulled, not the whole transfer — a healthy long prefill + many-GB
+        pull must outlive any fixed total budget (mirrors the puller's
+        stall-not-total timeout). Thread-safe."""
+        with self._mutex:
+            item = self._exports.get(handle)
+            if item is not None and isinstance(item[0], KvStreamExport):
+                exp = item[0]
+                self._exports[handle] = (exp, time.monotonic() + self.export_ttl_s)
+                return exp
+        return None
+
+    def release_stream_export(self, handle: str) -> None:
+        """Drop a fully-delivered streaming export (the consumer saw
+        kv_eos); frees any remaining host pages. Thread-safe."""
+        with self._mutex:
+            item = self._exports.pop(handle, None)
+        if item is not None and isinstance(item[0], KvStreamExport):
+            item[0].ack(item[0].chunk_count())
 
     def _reap_exports(self) -> None:
         now = time.monotonic()
         with self._mutex:
             dead = [h for h, (_, dl) in self._exports.items() if dl < now]
-            for h in dead:
-                del self._exports[h]
+            reaped = [self._exports.pop(h) for h in dead]
+        for item, _dl in reaped:
+            if isinstance(item, KvStreamExport):
+                # An unsealed reaped stream means the consumer never
+                # finished pulling — tell any late puller it is gone,
+                # and free whatever pages are still buffered.
+                item.abort("expired")
+                item.ack(item.chunk_count())
 
     def _register_written_blocks(self, seq: _Seq) -> None:
         """Register sealed blocks whose KV is fully written. A block sealed
@@ -1210,6 +1379,19 @@ class TpuEngine:
         # now and could be recycled before the next flush.
         freed = set(seq.block_ids)
         self._offload_pending = [(b, h) for b, h in self._offload_pending if b not in freed]
+        # A preempted streaming export aborts (the decode worker falls
+        # back to local prefill) and the re-admission runs non-streamed:
+        # re-registering the same handle under a fresh object would race
+        # a consumer already waiting on this one. export must drop too —
+        # otherwise re-admission runs a legacy one-shot extract under a
+        # handle no consumer ever learned, parking the payload on the
+        # heap until the TTL reap.
+        if seq.export_stream is not None:
+            seq.export_stream.abort("preempted")
+            seq.export_stream = None
+            seq.export_handle = None
+            seq.export_pub_blocks = 0
+            seq.export = False
         self.pool.free_sequence(seq.block_ids)
         seq.block_ids = []
         seq.registered_blocks = 0
@@ -1842,6 +2024,10 @@ class TpuEngine:
         already_posted: bool = False,
     ) -> None:
         seq.dead = True
+        if seq.export_stream is not None and not seq.export_stream.sealed:
+            # Error/cancel before the prefill sealed the stream: the
+            # puller must not wait out its deadline on a dead export.
+            seq.export_stream.abort("prefill_failed")
         if seq in self._running:
             self._running.remove(seq)
         if seq.slot is not None:

@@ -76,13 +76,14 @@ def _cache_arrays(cache: KVCache) -> tuple:
     return (cache.k, cache.v)
 
 
-def extract_pages(cache: KVCache, block_ids: list[int], replicate=None) -> tuple:
-    """Copy the named blocks to host → (k, v) numpy pages, each
-    [L, n, bs, KVH*hd] — plus (k_scale, v_scale) [L, n, bs, KVH] when the
-    cache stores int8. Must run before the cache is donated to a later
-    step (i.e. on the engine thread, synchronously). Pass the
-    ModelSharding as ``replicate`` on a sharded cache so the gather
-    all-gathers to every host."""
+def start_extract(cache: KVCache, block_ids: list[int], replicate=None) -> tuple:
+    """Dispatch the page gather WITHOUT syncing → (device arrays, each
+    [L, n_bucket, bs, ...], true block count n). The gather is enqueued
+    on the device stream BEFORE any later donating dispatch, so it reads
+    the pre-donation values; the caller harvests with ``finish_extract``
+    once ``host_ready`` (engine/runner.py) reports the async D2H copy
+    done. This is what lets the streaming KV exporter overlap page
+    copies with the remaining prefill chunks."""
     n = len(block_ids)
     nb = _bucket(n)
     ids = np.zeros((nb,), np.int32)
@@ -92,7 +93,23 @@ def extract_pages(cache: KVCache, block_ids: list[int], replicate=None) -> tuple
         out = _extract_replicated(arrs, jnp.asarray(ids), replicate)
     else:
         out = _extract_impl(arrs, jnp.asarray(ids))
-    return tuple(np.asarray(p[:, :n]) for p in out)
+    return out, n
+
+
+def finish_extract(device_pages: tuple, n: int) -> tuple:
+    """Sync a ``start_extract`` result → host numpy pages [L, n, ...]."""
+    return tuple(np.asarray(p[:, :n]) for p in device_pages)
+
+
+def extract_pages(cache: KVCache, block_ids: list[int], replicate=None) -> tuple:
+    """Copy the named blocks to host → (k, v) numpy pages, each
+    [L, n, bs, KVH*hd] — plus (k_scale, v_scale) [L, n, bs, KVH] when the
+    cache stores int8. Must run before the cache is donated to a later
+    step (i.e. on the engine thread, synchronously). Pass the
+    ModelSharding as ``replicate`` on a sharded cache so the gather
+    all-gathers to every host."""
+    out, n = start_extract(cache, block_ids, replicate)
+    return finish_extract(out, n)
 
 
 def inject_pages(cache: KVCache, block_ids: list[int], *pages) -> KVCache:

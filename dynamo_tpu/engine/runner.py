@@ -342,6 +342,20 @@ class LocalRunner:
             self.cache, block_ids, replicate=self.sharding
         )
 
+    def start_extract_pages(self, block_ids: list[int]) -> tuple:
+        """Dispatch a page gather without syncing → (device arrays, n).
+        The streaming KV exporter starts the D2H copy on these
+        (start_host_fetch) and harvests with ``finish_extract_pages``
+        once host_ready — page copies overlap remaining prefill chunks
+        instead of blocking the scheduler per chunk."""
+        return kv_transfer.start_extract(
+            self.cache, block_ids, replicate=self.sharding
+        )
+
+    @staticmethod
+    def finish_extract_pages(device_pages: tuple, n: int) -> tuple:
+        return kv_transfer.finish_extract(device_pages, n)
+
     def inject_pages(self, block_ids: list[int], *pages) -> None:
         pages = kv_transfer.adapt_pages(pages, self.cache, self.cfg.num_kv_heads)
         self.cache = kv_transfer.inject_pages(self.cache, block_ids, *pages)
@@ -507,6 +521,12 @@ class LeaderRunner(LocalRunner):
         self._cast({"op": "extract_pages", "ids": list(map(int, block_ids))})
         return super().extract_pages(block_ids)
 
+    def start_extract_pages(self, block_ids: list[int]):
+        # Followers replay the same gather dispatch (and discard the
+        # result) so the SPMD dispatch streams stay aligned.
+        self._cast({"op": "start_extract_pages", "ids": list(map(int, block_ids))})
+        return super().start_extract_pages(block_ids)
+
     def inject_pages(self, block_ids: list[int], *pages) -> None:
         def pack(a):
             a = np.asarray(a)
@@ -605,6 +625,8 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
             runner.embed(_unpack_np(desc["toks"]), desc["tlen"], rid=desc["rid"])
         elif op == "extract_pages":
             runner.extract_pages(desc["ids"])
+        elif op == "start_extract_pages":
+            runner.start_extract_pages(desc["ids"])
         elif op == "inject_pages":
             pages = [_unpack_np(d) for d in desc["pages"]]
             if desc["bf16"]:

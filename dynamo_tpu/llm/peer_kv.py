@@ -32,6 +32,7 @@ from dynamo_tpu.engine.kv_transfer import KvPagePayload, concat_page_run
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.tokens import compute_block_hashes
+from dynamo_tpu.transfer.stream import TransferError, read_kv_payload_frames
 
 log = get_logger("peer_kv")
 
@@ -126,18 +127,20 @@ class PeerPrefixFetcher:
                 return None
             # Delta only: blocks [covered, want) — the engine injects them
             # after its local hits (block_offset keeps the alignment).
-            frames: list[dict] = []
-            async for resp in self.fetch_router.generate(
-                {"hashes": hashes[covered:]}, Context(trace=ctx.trace),
-                instance_id=hint["instance_id"],
-            ):
-                frames.append(resp)
-            if not frames or frames[0].get("error"):
+            # Frames assemble through the shared data-plane chunk reader
+            # (dynamo_tpu/transfer), the same one the streaming disagg
+            # pull uses; a declined stream raises the typed TransferError.
+            try:
+                payload = await read_kv_payload_frames(
+                    self.fetch_router.generate(
+                        {"hashes": hashes[covered:]}, Context(trace=ctx.trace),
+                        instance_id=hint["instance_id"],
+                    )
+                )
+            except TransferError as e:
                 self.peer_fetch_failures += 1
-                log.debug("peer prefix fetch declined: %s",
-                          (frames[0] if frames else {}).get("error", "empty"))
+                log.debug("peer prefix fetch declined: %s", e)
                 return None
-            payload = KvPagePayload.from_frames(frames)
             if payload.num_tokens <= 0:
                 return None
             self.peer_fetches += 1

@@ -127,6 +127,29 @@ class Planner:
         self._last_current = current  # reused by _step_sync's _apply
         return max(self.cfg.min_replicas, min(self.cfg.max_replicas, need))
 
+    def initial_pool_split(self, total_workers: int) -> dict:
+        """Static prefill:decode split for a fixed fleet from the
+        profiled interpolators (interpolate.plan_disagg_pools) — the
+        day-0 deployment shape before the observe→scale loop has any
+        traffic to react to. Requires both interpolators and an ITL SLA."""
+        from dynamo_tpu.planner.interpolate import plan_disagg_pools
+
+        if (
+            self.decode_interp is None or self.prefill_interp is None
+            or self.cfg.itl_sla_ms is None
+        ):
+            raise ValueError(
+                "initial_pool_split needs decode + prefill interpolators "
+                "and an itl_sla_ms"
+            )
+        return plan_disagg_pools(
+            total_workers, self.decode_interp, self.prefill_interp,
+            prompt_len=self.cfg.mean_input_tokens,
+            gen_len=self.cfg.mean_output_tokens,
+            itl_sla_ms=self.cfg.itl_sla_ms,
+            ttft_sla_ms=self.cfg.ttft_sla_ms,
+        )
+
     def target_prefill_replicas(self, obs: PlannerObservation) -> int:
         """Prefill fleet sizing from the PREDICTED input-token rate and
         the profiled prefill throughput, TTFT-corrected (reference:

@@ -56,6 +56,60 @@ class PrefillInterpolator:
         return float(np.interp(prompt_len, self.prompt_len, self.tok_s))
 
 
+def plan_disagg_pools(
+    total_workers: int,
+    decode: DecodeInterpolator,
+    prefill: PrefillInterpolator,
+    *,
+    prompt_len: float,
+    gen_len: float,
+    itl_sla_ms: float,
+    ttft_sla_ms: float | None = None,
+) -> dict:
+    """Split a fixed fleet between prefill and decode pools so neither
+    side bottlenecks goodput — the DistServe argument (2401.09670): under
+    disaggregation each pool runs at ITS best SLA-respecting operating
+    point, so the right split equalizes per-pool REQUEST rates, not
+    token rates.
+
+    Per-worker request capacity from the profiled interpolators:
+    decode = best_throughput_under_itl(itl_sla) / gen_len;
+    prefill = throughput_at(prompt_len) / prompt_len. The integer split
+    maximizes min(prefill_rps, decode_rps) with ≥1 worker per pool.
+    → {"prefill_workers", "decode_workers", "ratio", "goodput_rps",
+       "prefill_rps_per_worker", "decode_rps_per_worker", ...}.
+
+    ``ttft_sla_ms``: when the profiled single-request TTFT at prompt_len
+    already exceeds the SLA, no ratio can fix it (that is a chip-count /
+    chunking problem) — reported as ``ttft_feasible`` rather than
+    silently folded into the split."""
+    if total_workers < 2:
+        raise ValueError("disagg needs at least 2 workers (1 prefill + 1 decode)")
+    d_tok = decode.best_throughput_under_itl(itl_sla_ms)
+    d_rps = d_tok / max(gen_len, 1.0)
+    p_tok = prefill.throughput_at(prompt_len)
+    p_rps = p_tok / max(prompt_len, 1.0)
+    best_p, best_goodput = 1, -1.0
+    for p in range(1, total_workers):
+        goodput = min(p * p_rps, (total_workers - p) * d_rps)
+        if goodput > best_goodput:
+            best_p, best_goodput = p, goodput
+    out = {
+        "prefill_workers": best_p,
+        "decode_workers": total_workers - best_p,
+        # prefill workers needed per decode worker to keep it fed
+        "ratio": round(d_rps / p_rps, 4) if p_rps > 0 else 0.0,
+        "goodput_rps": round(max(best_goodput, 0.0), 4),
+        "prefill_rps_per_worker": round(p_rps, 4),
+        "decode_rps_per_worker": round(d_rps, 4),
+        "decode_tok_s_under_itl_sla": round(d_tok, 2),
+        "prefill_tok_s": round(p_tok, 2),
+    }
+    if ttft_sla_ms is not None:
+        out["ttft_feasible"] = prefill.ttft_at(prompt_len) <= ttft_sla_ms
+    return out
+
+
 def save_profile(path: str, *, decode: DecodeInterpolator | None = None,
                  prefill: PrefillInterpolator | None = None, meta: dict | None = None) -> None:
     arrays: dict = {"meta": np.bytes_(repr(meta or {}))}

@@ -47,10 +47,14 @@ class ChaosStats:
     frames_dropped: int = 0
     streams_truncated: int = 0
     kills: int = 0
+    transfer_cuts: int = 0
     latency_injections: int = 0
 
     def total(self) -> int:
-        return self.frames_dropped + self.streams_truncated + self.kills
+        return (
+            self.frames_dropped + self.streams_truncated + self.kills
+            + self.transfer_cuts
+        )
 
 
 class ChaosInjector:
@@ -114,6 +118,21 @@ class ChaosInjector:
             self.stats.kills += 1
             self._count("kill")
             raise ChaosKillError("injected worker death")
+
+    def maybe_cut_transfer(self) -> None:
+        """Consulted by the streaming KV data plane AFTER each chunk's
+        frames (transfer.serve_kv_window): raises :class:`ChaosKillError`
+        so the endpoint server cuts the transport BETWEEN chunks — on
+        the wire, a prefill worker dying mid-transfer. The decode side
+        must fall back to local prefill with byte-identical output
+        (tests/test_disagg.py pins this)."""
+        if (
+            self.config.transfer_cut_p > 0
+            and self.rng.random() < self.config.transfer_cut_p
+        ):
+            self.stats.transfer_cuts += 1
+            self._count("transfer_cut")
+            raise ChaosKillError("injected kv-transfer death")
 
     async def inject_latency(self) -> None:
         """Sleep a seeded uniform delay in [0, latency_ms]."""

@@ -139,6 +139,10 @@ class ChaosConfig:
     truncate_p: float = 0.0
     # Probability the (mock) engine dies mid-generation.
     kill_p: float = 0.0
+    # Probability the streaming KV data plane (llm/disagg.py kv_fetch)
+    # cuts the connection AFTER a chunk — the prefill worker "dying
+    # between chunks" mid-transfer.
+    transfer_cut_p: float = 0.0
     # Injected per-frame latency: uniform in [0, latency_ms].
     latency_ms: float = 0.0
 

@@ -69,6 +69,8 @@ PHASE_SPANS = {
     "engine.queue": "queue_wait",
     "engine.prefill": "prefill",
     "engine.decode": "decode",
+    # Disagg data plane (llm/disagg.py): dispatch + streamed KV pull.
+    "disagg.remote_prefill": "remote_prefill",
 }
 
 

@@ -44,8 +44,17 @@ def parse_args(argv=None):
     # components/backends/vllm/src/dynamo/vllm/main.py:65-88)
     p.add_argument("--is-prefill-worker", action="store_true",
                    help="serve prefill-only + kv_fetch; no model card (run with --component prefill)")
+    p.add_argument("--disagg", choices=["auto", "on", "off"], default="auto",
+                   help="disaggregated prefill/decode as the serving shape: "
+                        "auto (default) wires the decode-side disagg handler on "
+                        "every TPU worker — with no prefill fleet discovered it "
+                        "costs one set lookup per long prompt and serves "
+                        "aggregated; off restores the bare engine")
     p.add_argument("--remote-prefill", action="store_true",
-                   help="decode worker: offload long prefills to the prefill component")
+                   help="alias for --disagg on (kept for compatibility)")
+    p.add_argument("--no-disagg-stream", action="store_true",
+                   help="legacy one-shot KV pull after prefill instead of the "
+                        "streaming data plane (dynamo_tpu/transfer)")
     p.add_argument("--prefill-component", default="prefill")
     p.add_argument("--max-local-prefill-length", type=int, default=512,
                    help="prompts with more uncached tokens than this prefill remotely")
@@ -134,10 +143,13 @@ def parse_args(argv=None):
     p.add_argument("--mocker-delta-tokens", type=int, default=1,
                    help="tokens per simulated decode window (mirror engine decode_steps)")
     args = p.parse_args(argv)
-    if args.engine == "mocker" and (args.remote_prefill or args.is_prefill_worker):
+    if args.remote_prefill:
+        args.disagg = "on"
+    if args.engine == "mocker" and (args.disagg == "on" or args.is_prefill_worker):
         # The disagg handlers drive the real engine's KV extract/inject
         # surface (prefix_hit_length, kv pages); the mocker has neither.
-        p.error("--engine mocker cannot combine with --remote-prefill/--is-prefill-worker")
+        # (--disagg auto silently stays aggregated on a mocker.)
+        p.error("--engine mocker cannot combine with --disagg on/--is-prefill-worker")
     if (args.dp_rank is not None or args.dp_size > 1) and args.dist_num_processes > 1:
         # A dp rank is a self-contained JAX world; spanning hosts within a
         # rank would need per-rank coordinator port blocks — run multi-host
@@ -281,10 +293,16 @@ async def async_main(args) -> None:
 
     if args.is_prefill_worker:
         from dynamo_tpu.llm.disagg import DisaggConfig, PrefillHandler, PrefillPuller
+        from dynamo_tpu.runtime.chaos import ChaosInjector
         from dynamo_tpu.runtime.queue import WorkQueue
 
         dcfg = DisaggConfig()
-        handler = PrefillHandler(engine, frame_bytes=dcfg.frame_bytes)
+        # Env-driven kill-mid-transfer faults (DYNTPU_CHAOS_TRANSFER_CUT_P)
+        # ride the same [chaos] section as the messaging-layer injector.
+        handler = PrefillHandler(
+            engine, frame_bytes=dcfg.frame_bytes,
+            chaos=ChaosInjector.from_config(rt.config.chaos),
+        )
         gen_handle = await comp.endpoint(args.endpoint).serve(handler.generate)
         await comp.endpoint("kv_fetch").serve(handler.kv_fetch)
         await serve_kv_endpoints(comp, broadcaster, engine.metrics)
@@ -299,7 +317,13 @@ async def async_main(args) -> None:
         # No model card: the frontend must route only to decode workers.
         role = "prefill worker"
     else:
-        if args.remote_prefill:
+        # Disaggregated prefill/decode is the DEFAULT serving shape for
+        # TPU decode workers (--disagg auto): the handler costs one
+        # discovery-set lookup per long prompt when no prefill fleet
+        # exists and serves aggregated, so wiring it is free — a prefill
+        # component joining the namespace starts taking long prefills
+        # with no decode-worker restart.
+        if args.engine == "tpu" and args.disagg != "off":
             from dynamo_tpu.llm.disagg import DisaggConfig, DisaggDecodeHandler
             from dynamo_tpu.runtime.push_router import RouterMode
 
@@ -309,6 +333,7 @@ async def async_main(args) -> None:
             cfg = DisaggConfig(
                 max_local_prefill_length=args.max_local_prefill_length,
                 prefill_component=args.prefill_component,
+                stream=not args.no_disagg_stream,
             )
             handler = DisaggDecodeHandler(
                 engine,
@@ -321,6 +346,9 @@ async def async_main(args) -> None:
                 ),
                 store=rt.store,
             )
+            # disagg_remote_prefill_total / disagg_fallback_total{reason}
+            # + transfer bytes/inflight/overlap on this process's /metrics.
+            handler.bind_metrics(rt.metrics)
         else:
             handler = engine
 

@@ -1,0 +1,38 @@
+"""Tier-1 guard for ``bench.py --disagg``: the A/B harness (aggregated
+engine vs prefill+decode pair over the streaming KV data plane) must run
+end to end at smoke shapes, keep byte-identical output streams, actually
+send every long prompt remote, and report the transfer accounting keys
+the BENCH_* trajectory depends on.
+
+No timing assertions: --quick makes no throughput claims.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_disagg_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--disagg", "--quick"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout + proc.stderr[-2000:]
+    result = json.loads(lines[-1])
+    assert "error" not in result, result
+    # Chunked-streaming output pinned byte-identical to aggregated.
+    assert result["parity"] is True
+    # The A/B measured the disagg path, not an all-fallback run.
+    assert result["remote_prefills"] > 0
+    assert result["transfer_bytes"] > 0
+    # The trajectory keys bench rounds compare.
+    for key in ("aggregated_tok_s", "disagg_vs_aggregated",
+                "ttft_p99_ms_disagg", "transfer_overlap_frac"):
+        assert key in result, key

@@ -351,3 +351,69 @@ def test_kubernetes_connector_scale_calls(monkeypatch):
     assert calls[0][1].endswith("/namespaces/serving/deployments/dynamo-tpu-worker/scale")
     method, url, content = calls[1]
     assert method == "PATCH" and '"replicas": 5' in content
+
+
+def test_plan_disagg_pools_goodput_split():
+    """DistServe-style static split: the integer allocation equalizes
+    per-pool REQUEST rates under the profiled SLA operating points."""
+    from dynamo_tpu.planner.interpolate import plan_disagg_pools
+
+    # Decode: flat 10ms ITL up to batch 32, 2000 tok/s there.
+    dec = DecodeInterpolator(
+        np.array([1, 16, 32]), np.array([5.0, 8.0, 10.0]),
+        np.array([100.0, 1200.0, 2000.0]),
+    )
+    # Prefill: 8000 tok/s at 512-token prompts, 60ms TTFT.
+    pre = PrefillInterpolator(
+        np.array([128, 512, 2048]), np.array([20.0, 60.0, 200.0]),
+        np.array([6000.0, 8000.0, 9000.0]),
+    )
+    plan = plan_disagg_pools(
+        10, dec, pre, prompt_len=512, gen_len=128,
+        itl_sla_ms=10.0, ttft_sla_ms=100.0,
+    )
+    assert plan["prefill_workers"] + plan["decode_workers"] == 10
+    assert plan["prefill_workers"] >= 1 and plan["decode_workers"] >= 1
+    # decode worker serves 2000/128 = 15.6 rps; prefill 8000/512 = 15.6
+    # rps -> even split maximizes min() goodput.
+    assert plan["prefill_workers"] == 5
+    assert plan["goodput_rps"] > 0
+    assert plan["ttft_feasible"] is True
+    # A decode-heavy workload (short prompts, long generations) shifts
+    # the split toward decode.
+    plan2 = plan_disagg_pools(
+        10, dec, pre, prompt_len=128, gen_len=512, itl_sla_ms=10.0,
+    )
+    assert plan2["decode_workers"] > plan2["prefill_workers"]
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        plan_disagg_pools(1, dec, pre, prompt_len=128, gen_len=128, itl_sla_ms=10.0)
+
+
+def test_planner_initial_pool_split():
+    dec = DecodeInterpolator(
+        np.array([1, 32]), np.array([5.0, 10.0]), np.array([100.0, 2000.0])
+    )
+    pre = PrefillInterpolator(
+        np.array([128, 2048]), np.array([20.0, 200.0]),
+        np.array([6000.0, 9000.0]),
+    )
+    cfg = PlannerConfig(
+        component="backend", prefill_component="prefill",
+        mean_input_tokens=512.0, mean_output_tokens=128.0, itl_sla_ms=10.0,
+    )
+    conn = RecordingConnector({"backend": 1, "prefill": 1})
+
+    async def source():
+        return PlannerObservation()
+
+    planner = Planner(cfg, conn, source, decode_interp=dec, prefill_interp=pre)
+    split = planner.initial_pool_split(8)
+    assert split["prefill_workers"] + split["decode_workers"] == 8
+    import pytest as _pytest
+
+    bare = Planner(PlannerConfig(), conn, source)
+    with _pytest.raises(ValueError):
+        bare.initial_pool_split(8)

@@ -83,7 +83,7 @@ class AsyncBlockingChecker(Checker):
     description = "blocking calls inside async def on the serving path"
     scope = (
         "dynamo_tpu/frontend", "dynamo_tpu/runtime", "dynamo_tpu/router",
-        "dynamo_tpu/llm", "dynamo_tpu/kv_router",
+        "dynamo_tpu/llm", "dynamo_tpu/kv_router", "dynamo_tpu/transfer",
     )
 
     def run(self, module: SourceModule) -> Iterable[Finding]:

@@ -54,6 +54,11 @@ async def build_registries():
     from dynamo_tpu.engine.engine import register_engine_metrics
 
     register_engine_metrics(wrt.metrics)
+    # Disagg data-plane series (what worker/__main__ binds on the decode
+    # handler): registered via the same shared path.
+    from dynamo_tpu.llm.disagg import register_disagg_metrics
+
+    register_disagg_metrics(wrt.metrics)
 
     async def gen_handler(payload, ctx):
         async for item in engine.generate(payload, ctx):
