@@ -1,0 +1,97 @@
+"""Fleet aggregation: merge per-process observability surfaces into one.
+
+The fleet shares ONE inference port, so a ``GET /metrics`` against it
+lands on an arbitrary child — useless for scraping. Each child therefore
+exposes a per-process admin site (ephemeral port, registered in the
+store), and the supervisor's aggregation endpoint merges them:
+
+- ``/metrics``: Prometheus expositions concatenated per metric family
+  (HELP/TYPE once, all children's samples grouped) with every sample
+  relabeled ``fleet_worker_id="<i>"`` so per-process series stay
+  distinguishable after aggregation;
+- ``/debug/requests``: ledger records concatenated, each tagged with
+  ``fleet_worker_id``.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})?\s+(\S+)\s*$")
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def relabel_sample(line: str, label: str, value: str) -> str | None:
+    """Inject ``label="value"`` into one exposition sample line.
+    → None when the line is not a sample (blank/comment/garbage)."""
+    m = _SAMPLE_RE.match(line)
+    if m is None:
+        return None
+    name, labels, val = m.groups()
+    inject = f'{label}="{value}"'
+    if labels:
+        body = labels[1:-1]
+        new = "{" + (f"{inject},{body}" if body else inject) + "}"
+    else:
+        new = "{" + inject + "}"
+    return f"{name}{new} {val}"
+
+
+def _family(name: str, families: set[str]) -> str:
+    """Histogram samples (``x_bucket``/``x_sum``/``x_count``) belong to
+    family ``x``; everything else is its own family."""
+    for suffix in _FAMILY_SUFFIXES:
+        if name.endswith(suffix) and name[: -len(suffix)] in families:
+            return name[: -len(suffix)]
+    return name
+
+
+def merge_metrics(parts: list[tuple[str, str]], label: str = "fleet_worker_id") -> str:
+    """Merge per-child expositions: ``parts`` is [(worker_id, text)].
+    Samples of one metric family stay contiguous under one HELP/TYPE
+    header (the exposition format's grouping requirement)."""
+    headers: dict[str, list[str]] = {}
+    samples: dict[str, list[str]] = {}
+    order: list[str] = []
+    families: set[str] = set()
+    for wid, text in parts:
+        for raw in text.splitlines():
+            line = raw.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                toks = line.split(None, 3)
+                if len(toks) >= 3 and toks[1] in ("HELP", "TYPE"):
+                    fam = toks[2]
+                    families.add(fam)
+                    if fam not in headers:
+                        headers[fam] = []
+                        order.append(fam)
+                    if line not in headers[fam]:
+                        headers[fam].append(line)
+                continue
+            relabeled = relabel_sample(line, label, wid)
+            if relabeled is None:
+                continue
+            fam = _family(line.split("{", 1)[0].split(" ", 1)[0], families)
+            if fam not in headers:
+                headers[fam] = []
+                order.append(fam)
+            samples.setdefault(fam, []).append(relabeled)
+    out: list[str] = []
+    for fam in order:
+        out.extend(headers.get(fam, ()))
+        out.extend(samples.get(fam, ()))
+    return "\n".join(out) + "\n"
+
+
+def merge_ledgers(parts: list[tuple[str, dict]], label: str = "fleet_worker_id") -> dict:
+    """Merge per-child ``/debug/requests`` bodies: ``parts`` is
+    [(worker_id, body)]. Enabled iff any child has tracing enabled."""
+    merged: list[dict] = []
+    enabled = False
+    for wid, body in parts:
+        enabled = enabled or bool(body.get("enabled"))
+        for rec in body.get("requests", []):
+            merged.append({label: wid, **rec})
+    return {"enabled": enabled, "requests": merged}

@@ -1,0 +1,298 @@
+"""Global admission budget: fleet-wide inflight bound, leased in chunks.
+
+Problem: N frontend processes each run a local
+:class:`~dynamo_tpu.runtime.admission.AdmissionController`, but the
+operator configures ONE number — the total concurrent requests the
+cluster should accept. A per-request store round-trip would put the
+control plane on the hot path; instead the budget is divided into
+fixed **chunks** and processes lease whole chunks:
+
+- chunk ``k`` is the store key ``fleet/<fleet_id>/budget/<k>``;
+- a claim is a ``PutMode.CREATE`` under the claimant's primary lease —
+  create-if-absent is atomic in the store, so a chunk has at most one
+  holder *by construction* (no coordinator, no read-modify-write race);
+- a process admits at most ``sum(held chunk slots)`` requests, so the
+  fleet-wide admitted total can never exceed the budget;
+- a crashed process's lease expires (TTL; the TCP store additionally
+  revokes connection-owned leases on disconnect) → its chunk keys
+  vanish → siblings see the DELETE events and re-claim the capacity.
+
+Claiming is demand-driven and work-conserving: a process keeps roughly
+``inflight + queued`` slots plus half a chunk of headroom, releases the
+rest, and re-claims when its queue backs up or a sibling releases.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+
+from dynamo_tpu.runtime.admission import AdmissionController
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.store import EventKind, KeyExistsError, KeyValueStore, PutMode
+
+log = get_logger("fleet.budget")
+
+
+def budget_prefix(fleet_id: str) -> str:
+    return f"fleet/{fleet_id}/budget/"
+
+
+def chunk_sizes(total: int, chunk_slots: int) -> list[int]:
+    """Partition ``total`` slots into chunks of ``chunk_slots`` (the last
+    chunk takes the remainder)."""
+    if total <= 0:
+        return []
+    chunk_slots = max(1, min(chunk_slots, total))
+    sizes = [chunk_slots] * (total // chunk_slots)
+    if total % chunk_slots:
+        sizes.append(total % chunk_slots)
+    return sizes
+
+
+class GlobalBudget:
+    """One process's view of the shared budget: claims/releases chunks to
+    track local demand, reports held slots through ``on_change``."""
+
+    def __init__(
+        self,
+        store: KeyValueStore,
+        fleet_id: str,
+        lease_id: int,
+        total: int,
+        chunk_slots: int = 8,
+        worker_id: int = 0,
+        on_change=None,
+        demand_fn=None,
+        metrics: dict | None = None,
+    ):
+        self.store = store
+        self.fleet_id = fleet_id
+        self.lease_id = lease_id
+        self.total = total
+        self.sizes = chunk_sizes(total, chunk_slots)
+        self.chunk_slots = max(1, min(chunk_slots, total)) if total > 0 else chunk_slots
+        # Scan order starts at a per-worker offset so siblings claiming
+        # concurrently mostly probe disjoint chunks (fewer CREATE losses).
+        n = len(self.sizes)
+        self.scan_order = [(worker_id * (n // 2 + 1) + i) % n for i in range(n)]
+        self.on_change = on_change
+        # demand_fn() → slots this process currently needs (inflight +
+        # queued); the manager keeps held ≈ demand + headroom.
+        self.demand_fn = demand_fn or (lambda: 0)
+        self.held: dict[int, int] = {}  # chunk index → slots
+        # Store revision of each chunk's claim put: a DELETE event older
+        # than our claim is the stale echo of an earlier release (ours or
+        # a sibling's) arriving after a re-claim — acting on it would
+        # discard a live claim and leak the chunk's slots fleet-wide.
+        self._claim_rev: dict[int, int] = {}
+        self._poke = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._watch = None
+        self._watch_task: asyncio.Task | None = None
+        self._draining = False
+        self._closed = False
+        self._m = metrics or {}
+
+    @property
+    def held_slots(self) -> int:
+        return sum(self.held.values())
+
+    def poke(self) -> None:
+        """Nudge the manager to re-evaluate claims (called from the
+        admission gate's acquire/release paths — cheap, loop-local)."""
+        self._poke.set()
+
+    def start_draining(self) -> None:
+        """Drain mode: stop claiming; release down to live demand as
+        in-flight streams finish (never below — released capacity gets
+        admitted by siblings immediately, and fleet-wide admitted must
+        stay ≤ budget)."""
+        self._draining = True
+        self._poke.set()
+
+    async def start(self) -> "GlobalBudget":
+        loop = asyncio.get_running_loop()
+        self._watch = await self.store.watch_prefix(budget_prefix(self.fleet_id))
+        self._watch_task = loop.create_task(self._watch_loop())
+        await self._rebalance()  # claim the initial headroom chunk
+        self._task = loop.create_task(self._manage_loop())
+        return self
+
+    async def close(self) -> None:
+        """Release every held chunk and stop. Part of the drain contract:
+        a SIGTERM'd process must return its budget explicitly rather than
+        leaving siblings to wait out the lease TTL."""
+        if self._closed:
+            return
+        self._closed = True
+        for t in (self._task, self._watch_task):
+            if t is not None:
+                t.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
+        if self._watch is not None:
+            await self._watch.cancel()
+        for idx in list(self.held):
+            await self._release(idx)
+        self._report()
+
+    async def _watch_loop(self) -> None:
+        # A sibling releasing (or dying: lease expiry deletes its keys)
+        # frees capacity this process may be queued for — re-claim.
+        try:
+            async for ev in self._watch:
+                if ev.kind != EventKind.DELETE:
+                    continue
+                tail = ev.key.rsplit("/", 1)[1]
+                if (
+                    tail.isdigit()
+                    and int(tail) in self.held
+                    # Revision guard: only a DELETE newer than our claim
+                    # means OUR key vanished server-side (lease expired —
+                    # keepalive fell behind TTL). Older DELETEs are stale
+                    # echoes of pre-re-claim releases.
+                    and ev.revision > self._claim_rev.get(int(tail), -1)
+                ):
+                    idx = int(tail)
+                    log.warning("budget chunk %d lost to lease expiry", idx)
+                    self.held.pop(idx, None)
+                    self._claim_rev.pop(idx, None)
+                    # A sibling may claim it now: shrink the local limit
+                    # immediately — the fleet-wide bound outranks this
+                    # process's capacity.
+                    self._report()
+                self._poke.set()
+        except asyncio.CancelledError:
+            pass
+
+    async def _manage_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        # Pokes (queue pressure, sibling releases) trigger fast claim
+        # passes; releases happen on a 1s PERIODIC tick so a
+        # sporadically-loaded process can't flap a chunk per request —
+        # and the tick must fire under steady traffic too (every request
+        # completion pokes, so gating release on a quiet second would
+        # never return surplus while serving). Draining releases eagerly.
+        next_release = loop.time() + 1.0
+        try:
+            while True:
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(
+                        self._poke.wait(), max(0.05, next_release - loop.time())
+                    )
+                self._poke.clear()
+                release = self._draining or loop.time() >= next_release
+                if release:
+                    next_release = loop.time() + 1.0
+                await self._rebalance(release=release)
+        except asyncio.CancelledError:
+            pass
+
+    def _desired_slots(self) -> int:
+        demand = max(0, int(self.demand_fn()))
+        if self._draining:
+            return demand  # never below in-flight; no headroom either
+        # Half a chunk of headroom keeps claim latency off the hot path
+        # while bounding what an idle process withholds from loaded
+        # siblings (work conservation beats first-burst latency here —
+        # a starved claim costs ~1 store round-trip).
+        return demand + max(1, self.chunk_slots // 2)
+
+    async def _rebalance(self, release: bool = True) -> None:
+        desired = self._desired_slots()
+        # Claim up: any unheld chunk, in this worker's scan order.
+        while self.held_slots < desired:
+            if not await self._claim_one():
+                break
+        if release:
+            # Release down: whole chunks whose loss still leaves desired.
+            while self.held:
+                idx = next(reversed(self.held))
+                if self.held_slots - self.held[idx] < desired:
+                    break
+                await self._release(idx)
+        self._report()
+
+    async def _claim_one(self) -> bool:
+        payload = None
+        for idx in self.scan_order:
+            if idx in self.held:
+                continue
+            if payload is None:
+                payload = json.dumps({"lease": self.lease_id}).encode()
+            key = budget_prefix(self.fleet_id) + str(idx)
+            try:
+                rev = await self.store.put(
+                    key, payload, lease_id=self.lease_id, mode=PutMode.CREATE
+                )
+            except KeyExistsError:
+                continue
+            except Exception as e:  # noqa: BLE001 — store hiccup: claim retried on next poke/tick, never crashes admission
+                log.warning("budget claim failed: %s", e)
+                if "claims" in self._m:
+                    self._m["claims"].inc(outcome="error")
+                return False
+            self.held[idx] = self.sizes[idx]
+            self._claim_rev[idx] = rev
+            if "claims" in self._m:
+                self._m["claims"].inc(outcome="won")
+            return True
+        if "claims" in self._m:
+            self._m["claims"].inc(outcome="exhausted")
+        return False
+
+    async def _release(self, idx: int) -> None:
+        self.held.pop(idx, None)
+        self._claim_rev.pop(idx, None)
+        # Lower the LOCAL limit before the awaited delete publishes the
+        # capacity to siblings: the store round-trip yields the event
+        # loop, and an acquire() racing in against the stale higher
+        # limit while a sibling claims the chunk would put fleet-wide
+        # admitted over the budget.
+        self._report()
+        try:
+            await self.store.delete(budget_prefix(self.fleet_id) + str(idx))
+        except Exception as e:  # noqa: BLE001 — release is best-effort: the lease TTL reclaims the chunk if the delete is lost
+            log.warning("budget release failed: %s", e)
+
+    def _report(self) -> None:
+        if "slots" in self._m:
+            self._m["slots"].set(self.held_slots)
+        if "chunks" in self._m:
+            self._m["chunks"].set(len(self.held))
+        if self.on_change is not None:
+            self.on_change(self.held_slots)
+
+
+class BudgetedAdmissionController(AdmissionController):
+    """Admission gate whose capacity is whatever the process currently
+    leases from the :class:`GlobalBudget`. ``max_inflight == 0`` means
+    *zero admissions* here (requests queue up to ``max_queue_depth``
+    waiting for a chunk claim), not "unlimited" as in the base class."""
+
+    allow_unbounded = False
+
+    def __init__(self, budget: GlobalBudget, **kw):
+        kw.setdefault("max_queue_depth", max(32, budget.chunk_slots * 2))
+        super().__init__(max_inflight=0, **kw)
+        self.budget = budget
+        budget.on_change = self.set_limit
+        budget.demand_fn = lambda: self._inflight + self.queued
+
+    async def acquire(self) -> None:
+        # Nudge the claim loop BEFORE possibly queueing: the queued wait
+        # is exactly what a fresh chunk claim resolves.
+        if self._inflight + self.queued + 1 > self.max_inflight:
+            self.budget.poke()
+        await super().acquire()
+
+    def release(self) -> None:
+        super().release()
+        # Falling demand is what lets chunks flow back to hot siblings.
+        self.budget.poke()
+
+    def start_draining(self) -> None:
+        super().start_draining()
+        self.budget.start_draining()

@@ -1,0 +1,209 @@
+"""Store-backed KV-router decision cache: cross-process sticky routing.
+
+With one frontend process, stickiness is emergent: the process's own
+radix index (or ApproxKvIndexer) remembers where it sent a conversation,
+so the follow-up turn scores highest on the same engine. With N processes
+behind one port, turn 2 can land on a frontend whose index has never seen
+the conversation — the KV events may still be in flight, and in
+``use_kv_events=False`` mode they never arrive at all.
+
+This cache closes that gap through the existing store:
+
+- after a placement streams its first token, the routing frontend writes
+  ``fleet/<fleet_id>/route/<model>/<deepest block hash>`` → worker id;
+- every frontend mirrors the prefix via a store watch, so lookups are a
+  local dict probe on the routing hot path (no store round-trip);
+- a follow-up turn's block-hash chain *extends* the previous turn's, so
+  scanning the new request's hashes deepest-first finds the prior
+  decision and its shared-prefix depth — fed to the scheduler as an
+  overlap floor, not a hard override (a better live-index match or a
+  dead worker still wins).
+
+Entries expire by riding **rotating leases**: writes attach to a lease
+with ``ttl = decision_ttl`` that is never kept alive; a fresh lease is
+granted each half-TTL, so an entry lives between TTL/2 and TTL and the
+store reclaims it (emitting DELETEs that prune every mirror). On drain
+the process revokes its active leases outright — a restarting fleet must
+not serve yesterday's placements (see docs/frontend-fleet.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import time
+
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.store import EventKind, KeyValueStore
+
+log = get_logger("fleet.decisions")
+
+
+def route_prefix(fleet_id: str, scope: str | None = None) -> str:
+    base = f"fleet/{fleet_id}/route/"
+    return base if scope is None else f"{base}{scope}/"
+
+
+class RouterDecisionCache:
+    """One per frontend process; scoped per model via :meth:`scoped`."""
+
+    def __init__(
+        self,
+        store: KeyValueStore,
+        fleet_id: str,
+        ttl: float = 120.0,
+        metrics: dict | None = None,
+        clock=time.monotonic,
+    ):
+        self.store = store
+        self.fleet_id = fleet_id
+        self.ttl = ttl
+        self._mirror: dict[tuple[str, int], tuple[int, int]] = {}
+        self._watch = None
+        self._watch_task: asyncio.Task | None = None
+        self._lease_id: int | None = None
+        self._lease_born = 0.0
+        self._active_leases: list[int] = []
+        self._bg: set[asyncio.Task] = set()
+        self._closed = False
+        self._clock = clock
+        self._m = metrics or {}
+
+    async def start(self) -> "RouterDecisionCache":
+        self._watch = await self.store.watch_prefix(route_prefix(self.fleet_id))
+        for entry in self._watch.snapshot:
+            self._apply(entry.key, entry.value)
+        self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
+        return self
+
+    async def close(self, flush: bool = False) -> None:
+        """Stop mirroring; ``flush=True`` (the SIGTERM drain path) revokes
+        the active write leases so this process's entries vanish NOW
+        instead of lingering up to the TTL."""
+        if self._closed:
+            return
+        self._closed = True
+        for t in list(self._bg):
+            t.cancel()
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._watch_task
+        if self._watch is not None:
+            await self._watch.cancel()
+        if flush:
+            for lease_id in self._active_leases:
+                with contextlib.suppress(Exception):
+                    await self.store.revoke_lease(lease_id)
+        self._active_leases.clear()
+
+    # -- mirror ------------------------------------------------------------
+
+    def _parse_key(self, key: str) -> tuple[str, int] | None:
+        rest = key[len(route_prefix(self.fleet_id)) :]
+        scope, _, h = rest.rpartition("/")
+        if not scope:
+            return None
+        try:
+            return scope, int(h, 16)
+        except ValueError:
+            return None
+
+    def _apply(self, key: str, value: bytes | None) -> None:
+        parsed = self._parse_key(key)
+        if parsed is None:
+            return
+        if value is None:
+            self._mirror.pop(parsed, None)
+        else:
+            try:
+                d = json.loads(value)
+                self._mirror[parsed] = (int(d["w"]), int(d["b"]))
+            except (ValueError, KeyError, TypeError):
+                log.warning("bad decision entry at %s", key)
+                return
+        if "entries" in self._m:
+            self._m["entries"].set(len(self._mirror))
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for ev in self._watch:
+                self._apply(ev.key, ev.value if ev.kind == EventKind.PUT else None)
+        except asyncio.CancelledError:
+            pass
+
+    # -- read/write --------------------------------------------------------
+
+    def lookup(self, scope: str, hashes: list[int]) -> tuple[int, int] | None:
+        """→ (worker_id, shared_prefix_blocks) for the deepest cached
+        decision along this request's hash chain, or None. Local-only."""
+        for i in range(len(hashes) - 1, -1, -1):
+            hit = self._mirror.get((scope, hashes[i]))
+            if hit is not None:
+                if "hits" in self._m:
+                    self._m["hits"].inc(model=scope)
+                return hit[0], i + 1
+        return None
+
+    def record(self, scope: str, hashes: list[int], worker: int) -> None:
+        """Publish a placement (fire-and-forget: the routing hot path
+        must not wait on the store)."""
+        if not hashes or self._closed:
+            return
+        key_tuple = (scope, hashes[-1])
+        if self._mirror.get(key_tuple, (None,))[0] == worker:
+            return  # already published (the common repeated-turn case)
+        # Optimistic local insert so back-to-back turns on THIS process
+        # hit before the watch echo arrives.
+        self._mirror[key_tuple] = (worker, len(hashes))
+        task = asyncio.get_running_loop().create_task(
+            self._write(scope, hashes[-1], worker, len(hashes))
+        )
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    async def _write(self, scope: str, h: int, worker: int, blocks: int) -> None:
+        try:
+            lease = await self._write_lease()
+            await self.store.put(
+                f"{route_prefix(self.fleet_id, scope)}{h:016x}",
+                json.dumps({"w": worker, "b": blocks}).encode(),
+                lease_id=lease,
+            )
+            if "writes" in self._m:
+                self._m["writes"].inc(model=scope)
+        except Exception as e:  # noqa: BLE001 — the cache is a routing hint; losing a write only costs stickiness, never a request
+            log.warning("decision write failed: %s", e)
+            # Drop the optimistic insert: an entry that never reached the
+            # store has no DELETE event coming to prune it.
+            if self._mirror.get((scope, h), (None,))[0] == worker:
+                self._mirror.pop((scope, h), None)
+
+    async def _write_lease(self) -> int:
+        now = self._clock()
+        if self._lease_id is None or now - self._lease_born > self.ttl / 2:
+            self._lease_id = await self.store.grant_lease(self.ttl)
+            self._lease_born = now
+            self._active_leases.append(self._lease_id)
+            # Leases older than one TTL have expired server-side already.
+            if len(self._active_leases) > 3:
+                self._active_leases = self._active_leases[-3:]
+        return self._lease_id
+
+    def scoped(self, scope: str) -> "ScopedDecisions":
+        return ScopedDecisions(self, scope)
+
+
+class ScopedDecisions:
+    """Per-model handle the KvPushRouter holds (model slug pre-bound)."""
+
+    def __init__(self, cache: RouterDecisionCache, scope: str):
+        self.cache = cache
+        self.scope = scope
+
+    def lookup(self, hashes: list[int]) -> tuple[int, int] | None:
+        return self.cache.lookup(self.scope, hashes)
+
+    def record(self, hashes: list[int], worker: int) -> None:
+        self.cache.record(self.scope, hashes, worker)

@@ -3,6 +3,13 @@
 Flags mirror the reference frontend (components/frontend/src/dynamo/
 frontend/main.py:69-187): router mode, KV overlap weight, router
 temperature, KV-events toggle.
+
+Fleet mode (``--fleet N``) delegates to the fleet supervisor
+(dynamo_tpu/fleet/supervisor.py): N copies of this process share one
+listen port, lease admission slots from a global budget through the
+store, and keep KV-router stickiness consistent via the shared decision
+cache. The per-child wiring lives in :func:`async_main` below — a fleet
+child is just this CLI with ``--fleet-worker-id`` set.
 """
 
 from __future__ import annotations
@@ -10,8 +17,11 @@ from __future__ import annotations
 import argparse
 import asyncio
 import contextlib
+import json
 import os
 import signal
+import socket
+import sys
 
 from dynamo_tpu.kv_router.router import KvRouterConfig
 from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
@@ -55,11 +65,42 @@ def parse_args(argv=None):
     p.add_argument("--request-timeout", type=float, default=None,
                    help="default end-to-end deadline (s) when the client "
                         "sends no X-Request-Timeout (0 = none)")
-    return p.parse_args(argv)
+    # Frontend fleet (docs/frontend-fleet.md). --fleet N supervises N
+    # child copies of this CLI sharing one port; the remaining flags
+    # configure fleet-wide behaviour and are inherited by children.
+    p.add_argument("--fleet", type=int, default=0,
+                   help="spawn and supervise N frontend processes sharing "
+                        "this port (0 = single process)")
+    p.add_argument("--fleet-id", default="default",
+                   help="store namespace for this fleet's budget leases, "
+                        "decision cache, and registrations")
+    p.add_argument("--fleet-admin-port", type=int, default=0,
+                   help="supervisor aggregation endpoint port "
+                        "(merged /metrics + /debug/requests; 0 = ephemeral)")
+    p.add_argument("--global-max-inflight", type=int, default=None,
+                   help="fleet-wide concurrent-request budget leased in "
+                        "chunks through the store; without --fleet it "
+                        "applies as the local admission bound "
+                        "(default: DYNTPU_FLEET_GLOBAL_MAX_INFLIGHT; 0 = off)")
+    p.add_argument("--budget-chunk", type=int, default=None,
+                   help="slots per budget chunk (claim granularity)")
+    # Internal (set by the fleet supervisor on child processes).
+    p.add_argument("--fleet-worker-id", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--reuse-port", action="store_true", help=argparse.SUPPRESS)
+    p.add_argument("--inherited-socket-fd", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+    if args.fleet and args.fleet_worker_id is not None:
+        p.error("--fleet and --fleet-worker-id are mutually exclusive")
+    return args
 
 
 async def async_main(args) -> None:
     rt = await DistributedRuntime.create(store_url=args.store_url)
+    fleet_child = args.fleet_worker_id is not None
+    fcfg = rt.config.fleet
+
     settings = RouterSettings(mode=RouterMode(args.router_mode), record_dir=args.record_dir)
     if settings.mode == RouterMode.KV:
         settings.kv = KvRouterConfig(
@@ -68,25 +109,121 @@ async def async_main(args) -> None:
             use_kv_events=not args.no_kv_events,
             index_shards=args.index_shards,
         )
+
+    fleet_metrics = budget = decisions = None
+    if fleet_child:
+        from dynamo_tpu.fleet import register_fleet_child_metrics
+        from dynamo_tpu.fleet.decisions import RouterDecisionCache
+
+        fleet_metrics = register_fleet_child_metrics(rt.metrics)
+        # Sticky routing across sibling processes: every KV placement is
+        # published to (and mirrored from) the store-backed decision
+        # cache, so a follow-up turn accepted by a different frontend
+        # still lands on the engine holding its prefix.
+        decisions = await RouterDecisionCache(
+            rt.store, args.fleet_id, ttl=fcfg.decision_ttl,
+            metrics={
+                "entries": fleet_metrics["decision_entries"],
+                "hits": fleet_metrics["decision_hits"],
+                "writes": fleet_metrics["decision_writes"],
+            },
+        ).start()
+        settings.decisions = decisions
+
     manager = ModelManager(rt, settings)
     watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
+
     acfg = rt.config.admission
-    admission = AdmissionController(
-        max_inflight=acfg.max_inflight if args.max_inflight is None else args.max_inflight,
-        max_queue_depth=acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth,
-        retry_after=acfg.retry_after,
-        queue_timeout=acfg.queue_timeout,
+    global_budget = (
+        fcfg.global_max_inflight if args.global_max_inflight is None
+        else args.global_max_inflight
     )
+    if fleet_child and global_budget > 0:
+        from dynamo_tpu.fleet.budget import BudgetedAdmissionController, GlobalBudget
+
+        # Per-process gate leasing slot chunks from the fleet-wide
+        # budget; the store's create-if-absent makes over-admission
+        # impossible and the primary lease's TTL returns this process's
+        # chunks if it dies without draining.
+        budget = GlobalBudget(
+            rt.store, args.fleet_id, await rt.primary_lease(),
+            total=global_budget,
+            chunk_slots=(
+                fcfg.budget_chunk_slots if args.budget_chunk is None
+                else args.budget_chunk
+            ),
+            worker_id=args.fleet_worker_id,
+            metrics={
+                "slots": fleet_metrics["budget_slots"],
+                "chunks": fleet_metrics["budget_chunks"],
+                "claims": fleet_metrics["budget_claims"],
+            },
+        )
+        kw = {"retry_after": acfg.retry_after, "queue_timeout": acfg.queue_timeout}
+        qdepth = acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth
+        if qdepth > 0:  # 0 = keep the controller's budget-aware default
+            kw["max_queue_depth"] = qdepth
+        admission: AdmissionController = BudgetedAdmissionController(budget, **kw)
+        await budget.start()
+    else:
+        max_inflight = acfg.max_inflight if args.max_inflight is None else args.max_inflight
+        if global_budget > 0 and args.max_inflight is None:
+            # Single process: the fleet-wide budget degenerates to a
+            # plain local bound — silently ignoring the flag would leave
+            # the frontend unbounded while the operator believes a cap
+            # is in force.
+            max_inflight = global_budget
+            log.info(
+                "single-process frontend: --global-max-inflight %d applied "
+                "as the local admission bound", global_budget,
+            )
+        admission = AdmissionController(
+            max_inflight=max_inflight,
+            max_queue_depth=acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth,
+            retry_after=acfg.retry_after,
+            queue_timeout=acfg.queue_timeout,
+        )
     default_timeout = (
         rt.config.runtime.default_request_timeout
         if args.request_timeout is None
         else args.request_timeout
     )
+    inherited = None
+    if args.inherited_socket_fd is not None:
+        # dyntpu: allow[DT002] reason=wrapping an inherited, already-listening fd in a socket object does no I/O; aiohttp serves it async
+        inherited = socket.socket(fileno=args.inherited_socket_fd)
     http = await HttpService(
         manager, rt.metrics, health=rt.health, host=args.host, port=args.port,
         admission=admission, default_timeout=default_timeout,
+        reuse_port=args.reuse_port, sock=inherited,
+        admin_port=0 if fleet_child else None,
     ).start()
-    print(f"dynamo_tpu frontend: http://{args.host}:{http.port}", flush=True)
+
+    reg_key = None
+    if fleet_child:
+        from dynamo_tpu.fleet.supervisor import frontends_prefix
+
+        # Lease-backed registration: the supervisor's aggregator finds
+        # this process's admin site here, and the entry vanishes with
+        # the lease if the process dies.
+        reg_key = frontends_prefix(args.fleet_id) + str(args.fleet_worker_id)
+        await rt.store.put(
+            reg_key,
+            json.dumps({
+                "pid": os.getpid(),
+                "host": args.host,
+                "port": http.port,
+                "admin": f"http://127.0.0.1:{http.admin_port}",
+            }).encode(),
+            lease_id=await rt.primary_lease(),
+        )
+        print(
+            f"dynamo_tpu frontend [fleet {args.fleet_id}/{args.fleet_worker_id}]: "
+            f"http://{args.host}:{http.port} admin http://127.0.0.1:{http.admin_port}",
+            flush=True,
+        )
+    else:
+        print(f"dynamo_tpu frontend: http://{args.host}:{http.port}", flush=True)
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -103,23 +240,62 @@ async def async_main(args) -> None:
             loop.add_signal_handler(sig, on_signal)
     await stop.wait()
     # Graceful drain: stop admitting (503 + Retry-After), let in-flight
-    # streams run to completion, then tear the planes down.
+    # streams run to completion, then tear the planes down. Under a
+    # supervisor the process must ALSO hand its shared state back before
+    # exit: admission-budget chunks are released as streams finish (a
+    # BudgetedAdmissionController's start_draining puts the budget in
+    # drain mode) and the router decision-cache leases are revoked —
+    # without this they linger until their TTLs while the replacement
+    # process serves (the single-process drain never had shared state).
     log.info("frontend draining (%d in flight)", admission.inflight)
+    if fleet_child:
+        # Leave the shared-port accept group first: new connections land
+        # on siblings; only connections already accepted here can still
+        # see the (retryable) drain 503.
+        await http.stop_accepting()
     http.start_draining()
     drained = await http.wait_drained(rt.config.runtime.graceful_shutdown_timeout)
     if not drained:
         log.warning(
             "drain timeout: %d streams still in flight at shutdown", admission.inflight
         )
-    log.info("frontend shutting down")
-    await http.close()
-    await watcher.close()
-    await manager.close()
-    await rt.shutdown()
+    async def teardown() -> None:
+        if reg_key is not None:
+            with contextlib.suppress(Exception):
+                await rt.store.delete(reg_key)
+        # HTTP closes BEFORE the budget releases: on a drain timeout the
+        # undrained streams are cut here, so every slot the close()
+        # below hands back really is free — releasing while streams
+        # still ran would let siblings admit on top of them and break
+        # the fleet-wide admitted ≤ budget invariant.
+        await http.close()
+        if budget is not None:
+            await budget.close()  # return every held chunk NOW, not at lease TTL
+        if decisions is not None:
+            await decisions.close(flush=True)  # revoke decision leases NOW
+        log.info("frontend shutting down")
+        await watcher.close()
+        await manager.close()
+        await rt.shutdown()
+
+    try:
+        # Bounded: with the drain complete, clients are served — teardown
+        # must not hang the process on a dead control plane (a store that
+        # exited first leaves half-open connections; the supervisor would
+        # otherwise have to SIGKILL us and lease TTLs do the cleanup).
+        await asyncio.wait_for(teardown(), timeout=15.0)
+    except Exception as e:  # noqa: BLE001 — exit anyway (incl. teardown timeout): every lease-backed key self-cleans via TTL
+        log.warning("teardown incomplete (%s: %s); exiting", type(e).__name__, e)
+        os._exit(0)
 
 
 def main(argv=None) -> int:
-    asyncio.run(async_main(parse_args(argv)))
+    args = parse_args(argv)
+    if args.fleet > 0:
+        from dynamo_tpu.fleet.supervisor import run_fleet
+
+        return run_fleet(args, list(argv if argv is not None else sys.argv[1:]))
+    asyncio.run(async_main(args))
     return 0
 
 

@@ -64,11 +64,16 @@ class KvPushRouter:
     """AsyncEngine shape over a DIRECT PushRouter."""
 
     def __init__(self, push_router: PushRouter, config: KvRouterConfig | None = None,
-                 event_sink=None):
+                 event_sink=None, decisions=None):
         self.config = config or KvRouterConfig()
         # callable(KVHitRateEvent) — routing-quality observability
         # (reference: scheduler.rs KVHitRateEvent → components/metrics).
         self.event_sink = event_sink
+        # Fleet sticky-routing cache (fleet/decisions.py ScopedDecisions):
+        # placements published by SIBLING frontend processes act as an
+        # overlap floor, so a conversation's follow-up turn routes to the
+        # engine holding its prefix no matter which process accepts it.
+        self.decisions = decisions
         self.push = push_router
         self.discovery = push_router.discovery
         self.messaging = push_router.messaging
@@ -177,6 +182,16 @@ class KvPushRouter:
         if not workers:
             raise NoInstancesError("no available instances")
         overlaps = self.index.find_matches(hashes)
+        if self.decisions is not None:
+            # Cross-process stickiness: a sibling's published placement is
+            # an overlap FLOOR fed to the same cost schedule — a deeper
+            # live-index match still wins, and a dead/excluded worker is
+            # simply not boosted (the index can't vouch for the cache).
+            cached = self.decisions.lookup(hashes)
+            if cached is not None:
+                wid, depth = cached
+                if wid in workers and depth > overlaps.scores.get(wid, 0):
+                    overlaps.scores[wid] = depth
         placement = self.scheduler.schedule(workers, request_blocks, overlaps, self.active)
         return placement, hashes, overlaps.scores, workers
 
@@ -258,6 +273,11 @@ class KvPushRouter:
                     if first:
                         first = False
                         self.active.mark_prefill_complete(context.id)
+                        if self.decisions is not None:
+                            # Publish only once the stream started: the
+                            # worker demonstrably accepted the request,
+                            # so its cache really holds this prefix.
+                            self.decisions.record(hashes, wid)
                     yield item
                 return
             except (

@@ -59,17 +59,31 @@ class HttpService:
         port: int = 8080,
         admission: AdmissionController | None = None,
         default_timeout: float = 0.0,
+        reuse_port: bool = False,
+        sock=None,
+        admin_port: int | None = None,
     ):
         self.manager = manager
         self.health = health
         self.host = host
         self.port = port
+        # Fleet socket sharing: reuse_port binds this process's own
+        # listener with SO_REUSEPORT (kernel spreads accepts across the
+        # fleet); sock serves an inherited, already-listening socket
+        # (platforms without SO_REUSEPORT). admin_port adds a second,
+        # per-process site on 127.0.0.1 so the supervisor can scrape
+        # THIS process's /metrics + /debug/requests — a GET against the
+        # shared port lands on an arbitrary sibling.
+        self.reuse_port = reuse_port
+        self.sock = sock
+        self.admin_port = admin_port
         # Admission gate for the inference surface; an unbounded controller
         # still tracks in-flight count so graceful drain works.
         self.admission = admission or AdmissionController()
         # Applied when the client sends no X-Request-Timeout (0 = none).
         self.default_timeout = default_timeout
         self._runner: web.AppRunner | None = None
+        self._main_site: web.BaseSite | None = None
         scope = metrics.child("http")
         self.m_requests = scope.counter("http_requests_total", "HTTP requests")
         self.m_inflight = scope.gauge("http_inflight", "In-flight requests")
@@ -110,11 +124,22 @@ class HttpService:
     async def start(self) -> "HttpService":
         self._runner = web.AppRunner(self.build_app(), access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
-        await site.start()
-        for s in self._runner.sites:
-            self.port = s._server.sockets[0].getsockname()[1]  # resolved when port=0
-            break
+        if self.sock is not None:
+            site: web.BaseSite = web.SockSite(self._runner, self.sock)
+            await site.start()
+            self.port = self.sock.getsockname()[1]
+        else:
+            site = web.TCPSite(
+                self._runner, self.host, self.port,
+                reuse_port=True if self.reuse_port else None,
+            )
+            await site.start()
+            self.port = site._server.sockets[0].getsockname()[1]  # resolved when port=0
+        self._main_site = site
+        if self.admin_port is not None:
+            admin = web.TCPSite(self._runner, "127.0.0.1", self.admin_port)
+            await admin.start()
+            self.admin_port = admin._server.sockets[0].getsockname()[1]
         log.info("http service listening on %s:%d", self.host, self.port)
         return self
 
@@ -126,6 +151,16 @@ class HttpService:
         """SIGTERM path step 1: refuse new inference requests (503 +
         Retry-After) while in-flight streams keep running."""
         self.admission.start_draining()
+
+    async def stop_accepting(self) -> None:
+        """Fleet drain step 0: close the main listener so this process
+        leaves the SO_REUSEPORT group (or stops competing on the
+        inherited socket) — new connections land only on siblings and
+        never see this process's drain 503s. In-flight connections and
+        the admin site stay up."""
+        if self._main_site is not None:
+            await self._main_site.stop()
+            self._main_site = None
 
     async def wait_drained(self, timeout: float | None = None) -> bool:
         """SIGTERM path step 2: wait for in-flight streams to finish.

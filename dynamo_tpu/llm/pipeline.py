@@ -36,6 +36,10 @@ class RouterSettings:
     # <record_dir>/<model>.jsonl (llm/recorder.py; reference: perf.rs +
     # recorder.rs replayable captures).
     record_dir: str | None = None
+    # Fleet cross-process sticky routing (fleet/decisions.py): one
+    # store-backed RouterDecisionCache per frontend process, scoped per
+    # model for each KvPushRouter. None outside fleet mode.
+    decisions: Any | None = None
 
 
 class _RouterEngine:
@@ -94,8 +98,13 @@ class ModelPipeline:
             push = await ep.router(RouterMode.DIRECT)
             kv_cfg = self.settings.kv or KvRouterConfig()
             kv_cfg.block_size = self.card.kv_cache_block_size
+            decisions = (
+                self.settings.decisions.scoped(self.card.slug)
+                if self.settings.decisions is not None else None
+            )
             self.kv_router = await KvPushRouter(
-                push, kv_cfg, event_sink=self._make_hit_rate_sink()
+                push, kv_cfg, event_sink=self._make_hit_rate_sink(),
+                decisions=decisions,
             ).start()
             engine = self.kv_router
         else:

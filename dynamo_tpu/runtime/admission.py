@@ -42,7 +42,14 @@ class AdmissionController:
     Freed slots are handed to queued waiters in strict FIFO order by
     ``release()`` itself (the waiter's future is resolved with the slot
     already assigned) — new arrivals can neither barge past the queue via
-    the fast path nor race a wakeup, so no waiter can be starved."""
+    the fast path nor race a wakeup, so no waiter can be starved.
+
+    Subclasses with externally-leased capacity (the fleet's
+    ``BudgetedAdmissionController``) set ``allow_unbounded = False`` so
+    ``max_inflight == 0`` means *no slots leased yet* (queue and wait)
+    rather than "unlimited", and drive the limit via ``set_limit``."""
+
+    allow_unbounded = True
 
     def __init__(
         self,
@@ -86,7 +93,7 @@ class AdmissionController:
             raise AdmissionRejected(
                 "service is draining", self.retry_after, draining=True
             )
-        if self.max_inflight <= 0 or (
+        if (self.max_inflight <= 0 and self.allow_unbounded) or (
             self._inflight < self.max_inflight and not self._waiters
         ):
             self._admit()
@@ -142,6 +149,14 @@ class AdmissionController:
                 continue
             self._admit()  # on the waiter's behalf, before it even wakes
             fut.set_result(None)
+
+    def set_limit(self, max_inflight: int) -> None:
+        """Adjust capacity at runtime (budget lease grew or shrank). A
+        raised limit hands the new slots to queued waiters immediately;
+        a lowered one simply stops further admissions — in-flight
+        requests above the new bound run to completion."""
+        self.max_inflight = max_inflight
+        self._hand_off()
 
     def start_draining(self) -> None:
         """Refuse all new admissions from now on (SIGTERM path); queued

@@ -48,12 +48,13 @@ class ChaosStats:
     streams_truncated: int = 0
     kills: int = 0
     transfer_cuts: int = 0
+    frontend_kills: int = 0
     latency_injections: int = 0
 
     def total(self) -> int:
         return (
             self.frames_dropped + self.streams_truncated + self.kills
-            + self.transfer_cuts
+            + self.transfer_cuts + self.frontend_kills
         )
 
 
@@ -133,6 +134,23 @@ class ChaosInjector:
             self.stats.transfer_cuts += 1
             self._count("transfer_cut")
             raise ChaosKillError("injected kv-transfer death")
+
+    def maybe_kill_frontend(self, candidates: list):
+        """Consulted once per fleet-supervisor monitor tick: on a hit,
+        → a (seeded-)random pick from ``candidates`` for the supervisor
+        to SIGKILL — a frontend process dying under live traffic. The
+        supervisor must restart it with backoff and the store lease TTL
+        must return its admission-budget chunks (tests/test_fleet_chaos.py
+        pins both). → None on no fault or no candidates."""
+        if (
+            not candidates
+            or self.config.frontend_kill_p <= 0
+            or self.rng.random() >= self.config.frontend_kill_p
+        ):
+            return None
+        self.stats.frontend_kills += 1
+        self._count("frontend_kill")
+        return self.rng.choice(candidates)
 
     async def inject_latency(self) -> None:
         """Sleep a seeded uniform delay in [0, latency_ms]."""

@@ -143,6 +143,10 @@ class ChaosConfig:
     # cuts the connection AFTER a chunk — the prefill worker "dying
     # between chunks" mid-transfer.
     transfer_cut_p: float = 0.0
+    # Probability (per fleet-supervisor monitor tick) a random frontend
+    # child is SIGKILLed — exercises restart backoff + budget-lease
+    # reclamation while sibling processes keep streaming.
+    frontend_kill_p: float = 0.0
     # Injected per-frame latency: uniform in [0, latency_ms].
     latency_ms: float = 0.0
 
@@ -152,12 +156,42 @@ class ChaosConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Frontend fleet (section ``[fleet]``, env ``DYNTPU_FLEET_*``):
+    multi-process HTTP tier knobs (dynamo_tpu/fleet/)."""
+
+    # Fleet-wide concurrent-request budget shared by every frontend
+    # process through store chunk leases (0 = no shared budget; each
+    # process falls back to its own [admission] bounds).
+    global_max_inflight: int = 0
+    # Slots per budget chunk — the claim granularity. Smaller chunks
+    # pack tighter under skewed load; larger ones claim less often.
+    budget_chunk_slots: int = 8
+    # Seconds a published router decision stays visible to sibling
+    # processes (rotating write leases; entries live TTL/2..TTL).
+    decision_ttl: float = 120.0
+    # Supervisor restart hygiene: jittered exponential backoff between
+    # respawns of a crashing child, reset once it survives reset_after.
+    restart_backoff_base: float = 0.5
+    restart_backoff_max: float = 10.0
+    restart_reset_after: float = 30.0
+    # Supervisor crash-detection poll interval (also the chaos
+    # frontend-kill draw cadence).
+    monitor_interval: float = 0.25
+
+    @classmethod
+    def section(cls) -> str:
+        return "fleet"
+
+
+@dataclass
 class Config:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     store: StoreConfig = field(default_factory=StoreConfig)
     system: SystemConfig = field(default_factory=SystemConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     @classmethod
     def from_env(cls, env: dict[str, str] | None = None) -> "Config":
@@ -175,7 +209,7 @@ class Config:
                 layers = tomllib.load(f)
 
         cfg = cls()
-        for section_obj in (cfg.runtime, cfg.store, cfg.system, cfg.admission, cfg.chaos):
+        for section_obj in (cfg.runtime, cfg.store, cfg.system, cfg.admission, cfg.chaos, cfg.fleet):
             section = section_obj.section()
             toml_section = layers.get(section, {})
             for f_ in dataclasses.fields(section_obj):

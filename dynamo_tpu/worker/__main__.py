@@ -124,6 +124,11 @@ def parse_args(argv=None):
                    help="first port of the per-rank port blocks (dp_rank_ports)")
     p.add_argument("--dp-chips-per-rank", type=int, default=0,
                    help="pin TPU_VISIBLE_CHIPS=[r*k, (r+1)*k) per rank (0 = no pinning)")
+    p.add_argument("--dp-restart", action="store_true",
+                   help="restart a crashed dp rank with jittered exponential "
+                        "backoff (fleet supervision hygiene, "
+                        "dynamo_tpu/fleet/supervisor.py) instead of letting "
+                        "the slot stay down until the spawner exits")
     # multi-host: ONE logical worker spanning several processes/hosts.
     # Launch one process per host; process 0 serves the endpoint, the
     # rest replay its dispatch stream (engine/runner.py). All processes
@@ -471,11 +476,16 @@ def run_dp_spawner(args, argv) -> int:
     independent replicas of the same model: a dead rank loses only its
     own KV and lease — the rest keep serving, so the spawner does not
     gang-kill on a single failure; it forwards SIGINT/SIGTERM and exits
-    with the worst child code once all ranks are done."""
+    with the worst child code once all ranks are done. With
+    ``--dp-restart`` a dead rank is respawned after jittered exponential
+    backoff (the frontend fleet's supervision hygiene,
+    fleet/supervisor.py:BackoffPolicy) — the replacement re-registers
+    under a fresh lease and the router folds it back in."""
     import os
     import signal as sig
     import subprocess
     import sys
+    import time
 
     base = [a for a in (argv if argv is not None else sys.argv[1:])]
     procs: list[subprocess.Popen] = []
@@ -492,22 +502,25 @@ def run_dp_spawner(args, argv) -> int:
     # ranks already running, or they orphan with chips and leases held.
     sig.signal(sig.SIGTERM, forward)
     sig.signal(sig.SIGINT, forward)
+    def spawn_rank(r: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        if args.dp_chips_per_rank > 0:
+            k = args.dp_chips_per_rank
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in range(r * k, (r + 1) * k))
+        if env.get("DYNTPU_SYSTEM_ENABLED"):
+            env["DYNTPU_SYSTEM_PORT"] = str(
+                dp_rank_ports(args.dp_base_port, r)["system"]
+            )
+        return subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.worker", *base, "--dp-rank", str(r)],
+            env=env,
+        )
+
     try:
         for r in range(args.dp_size):
             if stopping:
                 break
-            env = dict(os.environ)
-            if args.dp_chips_per_rank > 0:
-                k = args.dp_chips_per_rank
-                env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in range(r * k, (r + 1) * k))
-            if env.get("DYNTPU_SYSTEM_ENABLED"):
-                env["DYNTPU_SYSTEM_PORT"] = str(
-                    dp_rank_ports(args.dp_base_port, r)["system"]
-                )
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "dynamo_tpu.worker", *base, "--dp-rank", str(r)],
-                env=env,
-            ))
+            procs.append(spawn_rank(r))
     except Exception:
         # A failed spawn must not leave earlier ranks orphaned (they hold
         # chips and store leases with nobody to signal them).
@@ -521,6 +534,61 @@ def run_dp_spawner(args, argv) -> int:
             if p.poll() is None:
                 p.terminate()
     print(f"dynamo_tpu dp spawner: {args.dp_size} ranks launched", flush=True)
+    if args.dp_restart and not stopping:
+        # Fleet supervision hygiene for dp ranks: respawn a dead rank
+        # after jittered exponential backoff instead of serving degraded
+        # until an operator notices. A rank is an independent replica, so
+        # the restart is invisible to its siblings.
+        from dynamo_tpu.fleet.backoff import BackoffPolicy
+        from dynamo_tpu.runtime.config import Config
+
+        # Same knobs as the frontend fleet's restarts: an operator tuning
+        # DYNTPU_FLEET_RESTART_BACKOFF_* tunes BOTH supervision paths.
+        fcfg = Config.from_env().fleet
+        backoff = BackoffPolicy(
+            fcfg.restart_backoff_base,
+            fcfg.restart_backoff_max,
+            fcfg.restart_reset_after,
+        )
+        failures = [0] * len(procs)
+        started = [time.monotonic()] * len(procs)
+        restart_at = [0.0] * len(procs)
+        while not stopping:
+            now = time.monotonic()
+            for r, p in enumerate(procs):
+                # rc=0 is a deliberate exit (operator SIGTERMed the rank
+                # directly, or it finished): leave the slot down — only
+                # CRASHED ranks restart, as the flag advertises.
+                if p.poll() is None or p.returncode == 0:
+                    continue
+                if restart_at[r] == 0.0:
+                    if now - started[r] > backoff.reset_after:
+                        failures[r] = 0
+                    failures[r] += 1
+                    restart_at[r] = now + backoff.delay(failures[r])
+                    print(
+                        f"dynamo_tpu dp spawner: rank {r} exited rc={p.returncode}, "
+                        f"restart in {restart_at[r] - now:.2f}s", flush=True,
+                    )
+                elif now >= restart_at[r] and not stopping:
+                    try:
+                        procs[r] = spawn_rank(r)
+                    except Exception:
+                        # Same rule as the startup loop: a failed spawn
+                        # must not leave live ranks orphaned with chips
+                        # and leases held and nobody to signal them.
+                        for q in procs:
+                            if q.poll() is None:
+                                q.terminate()
+                        raise
+                    started[r] = now
+                    restart_at[r] = 0.0
+            if all(p.poll() is not None and p.returncode == 0 for p in procs):
+                break  # every rank exited cleanly: nothing left to supervise
+            time.sleep(0.25)
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(sig.SIGTERM)
     rcs = [p.wait() for p in procs]
     return max((abs(rc) for rc in rcs), default=0)
 

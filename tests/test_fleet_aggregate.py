@@ -1,0 +1,86 @@
+"""Fleet aggregation units: exposition relabel/merge and the ledger
+merge, plus supervisor helpers that don't need processes (backoff
+policy shape, supervisor-flag stripping)."""
+
+from dynamo_tpu.fleet.aggregate import merge_ledgers, merge_metrics, relabel_sample
+from dynamo_tpu.fleet.supervisor import BackoffPolicy, strip_supervisor_flags
+
+
+def test_relabel_sample_variants():
+    assert (
+        relabel_sample('m_total{a="x"} 3', "fleet_worker_id", "1")
+        == 'm_total{fleet_worker_id="1",a="x"} 3'
+    )
+    assert (
+        relabel_sample("m_total 3", "fleet_worker_id", "0")
+        == 'm_total{fleet_worker_id="0"} 3'
+    )
+    assert relabel_sample("# HELP m_total x", "w", "0") is None
+    assert relabel_sample("", "w", "0") is None
+    # Histogram 'le' labels survive (injected label leads).
+    out = relabel_sample('h_bucket{le="+Inf"} 7', "w", "2")
+    assert out == 'h_bucket{w="2",le="+Inf"} 7'
+
+
+def test_merge_metrics_groups_families_and_relabels():
+    e0 = (
+        "# HELP dt_req_total requests\n"
+        "# TYPE dt_req_total counter\n"
+        'dt_req_total{model="m"} 3\n'
+        "# HELP dt_lat latency\n"
+        "# TYPE dt_lat histogram\n"
+        'dt_lat_bucket{le="+Inf"} 2\n'
+        "dt_lat_sum 0.5\n"
+        "dt_lat_count 2\n"
+    )
+    e1 = (
+        "# HELP dt_req_total requests\n"
+        "# TYPE dt_req_total counter\n"
+        'dt_req_total{model="m"} 5\n'
+    )
+    merged = merge_metrics([("0", e0), ("1", e1)])
+    lines = merged.splitlines()
+    # One header per family, samples from both children contiguous.
+    assert lines.count("# TYPE dt_req_total counter") == 1
+    i0 = lines.index('dt_req_total{fleet_worker_id="0",model="m"} 3')
+    i1 = lines.index('dt_req_total{fleet_worker_id="1",model="m"} 5')
+    itype = lines.index("# TYPE dt_req_total counter")
+    assert itype < i0 < i1
+    # Histogram child samples land under the dt_lat family header, not
+    # as their own families.
+    assert 'dt_lat_bucket{fleet_worker_id="0",le="+Inf"} 2' in lines
+    assert "# TYPE dt_lat histogram" in lines
+    assert lines.index("# TYPE dt_lat histogram") < lines.index(
+        'dt_lat_sum{fleet_worker_id="0"} 0.5'
+    )
+
+
+def test_merge_ledgers_tags_and_flags():
+    merged = merge_ledgers([
+        ("0", {"enabled": False, "requests": [{"trace_id": "a"}]}),
+        ("1", {"enabled": True, "requests": [{"trace_id": "b"}]}),
+    ])
+    assert merged["enabled"] is True
+    assert {r["fleet_worker_id"] for r in merged["requests"]} == {"0", "1"}
+
+
+def test_backoff_policy_is_jittered_exponential_and_capped():
+    import random
+
+    bp = BackoffPolicy(base=0.5, max_delay=4.0, rng=random.Random(7))
+    d1 = [bp.delay(1) for _ in range(50)]
+    d4 = [bp.delay(4) for _ in range(50)]
+    assert all(0.25 <= d < 0.75 for d in d1)  # base * [0.5, 1.5)
+    assert all(2.0 <= d < 6.0 for d in d4)    # capped at max_delay, then jitter
+    assert len(set(d1)) > 1  # actually jittered
+
+
+def test_strip_supervisor_flags():
+    argv = ["--fleet", "4", "--fleet-admin-port", "9", "--port", "8080",
+            "--store-url", "tcp://h:1", "--fleet-id", "f", "--router-mode", "kv"]
+    assert strip_supervisor_flags(argv) == [
+        "--store-url", "tcp://h:1", "--fleet-id", "f", "--router-mode", "kv",
+    ]
+    assert strip_supervisor_flags(["--fleet=4", "--port=0", "--host", "h"]) == [
+        "--host", "h",
+    ]

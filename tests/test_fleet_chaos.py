@@ -1,0 +1,119 @@
+"""Seeded chaos: the kill-a-frontend-process fault.
+
+`DYNTPU_CHAOS_FRONTEND_KILL_P` makes the fleet supervisor SIGKILL a
+(seeded-)random child per monitor tick. Under continuous traffic the
+fleet must keep serving: the supervisor restarts victims with backoff,
+their leased admission-budget chunks return via the store's lease
+machinery (so the claimed-chunk count can never exceed the chunk
+count), and streams on sibling processes finish with full token counts
+— only connections pinned to a victim see a transport error, the same
+signal a crashed worker produces."""
+
+import signal
+import time
+
+import httpx
+import pytest
+
+from test_fleet_supervisor import FleetHarness
+
+pytestmark = [pytest.mark.e2e, pytest.mark.chaos]
+
+
+def test_frontend_kill_chaos_restarts_and_keeps_serving():
+    with FleetHarness(
+        n=2,
+        extra_args=["--global-max-inflight", "16", "--budget-chunk", "4"],
+        extra_env={
+            "DYNTPU_CHAOS_ENABLED": "1",
+            "DYNTPU_CHAOS_SEED": "1234",
+            "DYNTPU_CHAOS_FRONTEND_KILL_P": "0.10",
+            "DYNTPU_FLEET_MONITOR_INTERVAL": "0.2",
+        },
+    ) as h:
+        ok = transport_errors = 0
+        kills_seen = restarts_seen = 0
+        deadline = time.monotonic() + 40
+        while time.monotonic() < deadline:
+            try:
+                r = h.chat("under chaos", max_tokens=4)
+                if r.status_code == 200:
+                    ok += 1
+                else:
+                    # Shed/draining responses are typed, never hangs.
+                    assert r.status_code in (429, 503), r.status_code
+            except (httpx.HTTPError, OSError):
+                # Connection landed on a child at the instant of its
+                # death — detectable transport cut, like a dead worker.
+                transport_errors += 1
+            m = httpx.get(f"{h.admin}/metrics", timeout=10).text
+            for line in m.splitlines():
+                if line.startswith("dynamo_tpu_chaos_injections_total") and 'kind="frontend_kill"' in line:
+                    kills_seen = int(float(line.rsplit(" ", 1)[1]))
+            restarts_seen = sum(
+                w["restarts"] for w in h.status()["workers"]
+            )
+            if kills_seen >= 2 and restarts_seen >= 2 and ok >= 10:
+                break
+            time.sleep(0.2)
+        assert kills_seen >= 2, f"chaos never killed a frontend ({kills_seen})"
+        assert restarts_seen >= 2, f"supervisor never restarted ({restarts_seen})"
+        assert ok >= 10, f"fleet stopped serving under chaos (ok={ok})"
+
+        # Budget sanity THROUGH the chaos: chunks claimed never exceed
+        # the chunk count (16 slots / 4 per chunk = 4) — a victim's
+        # chunks were reclaimed, not duplicated.
+        assert h.status()["budget_chunks_claimed"] <= 4
+
+        # Fleet converges back to fully-ready once the dust settles
+        # (chaos keeps killing, so accept any moment of full health).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = h.status()
+            if all(w["alive"] and w["registered"] for w in st["workers"]):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"fleet never re-converged: {h.status()}")
+
+        # And in-flight streams on the SIBLING of a victim keep running:
+        # drive a slow stream, kill the OTHER child explicitly, assert
+        # full delivery.
+        st = h.status()
+        pids = {w["worker_id"]: w["pid"] for w in st["workers"] if w["alive"]}
+        import asyncio
+        import json as _json
+        import os
+
+        async def stream_and_kill():
+            async with httpx.AsyncClient(timeout=60) as client:
+                async with client.stream(
+                    "POST", f"{h.base}/v1/chat/completions",
+                    json={"model": "mock-model", "max_tokens": 30,
+                          "stream": True, "ignore_eos": True,
+                          "messages": [{"role": "user", "content": "sibling"}]},
+                    headers={"Connection": "close"},
+                ) as resp:
+                    assert resp.status_code == 200
+                    toks = 0
+                    killed = False
+                    async for line in resp.aiter_lines():
+                        if not killed:
+                            # The stream landed on SOME child; kill a
+                            # deterministic one — 50/50 it's the sibling.
+                            os.kill(pids[max(pids)], signal.SIGKILL)
+                            killed = True
+                        if line.startswith("data: ") and '"usage"' in line:
+                            u = _json.loads(line[6:]).get("usage")
+                            if u:
+                                toks = u["completion_tokens"]
+                    return toks
+
+        try:
+            toks = asyncio.run(stream_and_kill())
+        except (httpx.HTTPError, OSError):
+            # 50% chance the killed child held our stream — acceptable;
+            # the sibling-isolation guarantee is pinned deterministically
+            # in test_fleet_supervisor.py. Nothing more to assert here.
+            return
+        assert toks == 30, f"sibling stream truncated at {toks}"

@@ -1,0 +1,159 @@
+"""Cross-process sticky routing (fleet decision cache end to end).
+
+Two frontend "processes" (separate DistributedRuntimes, routers, and
+decision-cache mirrors over one shared store — process separation in
+everything but the pid) route a multi-turn conversation against one
+engine pair with a warm prefix on engine A. The KV index runs in
+``use_kv_events=False`` (TTL-predictive) mode, so frontend 2 has NO
+local signal about the conversation — without the shared decision cache
+its placement of a follow-up turn would be a coin flip. The assertions:
+every turn lands on engine A regardless of which frontend accepts it,
+and engine A's ``gpu_prefix_cache_hit_rate`` reflects the reuse."""
+
+import asyncio
+
+import httpx
+
+from dynamo_tpu.fleet.decisions import RouterDecisionCache
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.kv_router.router import KvRouterConfig
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+from dynamo_tpu.llm.pipeline import RouterSettings
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.push_router import RouterMode
+
+
+async def start_worker(store_url, namespace="fr"):
+    rt = await DistributedRuntime.create(store_url=store_url)
+    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0))
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+    comp = rt.namespace(namespace).component("backend")
+
+    async def gen_handler(payload, ctx):
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await comp.endpoint("generate").serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    card = ModelDeploymentCard(
+        name="mock-model", kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS], context_length=4096,
+    )
+    await register_model(rt, namespace, card)
+    return rt, engine
+
+
+async def start_fleet_frontend(store_url, fleet_id="frtest"):
+    """One fleet-child-shaped frontend: own runtime + own decision-cache
+    mirror over the shared store, approx (event-less) KV index."""
+    rt = await DistributedRuntime.create(store_url=store_url)
+    cache = await RouterDecisionCache(rt.store, fleet_id, ttl=60.0).start()
+    settings = RouterSettings(
+        mode=RouterMode.KV,
+        kv=KvRouterConfig(use_kv_events=False),
+        decisions=cache,
+    )
+    manager = ModelManager(rt, settings)
+    watcher = await ModelWatcher(rt, manager).start()
+    http = await HttpService(
+        manager, rt.metrics, health=rt.health, host="127.0.0.1", port=0
+    ).start()
+    return rt, manager, watcher, http, cache
+
+
+def test_conversation_sticks_to_warm_engine_across_frontends():
+    async def go():
+        url = "memory://fleet_routing"
+        w1, e1 = await start_worker(url)
+        w2, e2 = await start_worker(url)
+        f1 = await start_fleet_frontend(url)
+        f2 = await start_fleet_frontend(url)
+        bases = [f"http://127.0.0.1:{f[3].port}" for f in (f1, f2)]
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                async def turn(base: str, prompt: str) -> str:
+                    r = await client.post(f"{base}/v1/completions", json={
+                        "model": "mock-model", "prompt": prompt,
+                        "max_tokens": 8, "ignore_eos": True,
+                    })
+                    assert r.status_code == 200, r.text
+                    return r.json()["choices"][0]["text"]
+
+                # Turn 1 through frontend 1 warms SOME engine's prefix.
+                prompt = "conversation seed " * 4  # 72 chars → 18 blocks
+                reply = await turn(bases[0], prompt)
+                assert reply
+                warm = e1 if e1.total_generated > 0 else e2
+                cold = e2 if warm is e1 else e1
+                assert warm.total_generated > 0 and cold.total_generated == 0
+                await asyncio.sleep(0.1)  # decision write + mirror echo
+
+                # Follow-up turns: history grows, accepting frontend
+                # ALTERNATES. Frontend 2's approx index knows nothing —
+                # only the shared decision cache can keep the
+                # conversation on the warm engine.
+                for i in range(6):
+                    prompt = prompt + f" turn {i} extends the history"
+                    await turn(bases[i % 2], prompt)
+                    await asyncio.sleep(0.05)
+
+                assert cold.total_generated == 0, (
+                    "conversation leaked to the cold engine "
+                    f"(warm={warm.total_generated}, cold={cold.total_generated})"
+                )
+                # The warm engine's prefix cache actually got re-hit —
+                # the router stickiness translated into KV reuse.
+                hit_rate = warm.metrics().kv.gpu_prefix_cache_hit_rate
+                assert hit_rate > 0, f"gpu_prefix_cache_hit_rate={hit_rate}"
+                # And the second frontend's mirror really served lookups
+                # (the stickiness came from the shared cache, not luck).
+                assert f2[4]._mirror, "frontend 2's decision mirror is empty"
+        finally:
+            for f in (f1, f2):
+                await f[3].close()
+                await f[2].close()
+                await f[1].close()
+                await f[4].close()
+                await f[0].shutdown()
+            await w1.shutdown()
+            await w2.shutdown()
+
+    asyncio.run(go())
+
+
+def test_hit_rate_visible_on_worker_metrics_endpoint():
+    """The stickiness ground truth is scrapeable: the warm engine's
+    load-metrics endpoint reports the nonzero prefix hit rate the fleet
+    relies on."""
+
+    async def go():
+        url = "memory://fleet_routing2"
+        wrt, engine = await start_worker(url)
+        frt = await start_fleet_frontend(url)
+        base = f"http://127.0.0.1:{frt[3].port}"
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                prompt = "shared prefix block run " * 4
+                for i in range(3):
+                    r = await client.post(f"{base}/v1/completions", json={
+                        "model": "mock-model", "prompt": prompt + str(i),
+                        "max_tokens": 4, "ignore_eos": True,
+                    })
+                    assert r.status_code == 200
+                    await asyncio.sleep(0.05)
+            m = engine.metrics()
+            assert m.kv.gpu_prefix_cache_hit_rate > 0
+        finally:
+            await frt[3].close()
+            await frt[2].close()
+            await frt[1].close()
+            await frt[4].close()
+            await frt[0].shutdown()
+            await wrt.shutdown()
+
+    asyncio.run(go())

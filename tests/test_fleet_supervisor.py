@@ -1,0 +1,270 @@
+"""Fleet supervisor e2e: real child processes behind one shared port.
+
+Covers the process-lifecycle contract: both children serve through one
+port and the aggregation endpoint merges their observability; a
+SIGKILLed child is restarted with backoff while sibling in-flight
+streams are unaffected and its leased admission budget returns; SIGHUP
+rolls a drain through the fleet one process at a time without dropping
+requests; SIGTERM drains the whole fleet and leaves no shared state
+behind in the store."""
+
+import asyncio
+import json
+import signal
+import socket
+import time
+
+import httpx
+import pytest
+
+from procutil import ManagedProcess
+
+pytestmark = pytest.mark.e2e
+
+GRACE_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    # Fast drains + fast restart backoff so the suite stays quick.
+    "DYNTPU_RUNTIME_GRACEFUL_SHUTDOWN_TIMEOUT": "10",
+    "DYNTPU_FLEET_RESTART_BACKOFF_BASE": "0.2",
+    "DYNTPU_FLEET_RESTART_BACKOFF_MAX": "1.0",
+}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class FleetHarness:
+    """store + mocker worker + a --fleet N frontend, with teardown."""
+
+    def __init__(self, n: int = 2, extra_args: list | None = None,
+                 extra_env: dict | None = None, itl_ms: str = "1"):
+        self.n = n
+        self.store_port = _free_port()
+        self.store_url = f"tcp://127.0.0.1:{self.store_port}"
+        self.procs: list[ManagedProcess] = []
+        self.extra_args = extra_args or []
+        self.extra_env = extra_env or {}
+        self.itl_ms = itl_ms
+        self.base = self.admin = None
+
+    def __enter__(self) -> "FleetHarness":
+        store = ManagedProcess(
+            ["-m", "dynamo_tpu.runtime.store_server",
+             "--host", "127.0.0.1", "--port", str(self.store_port)],
+            name="store", env=GRACE_ENV,
+        )
+        self.procs.append(store)
+        store.wait_for(r"store server: tcp://")
+        worker = ManagedProcess(
+            ["-m", "dynamo_tpu.worker", "--store-url", self.store_url,
+             "--engine", "mocker", "--mocker-speedup", "1",
+             "--mocker-ttft-ms", "1", "--mocker-itl-ms", self.itl_ms,
+             "--max-num-seqs", "128"],
+            name="worker", env=GRACE_ENV,
+        )
+        self.procs.append(worker)
+        worker.wait_for(r"serving mock-model")
+        fleet = ManagedProcess(
+            ["-m", "dynamo_tpu.frontend", "--store-url", self.store_url,
+             "--host", "127.0.0.1", "--port", "0", "--router-mode", "kv",
+             "--fleet", str(self.n), "--fleet-id", f"t{self.store_port}",
+             *self.extra_args],
+            name="fleet", env={**GRACE_ENV, **self.extra_env},
+        )
+        self.procs.append(fleet)
+        self.fleet = fleet
+        m = fleet.wait_for(
+            r"fleet: http://127\.0\.0\.1:(\d+) admin http://127\.0\.0\.1:(\d+)"
+        )
+        self.base = f"http://127.0.0.1:{m.group(1)}"
+        self.admin = f"http://127.0.0.1:{m.group(2)}"
+        fleet.wait_for(r"fleet ready", timeout=60)
+        # Model discovery on every child.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            r = httpx.get(f"{self.base}/v1/models", timeout=5)
+            if r.json()["data"]:
+                return self
+            time.sleep(0.2)
+        raise TimeoutError("model never discovered")
+
+    def __exit__(self, *exc):
+        for p in reversed(self.procs):
+            p.terminate()
+        return False
+
+    def status(self) -> dict:
+        return httpx.get(f"{self.admin}/fleet", timeout=5).json()
+
+    def chat(self, text: str, max_tokens: int = 4, **kw) -> httpx.Response:
+        return httpx.post(
+            f"{self.base}/v1/chat/completions",
+            json={"model": "mock-model", "max_tokens": max_tokens,
+                  "messages": [{"role": "user", "content": text}], **kw},
+            # One fresh connection per request: SO_REUSEPORT balances
+            # connections, not requests.
+            headers={"Connection": "close"}, timeout=30,
+        )
+
+
+def test_fleet_serves_both_children_and_aggregates():
+    with FleetHarness(n=2) as h:
+        for i in range(24):
+            r = h.chat(f"hello {i}")
+            assert r.status_code == 200, r.text
+        m = httpx.get(f"{h.admin}/metrics", timeout=10).text
+        served = {}
+        for line in m.splitlines():
+            if line.startswith("dynamo_tpu_http_requests_total{") and 'status="200"' in line:
+                wid = line.split('fleet_worker_id="')[1].split('"')[0]
+                served[wid] = served.get(wid, 0) + float(line.rsplit(" ", 1)[1])
+        assert set(served) == {"0", "1"}, f"not all children served: {served}"
+        assert sum(served.values()) == 24
+        # Supervisor's own series ride the merge too.
+        assert 'dynamo_tpu_fleet_workers_alive{fleet_worker_id="supervisor"} 2' in m
+        # Per-child budget/decision series exist (children register them).
+        assert "dynamo_tpu_fleet_decision_cache_entries" in m
+        h_resp = httpx.get(f"{h.admin}/health", timeout=5)
+        assert h_resp.status_code == 200 and h_resp.json()["status"] == "ready"
+        st = h.status()
+        assert st["socket_mode"] in ("reuseport", "inherit")
+        assert [w["alive"] for w in st["workers"]] == [True, True]
+
+
+def test_kill_child_restarts_with_backoff_and_reclaims_budget():
+    """SIGKILL one child mid-stream: the supervisor restarts it (counted,
+    after backoff), sibling in-flight streams finish unaffected, and the
+    dead process's budget chunks are reclaimable (TCP store revokes
+    connection-owned leases on disconnect; TTL is the fallback)."""
+    with FleetHarness(
+        n=2, extra_args=["--global-max-inflight", "16", "--budget-chunk", "4"],
+        itl_ms="50",
+    ) as h:
+        # Long streams across several fresh connections: with 8
+        # connections the chance one child holds none is 2^-8 per side —
+        # retried via more streams below if needed.
+        async def drive():
+            async with httpx.AsyncClient(timeout=60) as client:
+                async def one(i):
+                    toks = 0
+                    try:
+                        async with client.stream(
+                            "POST", f"{h.base}/v1/chat/completions",
+                            json={"model": "mock-model", "max_tokens": 40,
+                                  "stream": True, "ignore_eos": True,
+                                  "messages": [{"role": "user", "content": f"s{i}"}]},
+                            headers={"Connection": "close"},
+                        ) as resp:
+                            assert resp.status_code == 200
+                            async for line in resp.aiter_lines():
+                                if line.startswith("data: ") and '"usage"' in line:
+                                    u = json.loads(line[6:]).get("usage")
+                                    if u:
+                                        toks = u["completion_tokens"]
+                        return ("ok", toks)
+                    except (httpx.HTTPError, OSError) as e:
+                        return (type(e).__name__, toks)
+
+                streams = [asyncio.create_task(one(i)) for i in range(10)]
+                # Streams at ~50ms/token for 40 tokens ≈ 2s: kill child 0
+                # while they're all mid-flight.
+                await asyncio.sleep(0.6)
+                victim_pid = next(
+                    w["pid"] for w in h.status()["workers"] if w["worker_id"] == 0
+                )
+                import os
+
+                os.kill(victim_pid, signal.SIGKILL)
+                return await asyncio.gather(*streams), victim_pid
+
+        results, victim_pid = asyncio.run(drive())
+        oks = [r for r in results if r[0] == "ok" and r[1] == 40]
+        cut = [r for r in results if r[0] != "ok"]
+        # The sibling's streams all completed with full token counts;
+        # only streams pinned to the killed process were cut.
+        assert len(oks) >= 1, results
+        assert len(oks) + len(cut) == 10
+        for r in results:
+            assert not (r[0] == "ok" and r[1] != 40), f"silent truncation: {r}"
+
+        # Supervisor restarts the slot with a fresh pid.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            st = h.status()
+            w0 = next(w for w in st["workers"] if w["worker_id"] == 0)
+            if w0["alive"] and w0["registered"] and w0["pid"] != victim_pid:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"child 0 never restarted: {st}")
+        assert w0["restarts"] >= 1
+        # Budget sanity after the crash+restart settles: claimed chunks
+        # never exceed the chunk count and serving still works.
+        assert h.status()["budget_chunks_claimed"] <= 4
+        r = h.chat("post-restart")
+        assert r.status_code == 200
+
+
+def test_sighup_rolls_drain_without_dropping_requests():
+    with FleetHarness(n=2) as h:
+        st0 = h.status()
+        pids0 = {w["worker_id"]: w["pid"] for w in st0["workers"]}
+        h.fleet.proc.send_signal(signal.SIGHUP)
+        # Keep issuing requests through the roll. A draining child leaves
+        # the accept group FIRST, so new connections land on siblings —
+        # but a connection the kernel handed it just before the listener
+        # closed can still see the typed drain 503 + Retry-After, which
+        # clients retry. The contract under test: one retry always
+        # succeeds, and nothing ever fails at the transport level.
+        failures = 0
+        deadline = time.monotonic() + 45
+        rolled = False
+        while time.monotonic() < deadline:
+            r = h.chat("during roll", max_tokens=2)
+            if r.status_code == 503:
+                assert "Retry-After" in r.headers
+                r = h.chat("during roll retry", max_tokens=2)
+            if r.status_code != 200:
+                failures += 1
+            st = h.status()
+            pids = {w["worker_id"]: w["pid"] for w in st["workers"]}
+            if (
+                all(w["alive"] and w["registered"] for w in st["workers"])
+                and all(pids[k] != pids0[k] for k in pids0)
+            ):
+                rolled = True
+                break
+            time.sleep(0.1)
+        assert rolled, f"rolling restart never completed: {h.status()}"
+        assert failures == 0, f"{failures} requests failed (post-retry) during the roll"
+        r = h.chat("after roll")
+        assert r.status_code == 200
+
+
+def test_sigterm_drains_fleet_and_clears_shared_state():
+    with FleetHarness(
+        n=2, extra_args=["--global-max-inflight", "16", "--budget-chunk", "4"]
+    ) as h:
+        for i in range(4):
+            assert h.chat(f"warm {i}").status_code == 200
+        h.fleet.proc.send_signal(signal.SIGTERM)
+        h.fleet.proc.wait(40)
+        assert h.fleet.proc.returncode == 0
+        # Shared state is handed back at drain, not left to TTL: no
+        # budget chunks, no decision entries, no registrations.
+        async def probe():
+            from dynamo_tpu.runtime.store import connect_store
+
+            store = await connect_store(h.store_url)
+            try:
+                fid = f"t{h.store_port}"
+                assert await store.get_prefix(f"fleet/{fid}/budget/") == []
+                assert await store.get_prefix(f"fleet/{fid}/route/") == []
+                assert await store.get_prefix(f"fleet/{fid}/frontends/") == []
+            finally:
+                await store.close()
+
+        asyncio.run(probe())

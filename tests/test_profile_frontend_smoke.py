@@ -24,3 +24,18 @@ def test_profile_frontend_quick_smoke():
     # --quick prints QUICK-OK only after its internal accounting asserts
     # (errors == 0, delivered tokens == streams * gen_len) passed.
     assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]
+
+
+def test_profile_frontend_fleet_quick_smoke():
+    """Fleet mode boots the REAL --fleet CLI (supervisor + 2 children on
+    one SO_REUSEPORT port) and asserts in --quick: zero errors, exact
+    token accounting, BOTH children served, and the aggregated /metrics
+    merge carries every child's relabeled series."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_frontend.py"),
+         "--fleet", "2", "--quick", "--json"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]

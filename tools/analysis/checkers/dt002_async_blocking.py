@@ -84,6 +84,7 @@ class AsyncBlockingChecker(Checker):
     scope = (
         "dynamo_tpu/frontend", "dynamo_tpu/runtime", "dynamo_tpu/router",
         "dynamo_tpu/llm", "dynamo_tpu/kv_router", "dynamo_tpu/transfer",
+        "dynamo_tpu/fleet",
     )
 
     def run(self, module: SourceModule) -> Iterable[Finding]:

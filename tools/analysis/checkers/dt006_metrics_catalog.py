@@ -91,10 +91,20 @@ async def build_registries():
     ep = ert.namespace("check").component("backend").endpoint("generate")
     await ep.router(RouterMode.ROUND_ROBIN)  # retries counter + breaker gauge
 
+    # Frontend-fleet series (dynamo_tpu/fleet): one shared definition
+    # covers supervisor AND fleet-child registrations, so registering it
+    # on its own registry (as the supervisor does) guards the whole set.
+    from dynamo_tpu.fleet import register_fleet_metrics
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    fleet_registry = MetricsRegistry()
+    register_fleet_metrics(fleet_registry)
+
     registries = [
         ("worker", wrt.metrics),
         ("frontend", frt.metrics),
         ("exporter", ert.metrics),
+        ("fleet", fleet_registry),
     ]
 
     async def cleanup():

@@ -41,6 +41,71 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _drive_streams_fleet(base: str, k: int, gen_len: int) -> tuple[int, int]:
+    """Fleet-scale load generator: k concurrent SSE streams over RAW
+    sockets with byte-level accounting. At 1k+ streams a full HTTP
+    client stack (h11 chunked-transfer parsing per delta) costs a
+    meaningful share of the host's CPU and the measurement becomes a
+    client bench; here each stream is one ``Connection: close`` request
+    whose response is drained in big reads keeping only a rolling tail,
+    and the single finish frame's usage is parsed after EOF.
+    → (delivered tokens, errored streams)."""
+    import asyncio as aio
+    import json as _json
+    import re as _re
+
+    host, port = base[len("http://"):].rsplit(":", 1)
+    usage_re = _re.compile(rb'"completion_tokens":\s*(\d+)')
+
+    async def go() -> tuple[int, int]:
+        async def one(i: int) -> tuple[int, int]:
+            try:
+                reader, writer = await aio.open_connection(host, int(port))
+                body = _json.dumps({
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": f"prompt {i} " * 8}],
+                    "max_tokens": gen_len, "stream": True, "ignore_eos": True,
+                }).encode()
+                writer.write(
+                    b"POST /v1/chat/completions HTTP/1.1\r\n"
+                    b"Host: " + host.encode() + b"\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Connection: close\r\n\r\n" + body
+                )
+                await writer.drain()
+                # Read until the status LINE is complete — under heavy
+                # host oversubscription the first read can return a
+                # partial line, and misreading it would count a healthy
+                # stream as errored.
+                head = b""
+                while b"\r\n" not in head:
+                    chunk = await reader.read(65536)
+                    if not chunk:
+                        break
+                    head += chunk
+                status = head.split(b"\r\n", 1)[0].split(b" ")
+                if len(status) < 2 or status[1] != b"200":
+                    writer.close()
+                    return 0, 1
+                tail = head[-4096:]
+                while True:
+                    chunk = await reader.read(262144)
+                    if not chunk:
+                        break
+                    tail = (tail + chunk)[-4096:]
+                writer.close()
+            except (OSError, IndexError):
+                return 0, 1
+            hits = usage_re.findall(tail)
+            return (int(hits[-1]) if hits else 0), 0
+
+        pairs = await aio.gather(*(one(i) for i in range(k)))
+        return sum(t for t, _ in pairs), sum(e for _, e in pairs)
+
+    return aio.run(go())
+
+
 def _drive_streams(base: str, k: int, gen_len: int) -> tuple[int, int]:
     """Subprocess load generator: k concurrent SSE streams →
     (delivered tokens, errored streams)."""
@@ -113,40 +178,18 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
 
     env = dict(os.environ, PYTHONPATH=REPO,
                DYNTPU_TRACING="1" if tracing_on else "0")
-    port = _free_port()
-    url = f"tcp://127.0.0.1:{port}"
     procs: list[subprocess.Popen] = []
     frt = manager = watcher = http = None
     results = []
     try:  # from the FIRST Popen: any setup failure must reap subprocesses
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
-             "--host", "127.0.0.1", "--port", str(port)], env=env,
-        ))
-        # Wait for the store to accept connections (interpreter start +
-        # imports can take seconds on a cold container).
-        deadline = time.monotonic() + 30
-        while True:
-            try:
-                r, w = await asyncio.open_connection("127.0.0.1", port)
-                w.close()
-                break
-            except OSError:
-                if time.monotonic() > deadline:
-                    raise RuntimeError("store server never came up")
-                await asyncio.sleep(0.25)
-        for _ in range(n_workers):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "dynamo_tpu.worker",
-                 "--store-url", url, "--engine", "mocker",
-                 "--mocker-speedup", "1000", "--mocker-ttft-ms", "0.1",
-                 "--mocker-itl-ms", "0.01",
-                 "--mocker-delta-tokens", str(delta_tokens),
-                 "--delta-max-tokens", str(delta_max_tokens),
-                 "--delta-max-ms", str(delta_max_ms),
-                 "--max-num-seqs", "512", "--num-kv-blocks", "16384",
-                 "--max-model-len", "8192"], env=env,
-            ))
+        url = await _start_store(procs, env)
+        _spawn_mockers(procs, env, url, n_workers, [
+            "--mocker-delta-tokens", str(delta_tokens),
+            "--delta-max-tokens", str(delta_max_tokens),
+            "--delta-max-ms", str(delta_max_ms),
+            "--max-num-seqs", "512", "--num-kv-blocks", "16384",
+            "--max-model-len", "8192",
+        ])
 
         frt = await DistributedRuntime.create(store_url=url)
         manager = ModelManager(
@@ -243,6 +286,293 @@ async def run(streams_list: list[int], gen_len: int, n_workers: int,
     return results
 
 
+async def _start_store(procs: list, env: dict) -> str:
+    """Spawn the store server + wait for it to accept connections.
+    → tcp:// url. Shared by the in-process and fleet benches."""
+    port = _free_port()
+    procs.append(subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.store_server",
+         "--host", "127.0.0.1", "--port", str(port)], env=env,
+    ))
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            _r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.close()
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise RuntimeError("store server never came up")
+            await asyncio.sleep(0.25)
+    return f"tcp://127.0.0.1:{port}"
+
+
+def _spawn_mockers(procs: list, env: dict, url: str, n: int, extra: list) -> None:
+    for _ in range(n):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.worker",
+             "--store-url", url, "--engine", "mocker",
+             "--mocker-speedup", "1000", "--mocker-ttft-ms", "0.1",
+             "--mocker-itl-ms", "0.01", *extra], env=env,
+        ))
+
+
+class _StdoutReader:
+    """Drains a subprocess's stdout on a thread (children inherit the
+    supervisor's pipe — an undrained pipe would eventually block them)
+    and lets callers wait for banner patterns."""
+
+    def __init__(self, proc: subprocess.Popen):
+        import threading
+
+        self.proc = proc
+        self.lines: list[str] = []
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            with self._cond:
+                self.lines.append(line)
+                self._cond.notify_all()
+        with self._cond:
+            self._cond.notify_all()
+
+    async def wait_for(self, pattern: str, timeout: float = 90.0):
+        import re as _re
+
+        rx = _re.compile(pattern)
+        deadline = time.monotonic() + timeout
+        scanned = 0
+        while time.monotonic() < deadline:
+            with self._cond:
+                for line in self.lines[scanned:]:
+                    m = rx.search(line)
+                    if m:
+                        return m
+                scanned = len(self.lines)
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet exited rc={self.proc.returncode}:\n" + "".join(self.lines[-30:])
+                )
+            await asyncio.sleep(0.1)
+        raise TimeoutError(f"no match for {pattern!r} in:\n" + "".join(self.lines[-30:]))
+
+
+async def run_fleet(fleet_sizes: list[int], streams: int, gen_len: int,
+                    n_workers: int, as_json: bool, delta_tokens: int = 8,
+                    quick: bool = False, out_path: str | None = None,
+                    global_max_inflight: int = 0,
+                    delta_max_tokens: int = 64, delta_max_ms: float = 0.0) -> dict:
+    """Fleet scaling bench: same worker fleet + offered load, N frontend
+    processes behind one SO_REUSEPORT port. Reports aggregate delivered
+    tok/s per N and the per-added-process scaling efficiency
+    ``eff(N) = tok_s(N) / (N * tok_s(1))``."""
+    import httpx
+
+    # Long store-lease TTL: at fleet sizes beyond the host's cores the
+    # keepalive loops can be CPU-starved for seconds mid-run; a missed
+    # beat must not expire a child's registration (and with it its
+    # budget chunks) during the measurement.
+    env = dict(os.environ, PYTHONPATH=REPO, DYNTPU_TRACING="0",
+               DYNTPU_STORE_LEASE_TTL="30")
+    procs: list[subprocess.Popen] = []
+    rows: list[dict] = []
+    import concurrent.futures as cf
+    import multiprocessing as mp
+
+    n_client_procs = 2 if quick else max(2, min(4, (os.cpu_count() or 2)))
+    try:
+        url = await _start_store(procs, env)
+        _spawn_mockers(procs, env, url, n_workers, [
+            "--mocker-delta-tokens", str(delta_tokens),
+            "--delta-max-tokens", str(delta_max_tokens),
+            "--delta-max-ms", str(delta_max_ms),
+            "--max-num-seqs", str(max(512, streams)),
+            "--num-kv-blocks", str(max(16384, streams * 16)),
+            "--max-model-len", "8192",
+        ])
+
+        with cf.ProcessPoolExecutor(
+            max_workers=n_client_procs, mp_context=mp.get_context("spawn")
+        ) as pool:
+            loop = asyncio.get_running_loop()
+            for n in fleet_sizes:
+                fleet = subprocess.Popen(
+                    [sys.executable, "-m", "dynamo_tpu.frontend",
+                     "--store-url", url, "--host", "127.0.0.1", "--port", "0",
+                     "--router-mode", "kv", "--fleet", str(n),
+                     "--fleet-id", f"prof{n}", "--fleet-admin-port", "0",
+                     *(["--global-max-inflight", str(global_max_inflight),
+                        "--budget-chunk", str(max(8, global_max_inflight // (4 * n)))]
+                       if global_max_inflight else [])],
+                    env=env, stdout=subprocess.PIPE, text=True,
+                )
+                procs.append(fleet)
+                reader = _StdoutReader(fleet)
+                m = await reader.wait_for(
+                    r"fleet: http://127\.0\.0\.1:(\d+) admin http://127\.0\.0\.1:(\d+)"
+                )
+                base = f"http://127.0.0.1:{m.group(1)}"
+                admin = f"http://127.0.0.1:{m.group(2)}"
+                await reader.wait_for(r"fleet ready")
+                async with httpx.AsyncClient(timeout=60) as client:
+                    deadline = time.monotonic() + 30
+                    while True:
+                        r = await client.get(f"{base}/v1/models")
+                        if r.json()["data"]:
+                            break
+                        if time.monotonic() > deadline:
+                            raise RuntimeError("model never discovered")
+                        await asyncio.sleep(0.2)
+                    # Warm every child's pipeline: reuseport spreads
+                    # CONNECTIONS, so each warm request must close its
+                    # connection or they all ride one keep-alive socket
+                    # into a single child.
+                    for _ in range(4 * n):
+                        r = await client.post(f"{base}/v1/chat/completions", json={
+                            "model": "mock-model",
+                            "messages": [{"role": "user", "content": "warm"}],
+                            "max_tokens": 2,
+                        }, headers={"Connection": "close"})
+                        r.raise_for_status()
+
+                per = [streams // n_client_procs + (1 if i < streams % n_client_procs else 0)
+                       for i in range(n_client_procs)]
+                # Full-size warmup pass OUTSIDE the timed window: the
+                # first big run against a fresh process tree is dominated
+                # by allocator/page-cache/dict-growth cold costs (measured
+                # ~2x on this harness), which would bias whichever N runs
+                # first in the sweep.
+                if not quick:
+                    await asyncio.gather(*(
+                        loop.run_in_executor(pool, _drive_streams_fleet, base, k, gen_len)
+                        for k in per if k
+                    ))
+                else:
+                    await asyncio.gather(*(
+                        loop.run_in_executor(pool, _drive_streams_fleet, base, 1, 2)
+                        for _ in range(n_client_procs)
+                    ))
+                # Best-of-R timed passes: a 2-core host under this much
+                # oversubscription schedules noisily; the best pass is
+                # the least-perturbed estimate of what the tier sustains.
+                reps = 1 if quick else 2
+                attempts: list[float] = []
+                total = errs = 0
+                dur = 1e-9
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    counts = await asyncio.gather(*(
+                        loop.run_in_executor(pool, _drive_streams_fleet, base, k, gen_len)
+                        for k in per if k
+                    ))
+                    d = time.perf_counter() - t0
+                    t = sum(x for x, _ in counts)
+                    e = sum(x for _, x in counts)
+                    attempts.append(round(t / d, 1))
+                    if t / d >= total / dur:
+                        total, errs, dur = t, e, d
+
+                # Per-child accounting + fleet surface checks off the
+                # aggregation endpoint.
+                async with httpx.AsyncClient(timeout=30) as client:
+                    metrics_text = (await client.get(f"{admin}/metrics")).text
+                    status = (await client.get(f"{admin}/fleet")).json()
+                per_child: dict[str, float] = {}
+                for line in metrics_text.splitlines():
+                    if line.startswith("dynamo_tpu_http_requests_total{") and 'status="200"' in line:
+                        wid = line.split('fleet_worker_id="')[1].split('"')[0]
+                        if wid != "supervisor":
+                            per_child[wid] = per_child.get(wid, 0) + float(line.rsplit(" ", 1)[1])
+                row = {
+                    "fleet": n, "streams": streams, "gen_len": gen_len,
+                    "workers": n_workers, "delta_tokens": delta_tokens,
+                    "elapsed_s": round(dur, 3),
+                    "frontend_tok_s": round(total / dur, 1),
+                    "attempt_tok_s": attempts,
+                    "errors": errs,
+                    "served_per_child": per_child,
+                    "socket_mode": status.get("socket_mode"),
+                    "budget_chunks_claimed": status.get("budget_chunks_claimed"),
+                    "workers_alive": sum(
+                        1 for w in status.get("workers", []) if w.get("alive")
+                    ),
+                    "restarts": sum(
+                        w.get("restarts", 0) for w in status.get("workers", [])
+                    ),
+                }
+                if quick:
+                    assert errs == 0, f"{errs} streams errored"
+                    assert total == streams * gen_len, (
+                        f"token accounting off: {total} != {streams}*{gen_len}"
+                    )
+                    assert len(per_child) == n, (
+                        f"only {sorted(per_child)} of {n} children served"
+                    )
+                    assert 'fleet_worker_id="supervisor"' in metrics_text
+                    assert "dynamo_tpu_fleet_workers_alive" in metrics_text
+                rows.append(row)
+                if as_json:
+                    print(json.dumps(row), flush=True)
+                else:
+                    print(f"fleet={n}: {total/dur:10.0f} tok/s "
+                          f"({dur:.2f}s, {errs} errors, per-child {per_child})",
+                          flush=True)
+                fleet.send_signal(signal.SIGTERM)
+                try:
+                    fleet.wait(30)
+                except subprocess.TimeoutExpired:
+                    fleet.kill()
+    finally:
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    base_row = next((r for r in rows if r["fleet"] == 1), None)
+    for r in rows:
+        if base_row is not None and base_row["frontend_tok_s"] > 0:
+            r["scaling_efficiency"] = round(
+                r["frontend_tok_s"] / (r["fleet"] * base_row["frontend_tok_s"]), 3
+            )
+    result = {
+        "bench": "frontend_fleet",
+        "host_cpus": os.cpu_count(),
+        "methodology": (
+            "same store+mocker fleet and offered load per N; N frontend "
+            "processes share one SO_REUSEPORT port; delivered tokens "
+            "counted client-side from finish-frame usage via raw-socket "
+            "clients; full-size warmup pass + best-of-2 timed passes per "
+            "N; eff(N)=tok_s(N)/(N*tok_s(1))"
+        ),
+        "rows": rows,
+    }
+    ncpu = os.cpu_count() or 1
+    if rows and max(r["fleet"] for r in rows) >= ncpu:
+        # N frontends + workers + store + client drivers all share this
+        # host: once N reaches the core count the sweep measures host
+        # oversubscription, not tier scaling. Say so in the artifact
+        # rather than letting a low eff(N) read as a fleet defect.
+        result["host_note"] = (
+            f"host has {ncpu} CPUs; fleet sizes >= {ncpu} are "
+            "host-oversubscribed (frontends, workers, store, and client "
+            "drivers share the cores) — efficiency at those N reflects "
+            "the host ceiling, not tier scaling; rerun on a many-core "
+            "frontend host for the true curve"
+        )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {out_path}", flush=True)
+    return result
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--streams", default="32,128,256")
@@ -264,8 +594,41 @@ def main():
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke mode: tiny run, asserts completion + "
                         "exact token accounting, makes no timing claims")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="fleet scaling mode: spawn the frontend as a fleet "
+                        "of N processes (python -m dynamo_tpu.frontend "
+                        "--fleet N) and measure aggregate tok/s through the "
+                        "shared port (0 = classic single in-process frontend)")
+    p.add_argument("--fleet-sweep", default=None,
+                   help='comma list of fleet sizes to sweep, e.g. "1,2,4" '
+                        "(reports per-added-process scaling efficiency)")
+    p.add_argument("--global-max-inflight", type=int, default=0,
+                   help="fleet-wide admission budget to run the sweep under "
+                        "(0 = unbudgeted)")
+    p.add_argument("--out", default=None,
+                   help="write the fleet sweep result JSON here "
+                        "(e.g. BENCH_FLEET_r09.json)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args()
+    if args.fleet or args.fleet_sweep:
+        sizes = ([int(s) for s in args.fleet_sweep.split(",")]
+                 if args.fleet_sweep else [args.fleet])
+        if args.quick:
+            streams, gen_len, workers = 24, 16, 1
+        else:
+            # Fleet mode drives ONE total stream count (the first entry
+            # of --streams) across every N.
+            streams = [int(s) for s in args.streams.split(",")][0]
+            gen_len, workers = args.gen_len, args.workers
+        asyncio.run(run_fleet(
+            sizes, streams, gen_len, workers, args.json,
+            delta_tokens=args.delta_tokens, quick=args.quick,
+            out_path=args.out, global_max_inflight=args.global_max_inflight,
+            delta_max_tokens=args.delta_max_tokens, delta_max_ms=args.delta_max_ms,
+        ))
+        if args.quick:
+            print("QUICK-OK", flush=True)
+        return
     if args.quick:
         streams, gen_len, workers = [8], 16, 1
     else:
