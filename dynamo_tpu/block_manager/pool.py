@@ -60,6 +60,11 @@ class BlockPool:
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # pop() → low ids first
         self._cached: dict[int, int] = {}          # seq_hash → block_id (registered)
         self._lru: OrderedDict[int, None] = OrderedDict()  # block_id → None, oldest first
+        # Radix fan-out: parent seq_hash → number of REGISTERED children.
+        # A hash with >= 2 children is a branch point (shared prefix that
+        # several continuations diverge from) — the tier eviction policy
+        # protects those blocks from one-off-prompt churn.
+        self._children: dict[int, int] = {}
         self._event_sink = event_sink
         self._event_id = 0
         # Mutations run on the engine scheduler thread while snapshot()/
@@ -178,8 +183,18 @@ class BlockPool:
         if b.seq_hash is not None:
             self._cached.pop(b.seq_hash, None)
             self._emit(KvCacheEvent.removed([b.seq_hash]))
+            self._drop_child(b.parent_hash)
             b.seq_hash = None
             b.parent_hash = None
+
+    def _drop_child(self, parent_hash: int | None) -> None:
+        if parent_hash is None:
+            return
+        n = self._children.get(parent_hash, 0) - 1
+        if n > 0:
+            self._children[parent_hash] = n
+        else:
+            self._children.pop(parent_hash, None)
 
     def _ref(self, bid: int) -> None:
         b = self._blocks[bid]
@@ -216,8 +231,26 @@ class BlockPool:
             b.parent_hash = parent_hash
             if self.enable_prefix_caching:
                 self._cached[seq_hash] = bid
+                if parent_hash is not None:
+                    self._children[parent_hash] = self._children.get(parent_hash, 0) + 1
                 self._emit(KvCacheEvent.stored([StoredBlock(seq_hash, parent_hash)]))
             return bid
+
+    def hash_fanout(self, seq_hash: int) -> int:
+        """Registered children of this hash in the radix chain."""
+        with self._lock:
+            return self._children.get(seq_hash, 0)
+
+    def hash_protected(self, seq_hash: int) -> bool:
+        """Should the KV tiers protect this block from churn eviction?
+        True for branch points (>= 2 registered children — shared
+        prefixes several continuations diverge from, e.g. a system
+        prompt) and blocks multiple live sequences currently share."""
+        with self._lock:
+            if self._children.get(seq_hash, 0) >= 2:
+                return True
+            bid = self._cached.get(seq_hash)
+            return bid is not None and self._blocks[bid].ref_count >= 2
 
     # -- release ----------------------------------------------------------
 
@@ -248,7 +281,9 @@ class BlockPool:
                 if b.seq_hash is not None:
                     self._cached.pop(b.seq_hash, None)
                     dropped.append(b.seq_hash)
+                    self._drop_child(b.parent_hash)
                     b.seq_hash = None
+                    b.parent_hash = None
                 self._free.append(bid)
             if dropped:
                 self._emit(KvCacheEvent.removed(dropped))

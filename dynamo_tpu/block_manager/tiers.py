@@ -12,8 +12,17 @@ invalidates old device buffers and eviction happens mid-allocation where
 a synchronous extract would serialize admission.
 
 Lookup path on prefix miss in G1: G2 dict hit → pages; G2 miss → G3 file
-hit → pages (promoted back into G2). Both tiers are plain LRU over
-hash-keyed pages and thread-safe.
+hit → pages (promoted back into G2). Both tiers are hash-keyed and
+thread-safe; eviction is **frequency/fan-out-aware LRU** (second-chance):
+plain LRU let one burst of one-off prompts flush the hot shared
+system-prefix blocks that chat/agentic traffic re-hits constantly. Each
+entry carries a small credit — seeded by the caller's ``protected`` hint
+(the radix tree knows which hashes have high prefix fan-out or live
+sharers) and topped up on every hit, decayed on every spared scan — and
+the evictor skips positive-credit entries (re-queueing them MRU, counted
+in ``protected_evictions``) until it finds a cold one. Credits age, so a
+protected block that stops earning hits still leaves eventually; scans
+are bounded, so eviction stays O(spares) and always terminates.
 """
 
 from __future__ import annotations
@@ -24,9 +33,48 @@ from collections import OrderedDict
 
 import numpy as np
 
+# Credit seeded by a `protected` put (radix fan-out / live sharers) and
+# the cap hits can accumulate to. Spared scans decay credit by 1, so a
+# protected-but-cold block survives at most PROTECT_CREDIT burst waves.
+PROTECT_CREDIT = 2
+MAX_CREDIT = 8
+
+
+def _credit_seed(credit: dict[int, int], h: int, protected: bool) -> None:
+    if protected:
+        credit[h] = max(credit.get(h, 0), PROTECT_CREDIT)
+
+
+def _credit_touch(credit: dict[int, int], h: int) -> None:
+    credit[h] = min(credit.get(h, 0) + 1, MAX_CREDIT)
+
+
+def _second_chance_pop(order, credit: dict[int, int]):
+    """Pop the eviction victim from an LRU-ordered dict: the oldest
+    ZERO-credit entry within a bounded scan; positive-credit entries are
+    spared (credit decayed by 1, re-queued MRU). Falls back to plain
+    oldest when everything is warm — the bound keeps eviction
+    O(spares), never a livelock. The ONE policy both tiers share.
+    → (hash, value, spared_count)."""
+    scans = 0
+    limit = len(order)
+    while scans < limit:
+        h, v = order.popitem(last=False)
+        c = credit.get(h, 0)
+        if c <= 0:
+            credit.pop(h, None)
+            return h, v, scans
+        credit[h] = c - 1
+        order[h] = v  # re-queue MRU (second chance)
+        scans += 1
+    h, v = order.popitem(last=False)
+    credit.pop(h, None)
+    return h, v, scans
+
 
 class HostBlockPool:
-    """G2: host-RAM pages keyed by sequence hash, LRU-bounded.
+    """G2: host-RAM pages keyed by sequence hash, credit-aware-LRU
+    bounded (module header).
 
     A "page" is the tuple of per-block arrays the engine extracts:
     ``(k, v)`` for full-precision caches, ``(k, v, k_scale, v_scale)``
@@ -36,16 +84,18 @@ class HostBlockPool:
     def __init__(self, capacity_blocks: int, spill=None):
         self.capacity = capacity_blocks
         self._pages: OrderedDict[int, tuple[np.ndarray, ...]] = OrderedDict()
+        self._credit: dict[int, int] = {}
         self._lock = threading.Lock()
         self._spill = spill  # callable(hash, *pages) — e.g. DiskBlockPool.put
         self.hits = 0
         self.misses = 0
+        self.protected_evictions = 0  # eviction scans that spared an entry
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._pages)
 
-    def put(self, seq_hash: int, *pages: np.ndarray) -> None:
+    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False) -> None:
         spilled = []
         # Own the storage: callers pass views into shared batch buffers
         # (engine extracts up to 64 blocks per DMA and slices per block);
@@ -55,10 +105,13 @@ class HostBlockPool:
         with self._lock:
             if seq_hash in self._pages:
                 self._pages.move_to_end(seq_hash)
+                _credit_seed(self._credit, seq_hash, protected)
                 return
             self._pages[seq_hash] = pages
+            _credit_seed(self._credit, seq_hash, protected)
             while len(self._pages) > self.capacity:
-                h, pgs = self._pages.popitem(last=False)
+                h, pgs, spared = _second_chance_pop(self._pages, self._credit)
+                self.protected_evictions += spared
                 spilled.append((h, pgs))
         for h, pgs in spilled:
             if self._spill is not None:
@@ -69,6 +122,7 @@ class HostBlockPool:
             pages = self._pages.get(seq_hash)
             if pages is not None:
                 self._pages.move_to_end(seq_hash)
+                _credit_touch(self._credit, seq_hash)
                 self.hits += 1
                 return pages
         self.misses += 1
@@ -82,6 +136,7 @@ class HostBlockPool:
         with self._lock:
             n = len(self._pages)
             self._pages.clear()
+            self._credit.clear()
             return n
 
 
@@ -95,6 +150,7 @@ class DiskBlockPool:
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
         self._order: OrderedDict[int, None] = OrderedDict()
+        self._credit: dict[int, int] = {}
         for fname in sorted(
             os.listdir(directory),
             key=lambda f: os.path.getmtime(os.path.join(directory, f)),
@@ -106,6 +162,7 @@ class DiskBlockPool:
                     pass
         self.hits = 0
         self.misses = 0
+        self.protected_evictions = 0  # eviction scans that spared an entry
 
     def _path(self, seq_hash: int) -> str:
         return os.path.join(self.dir, f"{seq_hash}.npz")
@@ -114,16 +171,20 @@ class DiskBlockPool:
         with self._lock:
             return len(self._order)
 
-    def put(self, seq_hash: int, *pages: np.ndarray) -> None:
+    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False) -> None:
         k, v = pages[0], pages[1]
         evict: list[int] = []
         with self._lock:
             if seq_hash in self._order:
                 self._order.move_to_end(seq_hash)
+                _credit_seed(self._credit, seq_hash, protected)
                 return
             self._order[seq_hash] = None
+            _credit_seed(self._credit, seq_hash, protected)
             while len(self._order) > self.capacity:
-                evict.append(self._order.popitem(last=False)[0])
+                h, _, spared = _second_chance_pop(self._order, self._credit)
+                self.protected_evictions += spared
+                evict.append(h)
         # bf16 numpy (ml_dtypes) isn't npz-portable → store uint16 view.
         kind = str(k.dtype)
         if kind == "bfloat16":
@@ -159,6 +220,7 @@ class DiskBlockPool:
         with self._lock:
             if seq_hash in self._order:
                 self._order.move_to_end(seq_hash)
+                _credit_touch(self._credit, seq_hash)
         self.hits += 1
         return (k, v, *scales)
 
@@ -170,6 +232,7 @@ class DiskBlockPool:
         with self._lock:
             hashes = list(self._order)
             self._order.clear()
+            self._credit.clear()
         for h in hashes:
             try:
                 os.remove(self._path(h))
@@ -203,19 +266,50 @@ class TierStack:
     def enabled(self) -> bool:
         return self.host is not None or self.disk is not None
 
-    def offload(self, pairs: list[tuple]) -> int:
+    def offload(self, pairs: list[tuple],
+                protected: list[bool] | None = None) -> int:
         """pairs: (seq_hash, *page_arrays) — (hash, k, v) for dense
-        caches, (hash, k, v, k_scale, v_scale) for int8. → number
-        offloaded."""
+        caches, (hash, k, v, k_scale, v_scale) for int8. ``protected``
+        (parallel to pairs) marks blocks the radix tree knows are hot —
+        high prefix fan-out or multiple live sharers — so a burst of
+        one-off prompts cannot flush them (second-chance eviction,
+        module header). → number offloaded."""
         n = 0
-        for seq_hash, *pages in pairs[: self.MAX_OFFLOAD_PER_STEP]:
+        for i, (seq_hash, *pages) in enumerate(pairs[: self.MAX_OFFLOAD_PER_STEP]):
+            prot = bool(protected[i]) if protected is not None else False
             if self.host is not None:
-                self.host.put(seq_hash, *pages)
+                self.host.put(seq_hash, *pages, protected=prot)
             elif self.disk is not None:
-                self.disk.put(seq_hash, *pages)
+                self.disk.put(seq_hash, *pages, protected=prot)
             n += 1
         self.offloaded_blocks += n
         return n
+
+    @property
+    def protected_evictions(self) -> int:
+        """Eviction scans (both tiers) that spared a protected/warm
+        block and evicted a colder one instead — the
+        tier_protected_evictions_total feed."""
+        n = 0
+        if self.host is not None:
+            n += self.host.protected_evictions
+        if self.disk is not None:
+            n += self.disk.protected_evictions
+        return n
+
+    @property
+    def hit_rate(self) -> float:
+        """Cumulative lookup hit rate across both tiers (0.0 when the
+        stack is disabled or untouched)."""
+        hits = misses = 0
+        if self.host is not None:
+            hits += self.host.hits
+            misses += self.host.misses
+        if self.disk is not None:
+            hits += self.disk.hits
+            misses += self.disk.misses
+        total = hits + misses
+        return hits / total if total else 0.0
 
     def peek_run_len(self, hashes: list[int]) -> int:
         """Length of the leading run resident in ANY tier — no page copies,
@@ -267,4 +361,6 @@ class TierStack:
             "g3_hits": self.disk.hits if self.disk else 0,
             "offloaded_blocks": self.offloaded_blocks,
             "onboarded_blocks": self.onboarded_blocks,
+            "protected_evictions": self.protected_evictions,
+            "hit_rate": round(self.hit_rate, 4),
         }

@@ -284,6 +284,22 @@ class EngineArgs:
     spec_ema_alpha: float = 0.3
     spec_ema_disable: float = 0.2
     spec_probe_every: int = 16
+    # Tree speculation (SpecInfer-style): max branching factor per draft
+    # node. 1 = linear drafts only (the PR 5 path, byte-for-byte);
+    # >= 2 swaps in the tree drafter (engine/drafter.TreeDrafter):
+    # wherever the per-sequence n-gram index has recorded SEVERAL
+    # distinct continuations of the trailing context the draft branches,
+    # and a Lookahead-style Jacobi pool (model-predicted continuations
+    # harvested from every verify pass's logits) drafts on generic
+    # traffic with zero history hits. The whole tree still verifies in
+    # ONE weight stream via the topology-masked multi-query gather, so
+    # the node budget stays spec_tokens — width buys coverage of
+    # alternative branches, not extra bandwidth.
+    spec_tree_width: int = 1
+    # Max tree path depth (0 = spec_tokens). Depth bounds the best-case
+    # accepted run; width x depth should comfortably exceed spec_tokens
+    # or the budget can never branch.
+    spec_tree_depth: int = 0
     # Verify forward shape: True (default) = single-pass fused forward —
     # ONE weight stream scores the whole draft, the bandwidth win.
     # False = teacher-forced scan of the dense decode step — bitwise
@@ -317,6 +333,15 @@ class EngineArgs:
         if self.kv_quant not in ("none", "int8"):
             raise ValueError(
                 f"kv_quant must be 'none' or 'int8'; got {self.kv_quant!r}"
+            )
+        if self.spec_tree_width < 1:
+            raise ValueError(
+                f"spec_tree_width must be >= 1; got {self.spec_tree_width}"
+            )
+        if self.spec_tree_depth < 0:
+            raise ValueError(
+                f"spec_tree_depth must be >= 0 (0 = spec_tokens); got "
+                f"{self.spec_tree_depth}"
             )
         if self.max_model_len % self.block_size:
             self.max_model_len = ((self.max_model_len // self.block_size) + 1) * self.block_size

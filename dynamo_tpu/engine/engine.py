@@ -40,7 +40,7 @@ import numpy as np
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine.config import EngineArgs
-from dynamo_tpu.engine.drafter import build_drafter
+from dynamo_tpu.engine.drafter import TreeDraft, build_drafter
 from dynamo_tpu.engine.runner import host_ready, start_host_fetch
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
@@ -165,24 +165,40 @@ class _Spec:
     Unlike a _Window, the number of tokens a row will emit (1 + accepted
     drafts) is unknown until the fetch lands, so the scheduler never
     plans further decode work for these rows while a _Spec is queued —
-    _decode_iteration force-drains any queued _Spec before planning."""
+    _decode_iteration force-drains any queued _Spec before planning.
 
-    __slots__ = ("rows", "pos0", "draft_lens", "ref", "top_n")
+    ``draft_lens`` counts proposed draft NODES per row (the token budget
+    spent); ``potentials`` the max accepted run each proposal could
+    yield — equal for a linear draft, the deepest path for a tree (the
+    honest EMA denominator). ``node_tokens``/``node_parents`` keep the
+    host-side tree views so the drain can feed the drafter's Jacobi
+    pool without re-fetching anything."""
+
+    __slots__ = ("rows", "pos0", "draft_lens", "potentials", "ref",
+                 "top_n", "tree", "node_tokens", "node_parents")
 
     def __init__(self, rows: list[_Seq], pos0: list[int],
-                 draft_lens: list[int], ref, top_n: int = 0):
+                 draft_lens: list[int], ref, top_n: int = 0,
+                 potentials: list[int] | None = None, tree: bool = False,
+                 node_tokens: list[list[int]] | None = None,
+                 node_parents: list[list[int]] | None = None):
         self.rows = rows
         self.pos0 = pos0
         self.draft_lens = draft_lens
+        self.potentials = potentials or draft_lens
         # StepRef: arrs = (out [B, S1], n_emit [B], logps [B, S1],
-        # top_vals [B, S1, n], top_ids [B, S1, n])
+        # cand [B, S1], top_vals [B, S1, n], top_ids [B, S1, n])
         self.ref = ref
         self.top_n = top_n
+        self.tree = tree
+        self.node_tokens = node_tokens
+        self.node_parents = node_parents
 
     def fetch_arrays(self) -> list:
-        a = [self.ref.arrs[0], self.ref.arrs[1], self.ref.arrs[2]]
+        a = [self.ref.arrs[0], self.ref.arrs[1], self.ref.arrs[2],
+             self.ref.arrs[3]]
         if self.top_n:
-            a += [self.ref.arrs[3], self.ref.arrs[4]]
+            a += [self.ref.arrs[4], self.ref.arrs[5]]
         return a
 
 
@@ -261,6 +277,28 @@ def register_engine_metrics(registry):
             "1 when the paged KV cache stores int8 pages (kv_quant), "
             "0 for full-precision storage",
         ),
+        registry.counter(
+            "engine_spec_tree_passes_total",
+            "Speculative verify passes dispatched with a branched "
+            "(non-chain) draft tree",
+        ),
+        registry.gauge(
+            "engine_spec_tree_accept_depth",
+            "Cumulative mean accepted root-path depth of tree verify "
+            "passes (0 = every tree pass rejected at the root)",
+        ),
+        registry.counter(
+            "tier_protected_evictions_total",
+            "Host/disk KV tier eviction scans that SPARED a protected "
+            "block (high prefix fan-out or recent hits) and evicted a "
+            "colder one instead",
+        ),
+        registry.gauge(
+            "tier_hit_rate",
+            "Cumulative G2+G3 tier lookup hit rate (hits / (hits + "
+            "misses)) — the churn-resistance signal for the "
+            "frequency-aware eviction policy",
+        ),
     )
 
 
@@ -280,7 +318,7 @@ class TpuEngine:
         "_submissions", "_waiting", "_running", "_fetchq", "_free_slots",
         "_embed_jobs", "_host_jobs", "_offload_pending", "_exports",
         "_export_fetches", "_drafter", "_step_no", "_spec_ticked",
-        "phase_s", "phase_n", "_ctr_pushed",
+        "phase_s", "phase_n", "_ctr_pushed", "_spec_depth_hist",
     })
 
     def __init__(
@@ -366,6 +404,13 @@ class TpuEngine:
         self.total_spec_passes = 0
         self.total_spec_rows = 0
         self.total_spec_emitted = 0
+        # Tree speculation: branched-pass dispatches, per-row accepted
+        # depth sum + row count (mean accept depth), and a small
+        # accepted-depth histogram {depth: rows} for the profiler.
+        self.total_spec_tree_passes = 0
+        self.total_spec_tree_rows = 0
+        self.total_spec_tree_depth = 0
+        self._spec_depth_hist: collections.Counter = collections.Counter()
         # Tokens-per-weight-pass accounting: every (row, substep) of a
         # drained window or single step is one per-sequence weight pass
         # yielding one token; a spec row-pass is one weight pass yielding
@@ -392,7 +437,9 @@ class TpuEngine:
         # already been fed (engine keeps plain ints; registry counters
         # get the delta once per step).
         self._gauges = None
-        self._ctr_pushed = [0, 0]  # (proposed, accepted) already inc'd
+        # (proposed, accepted, tree passes, protected tier evictions)
+        # already inc'd into the registry counters.
+        self._ctr_pushed = [0, 0, 0, 0]
 
     def bind_metrics(self, registry) -> None:
         """Attach the engine gauges to a MetricsRegistry; updated once
@@ -403,7 +450,7 @@ class TpuEngine:
         if self._gauges is None:
             return
         (g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp,
-         g_kvb, g_kvq) = self._gauges
+         g_kvb, g_kvq, c_tree, g_tree_depth, c_tier_prot, g_tier_hit) = self._gauges
         g_kvb.set(self.args.kv_bytes_per_block() * self.args.num_kv_blocks)
         g_kvq.set(1 if self.args.kv_quant == "int8" else 0)
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
@@ -417,6 +464,17 @@ class TpuEngine:
             self._ctr_pushed[1] = self.total_spec_accepted
         g_rate.set(self.total_spec_accepted / max(1, self.total_spec_proposed))
         g_tpp.set(self.total_row_tokens / max(1, self.total_row_passes))
+        if self.total_spec_tree_passes > self._ctr_pushed[2]:
+            c_tree.inc(self.total_spec_tree_passes - self._ctr_pushed[2])
+            self._ctr_pushed[2] = self.total_spec_tree_passes
+        g_tree_depth.set(
+            self.total_spec_tree_depth / max(1, self.total_spec_tree_rows)
+        )
+        prot = self.tiers.protected_evictions
+        if prot > self._ctr_pushed[3]:
+            c_tier_prot.inc(prot - self._ctr_pushed[3])
+            self._ctr_pushed[3] = prot
+        g_tier_hit.set(self.tiers.hit_rate)
 
     def _phase(self, key: str, t0: float) -> float:
         """Accumulate perf_counter()-t0 into phase `key`; → new t0."""
@@ -861,23 +919,44 @@ class TpuEngine:
 
         def _warm():
             count = 0
+            S1 = S + 1
+            # Tree lattice rides the same loop when tree drafting is on:
+            # the topology arrays are traced by SHAPE only, so one inert
+            # chain-shaped dispatch warms every tree a real batch can
+            # produce at this (B, W, mode, top_n).
+            shapes: list[tuple | None] = [None]
+            if args.spec_tree_width > 1:
+                chain_parents = np.maximum(
+                    np.arange(S1, dtype=np.int32) - 1, 0
+                )
+                shapes.append((chain_parents, np.tril(np.ones((S1, S1), np.int8)),
+                               np.arange(S1, dtype=np.int32)))
             for mode in modes:
                 for top_n in top_ns:
                     for B in args.decode_buckets:
                         for W in args.table_buckets:
-                            self._runner.spec_verify(
-                                S + 1, mode,
-                                np.zeros((B, S + 1), np.int32),
-                                np.zeros((B,), np.int32),
-                                np.full((B,), S, np.int32),
-                                np.zeros((B, W), np.int32),
-                                np.zeros((B,), bool),
-                                np.ones((B,), np.float32),
-                                np.zeros((B,), np.uint32),
-                                np.zeros((B,), np.int32),
-                                None, top_n,
-                            )
-                            count += 1
+                            for shape in shapes:
+                                tree = None
+                                if shape is not None:
+                                    p, anc, dep = shape
+                                    tree = (
+                                        np.broadcast_to(p, (B, S1)).copy(),
+                                        np.broadcast_to(anc, (B, S1, S1)).copy(),
+                                        np.broadcast_to(dep, (B, S1)).copy(),
+                                    )
+                                self._runner.spec_verify(
+                                    S1, mode,
+                                    np.zeros((B, S1), np.int32),
+                                    np.zeros((B,), np.int32),
+                                    np.full((B,), S, np.int32),
+                                    np.zeros((B, W), np.int32),
+                                    np.zeros((B,), bool),
+                                    np.ones((B,), np.float32),
+                                    np.zeros((B,), np.uint32),
+                                    np.zeros((B,), np.int32),
+                                    None, top_n, tree,
+                                )
+                                count += 1
             return count
 
         return await self.run_on_engine_thread(_warm)
@@ -937,7 +1016,11 @@ class TpuEngine:
             [
                 (h, *(a[:, i : i + 1] for a in pages))
                 for i, (_, h) in enumerate(batch)
-            ]
+            ],
+            # Radix protection hint: branch points / live-shared blocks
+            # get eviction credit in the tiers so one-off prompt bursts
+            # can't flush the hot shared system-prefix blocks.
+            protected=[self.pool.hash_protected(h) for _, h in batch],
         )
 
     def _reap_cancelled(self) -> None:
@@ -1694,14 +1777,17 @@ class TpuEngine:
     # an acceptance EMA / enter a probe cooldown, so incompressible
     # workloads fall back to the dense window pipeline at full depth.
 
-    def _row_draft(self, seq: _Seq, S: int) -> list[int]:
-        """Propose up to S draft tokens for one row, applying the
-        adaptive controls. Empty ⇒ the row rides the pass with
-        draft_len 0 (a plain next-token step) or, if no row drafts,
-        the batch falls back to the dense path entirely."""
+    def _row_draft(self, seq: _Seq, S: int):
+        """Propose a draft for one row — a token list (linear drafter)
+        or a TreeDraft (tree drafter) — applying the adaptive controls.
+        Empty ⇒ the row rides the pass with draft_len 0 (a plain
+        next-token step) or, if no row drafts, the batch falls back to
+        the dense path entirely."""
         args = self.args
         # Never draft past the model length: the pass emits up to
-        # draft_len+1 tokens and writes KV at positions0+draft_len.
+        # potential+1 tokens and writes KV slots up to positions0 +
+        # draft-node count (tree slots are slot-ordered, so the node
+        # budget bounds the write extent for any shape).
         cap = min(S, args.max_model_len - len(seq.tokens) - 1)
         if cap <= 0 or seq.spec_cool > 0:
             return []
@@ -1711,16 +1797,25 @@ class TpuEngine:
         eff = min(cap, max(1, round(S * min(1.0, seq.spec_ema / 0.5))))
         if seq.draft_state is None:
             seq.draft_state = self._drafter.new_state()
+        if hasattr(self._drafter, "draft_tree"):
+            return self._drafter.draft_tree(seq.tokens, seq.draft_state, eff)
         return self._drafter.draft(seq.tokens, seq.draft_state, eff)
 
-    def _spec_gate_passes(self, drafts: dict["_Seq", list[int]]) -> bool:
+    @staticmethod
+    def _draft_potential(d) -> int:
+        """Best-case accepted run of one proposal: the whole draft for a
+        chain, the deepest root path for a tree."""
+        return d.max_depth if isinstance(d, TreeDraft) else len(d)
+
+    def _spec_gate_passes(self, drafts: dict["_Seq", Any]) -> bool:
         """Batch-level dispatch decision: the EMA-weighted expected
-        tokens per row-pass, mean(1 + ema_i * draft_len_i), must clear
+        tokens per row-pass, mean(1 + ema_i * potential_i), must clear
         spec_gate — and at least one draft must exist at all."""
-        if not drafts or not any(drafts.values()):
+        if not drafts or not any(len(d) for d in drafts.values()):
             return False
         expected = sum(
-            1.0 + s.spec_ema * len(d) for s, d in drafts.items()
+            1.0 + s.spec_ema * self._draft_potential(d)
+            for s, d in drafts.items()
         ) / len(drafts)
         return expected >= self.args.spec_gate
 
@@ -1771,8 +1866,9 @@ class TpuEngine:
         if not self._spec_gate_passes(drafts):
             return False
         batch = list(self._running)
-        # Cover writes at positions0 + draft_len; rows that cannot grow
-        # fall back to the dense path's pressure handling (drain/preempt).
+        # Cover writes at positions0 + draft-node-count; rows that
+        # cannot grow fall back to the dense path's pressure handling
+        # (drain/preempt).
         for seq in batch:
             if not self._ensure_block(seq, lookahead=len(drafts[seq]) + 1):
                 return False
@@ -1790,15 +1886,33 @@ class TpuEngine:
         steps0 = np.zeros((B,), np.int32)
         pos0: list[int] = []
         draft_lens: list[int] = []
+        potentials: list[int] = []
+        node_tokens: list[list[int]] = []
+        node_parents: list[list[int]] = []
+        # A batch whose proposals are all CHAINS dispatches through the
+        # PR 5 linear op (byte-for-byte that path, including stepwise
+        # parity); any branched proposal upgrades the whole batch to the
+        # topology-masked tree op (chains are trees too).
+        any_tree = any(
+            isinstance(d, TreeDraft) and not d.is_chain()
+            for d in drafts.values()
+        )
         for i, seq in enumerate(batch):
             d = drafts[seq]
+            if isinstance(d, TreeDraft):
+                toks, pars = d.tokens, d.parents
+            else:
+                toks, pars = list(d), list(range(len(d)))
             tokens[i, 0] = seq.tokens[-1]
-            tokens[i, 1 : 1 + len(d)] = d
+            tokens[i, 1 : 1 + len(toks)] = toks
             p0 = seq.next_write_pos
             pos0.append(p0)
             pos0_arr[i] = p0
-            dlen[i] = len(d)
-            draft_lens.append(len(d))
+            dlen[i] = len(toks)
+            draft_lens.append(len(toks))
+            potentials.append(self._draft_potential(d))
+            node_tokens.append([seq.tokens[-1]] + list(toks))
+            node_parents.append([0] + list(pars))
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
             fold_slots[i] = seq.slot
@@ -1810,26 +1924,56 @@ class TpuEngine:
             self.args.top_logprobs_max
             if any(s.sampling.top_logprobs for s in batch) else 0
         )
+        tree = None
+        if any_tree:
+            tree = self._build_tree_args(B, S1, node_parents)
         ref = self._runner.spec_verify(
             S1, mode, tokens, pos0_arr, dlen, tables, active,
-            temps, seeds, steps0, fold_slots, top_n,
+            temps, seeds, steps0, fold_slots, top_n, tree,
         )
-        item = _Spec(batch, pos0, draft_lens, ref, top_n)
+        item = _Spec(
+            batch, pos0, draft_lens, ref, top_n,
+            potentials=potentials, tree=any_tree,
+            node_tokens=node_tokens, node_parents=node_parents,
+        )
         start_host_fetch(item.fetch_arrays())
         self._fetchq.append(item)
         self._phase("spec_dispatch", t0)
         return True
 
+    @staticmethod
+    def _build_tree_args(
+        B: int, S1: int, node_parents: list[list[int]]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Host-side tree topology for one verify dispatch → (parents
+        [B, S1], ancestor-or-self mask [B, S1, S1] int8, depth [B, S1]).
+        Rows beyond the live batch stay all-zero (inactive)."""
+        parents = np.zeros((B, S1), np.int32)
+        anc = np.zeros((B, S1, S1), np.int8)
+        depth = np.zeros((B, S1), np.int32)
+        for i, pars in enumerate(node_parents):
+            anc[i, 0, 0] = 1
+            for j in range(1, len(pars)):
+                p = pars[j]
+                parents[i, j] = p
+                anc[i, j] = anc[i, p]
+                anc[i, j, j] = 1
+                depth[i, j] = depth[i, p] + 1
+        return parents, anc, depth
+
     def _drain_spec(self, sp: "_Spec", blocked: bool = True) -> None:
         self.total_spec_passes += 1
+        if sp.tree:
+            self.total_spec_tree_passes += 1
         t0 = time.perf_counter()
         out_l = np.asarray(sp.ref.arrs[0]).tolist()     # [B][S1]
         n_emit_l = np.asarray(sp.ref.arrs[1]).tolist()  # [B]
         logps_l = np.asarray(sp.ref.arrs[2]).tolist()   # [B][S1]
+        cand_l = np.asarray(sp.ref.arrs[3]).tolist()    # [B][S1]
         tvals_l = tids_l = None
         if sp.top_n:
-            tvals_l = np.asarray(sp.ref.arrs[3]).tolist()  # [B][S1][n]
-            tids_l = np.asarray(sp.ref.arrs[4]).tolist()
+            tvals_l = np.asarray(sp.ref.arrs[4]).tolist()  # [B][S1][n]
+            tids_l = np.asarray(sp.ref.arrs[5]).tolist()
         t0 = self._phase("drain_sync" if blocked else "drain_ready", t0)
         alpha = self.args.spec_ema_alpha
         for i, seq in enumerate(sp.rows):
@@ -1845,9 +1989,29 @@ class TpuEngine:
             if S_i > 0:
                 self.total_spec_proposed += S_i
                 self.total_spec_accepted += a
-                seq.spec_ema = (1 - alpha) * seq.spec_ema + alpha * (a / S_i)
+                # EMA over ACHIEVABLE acceptance: a tree that branches 4
+                # wide can only accept down its deepest path, so the
+                # potential (max depth; == S_i for a chain) is the
+                # honest denominator for the shrink/disable controls.
+                pot = max(1, sp.potentials[i])
+                seq.spec_ema = (1 - alpha) * seq.spec_ema + alpha * (a / pot)
                 if seq.spec_ema < self.args.spec_ema_disable:
                     seq.spec_cool = self.args.spec_probe_every
+                if sp.tree:
+                    self.total_spec_tree_rows += 1
+                    self.total_spec_tree_depth += a
+                    self._spec_depth_hist[a] += 1
+            # Jacobi-pool refresh for EVERY live row — including rows
+            # that proposed nothing (their root-node cand is exactly the
+            # zero-history-hit seed the Lookahead pool exists for):
+            # every node's (context → argmax prediction) pair is free
+            # drafting signal, rejected branches included. seq.tokens
+            # still ends at this pass's root (emission happens below).
+            if seq.draft_state is not None and sp.node_tokens is not None:
+                self._drafter.observe(
+                    seq.draft_state, seq.tokens, sp.node_tokens[i],
+                    sp.node_parents[i], S_i + 1, cand_l[i],
+                )
             # Positions p0..p0+a hold CORRECT KV ([last, accepted
             # drafts]); the correction/bonus token's KV lands on the next
             # dispatch, exactly like a dense window's last sample. Junk

@@ -637,6 +637,9 @@ def spec_verify_impl(
     temperature: jax.Array,   # [B] fp32 (<=0 → greedy row)
     seeds: jax.Array,         # [B] uint32 per-row sample seed
     steps0: jax.Array,        # [B] int32 per-row emission index of the first token
+    tree_parents: jax.Array | None = None,  # [B, S1] int32 — tree mode (below)
+    tree_anc: jax.Array | None = None,      # [B, S1, S1] int8 ancestor-or-self
+    tree_depth: jax.Array | None = None,    # [B, S1] int32 per-node depth
     *,
     fused: bool = True,       # static — single-pass forward vs stepwise scan
     attn_impl: str = "auto",  # attention backend: stepwise decode steps AND
@@ -673,12 +676,30 @@ def spec_verify_impl(
     dispatch rewrites those positions (block lookahead already covers
     them), so nothing downstream observes it.
 
+    **Tree mode** (``tree_parents`` given): the S1 slots form a draft
+    TREE (SpecInfer) instead of a chain. Node j writes its KV at SLOT
+    position positions0+j (slots are distinct even when depths collide),
+    RoPE-rotates at its true sequence position positions0+depth[j], and
+    attends paged history plus exactly its ancestor-or-self slots via
+    the [S1, S1] topology mask (ops.paged_spec_attention ``anc``).
+    Acceptance walks the longest accepted root path
+    (sampler.spec_tree_acceptance — argmax chain for greedy rows,
+    multi-round rejection sampling for sampled ones), and the accepted
+    path's KV is then COMPACTED on device into contiguous positions
+    positions0+1..positions0+a (non-accepted branches' writes are
+    redirected to garbage block 0) — so the engine's rollback contract
+    is identical to the linear path's. Tree mode always runs the fused
+    forward: a branched topology has no stepwise decode-step equivalent
+    (``fused=False`` is the linear parity anchor only).
+
     Returns (out [B, S1] emitted tokens, n_emit [B] = accepted+1,
-    logps [B, S1] raw chosen-token logprobs, top_vals [B, S1, top_n],
-    top_ids [B, S1, top_n], last_tok [B] = out[b, n_emit-1] for the
-    chain-buffer fold, cache)."""
+    logps [B, S1] raw chosen-token logprobs, cand [B, S1] per-node
+    argmax predictions — free Jacobi-pool food for the drafter,
+    top_vals [B, S1, top_n], top_ids [B, S1, top_n], last_tok [B] =
+    out[b, n_emit-1] for the chain-buffer fold, cache)."""
     from dynamo_tpu.engine.sampler import (
         spec_acceptance,
+        spec_tree_acceptance,
         top_k_logprobs,
     )
     from dynamo_tpu.ops.paged_attention import (
@@ -690,20 +711,34 @@ def spec_verify_impl(
     B, T = tokens.shape
     bs = cache.k.shape[2]
     KVH, hd = cfg.num_kv_heads, cfg.head_dim
-    pos = positions0[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
-    use = active[:, None] & (
-        jnp.arange(T, dtype=jnp.int32)[None, :] <= draft_len[:, None]
-    )                                                                    # [B, T]
+    tree = tree_parents is not None
+    slot = jnp.arange(T, dtype=jnp.int32)[None, :]
+    use = active[:, None] & (slot <= draft_len[:, None])                 # [B, T]
+    # Write position per slot (always slot-ordered: distinct cache slots
+    # regardless of tree shape) and RoPE position per node (its true
+    # sequence depth — equal to the slot index for a chain).
+    wpos = positions0[:, None] + slot                                    # [B, T]
+    pos = wpos if not tree else positions0[:, None] + tree_depth
 
-    if fused:
+    if fused or tree:
         compute_dtype = params["layers"]["attn_norm"].dtype
         x = _embed_rows(params, tokens, compute_dtype)  # [B, T, D]
 
         blk = jnp.where(
-            use, jnp.take_along_axis(block_tables, pos // bs, axis=1), 0
+            use, jnp.take_along_axis(block_tables, wpos // bs, axis=1), 0
         )
-        off = jnp.where(use, pos % bs, 0)
-        lengths = jnp.where(use, pos + 1, 0)  # [B, T] — query j attends [0, pos_j]
+        off = jnp.where(use, wpos % bs, 0)
+        if tree:
+            # Per-query paged-history horizon; the slot window rides on
+            # top of it under the topology mask (dead queries/slots are
+            # masked out of the anc bits entirely).
+            lengths = jnp.where(use, positions0[:, None], 0)
+            anc = (
+                (tree_anc != 0) & use[:, :, None] & use[:, None, :]
+            ).astype(jnp.int8)
+        else:
+            lengths = jnp.where(use, pos + 1, 0)  # query j attends [0, pos_j]
+            anc = None
 
         G = cfg.num_heads // KVH
         # Fused spec-verify gather (ops.paged_spec_attention): one Pallas
@@ -757,13 +792,13 @@ def spec_verify_impl(
             if use_kernel:
                 o = paged_spec_attention(
                     qg, k_cache, v_cache, layer_idx, block_tables, lengths,
-                    k_scale, v_scale,
+                    k_scale, v_scale, anc,
                     interpret=(impl == "pallas_interpret"),
                 )
             else:
                 o = paged_spec_attention_xla(
                     qg, k_cache, v_cache, layer_idx, block_tables, lengths,
-                    k_scale, v_scale,
+                    k_scale, v_scale, anc=anc,
                 )
             o = o.reshape(B, T, cfg.q_size)
             x = x + _dot_q(o, lp, "wo")
@@ -794,25 +829,66 @@ def spec_verify_impl(
         )
         logits = jnp.transpose(logits_t, (1, 0, 2))  # [B, T, V] fp32
 
-    drafts = tokens[:, 1:]
-    out, n_emit = spec_acceptance(
-        logits, drafts, draft_len, temperature, seeds, steps0, mode
-    )
+    if tree:
+        out, n_emit, path, cand = spec_tree_acceptance(
+            logits, tokens, tree_parents, draft_len, temperature, seeds,
+            steps0, mode,
+        )
+        # Everything downstream reads PATH-ALIGNED logits: emitted token
+        # k came from node path[k]'s distribution (path is clamped to
+        # the stopping node past n_emit, so the gathers stay in-bounds).
+        logits_out = jnp.take_along_axis(logits, path[:, :, None], axis=1)
+        # KV compaction: relocate the accepted path's KV from its tree
+        # slots to the contiguous positions the engine's rollback
+        # contract expects (positions0+k holds the depth-k accepted
+        # node); depths beyond the accepted run redirect to garbage
+        # block 0. Gather-before-scatter, so aliasing (path[k] == k on
+        # chain prefixes) is value-identical, and the moved bytes are
+        # ~the KV the pass just wrote — noise next to the weight stream.
+        kdepth = jnp.arange(1, T, dtype=jnp.int32)[None, :]       # [1, S]
+        src_pos = positions0[:, None] + path[:, 1:]
+        dst_pos = positions0[:, None] + kdepth
+        keep = active[:, None] & (kdepth < n_emit[:, None])
+        src_blk = jnp.take_along_axis(block_tables, src_pos // bs, axis=1)
+        src_off = src_pos % bs
+        dst_blk = jnp.where(
+            keep, jnp.take_along_axis(block_tables, dst_pos // bs, axis=1), 0
+        )
+        dst_off = jnp.where(keep, dst_pos % bs, 0)
+        k_cache, v_cache = cache.k, cache.v
+        k_scale, v_scale = cache.k_scale, cache.v_scale
+        k_cache = k_cache.at[:, dst_blk, dst_off].set(k_cache[:, src_blk, src_off])
+        v_cache = v_cache.at[:, dst_blk, dst_off].set(v_cache[:, src_blk, src_off])
+        if k_scale is not None:
+            k_scale = k_scale.at[:, dst_blk, dst_off].set(
+                k_scale[:, src_blk, src_off]
+            )
+            v_scale = v_scale.at[:, dst_blk, dst_off].set(
+                v_scale[:, src_blk, src_off]
+            )
+        cache = KVCache(k_cache, v_cache, k_scale, v_scale)
+    else:
+        drafts = tokens[:, 1:]
+        out, n_emit = spec_acceptance(
+            logits, drafts, draft_len, temperature, seeds, steps0, mode
+        )
+        cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits_out = logits
     # Raw-distribution logprobs of the EMITTED tokens (dense parity:
     # OpenAI reports model logprobs, not sampler-modified ones).
-    logz = jax.nn.logsumexp(logits, axis=-1)
+    logz = jax.nn.logsumexp(logits_out, axis=-1)
     logps = (
-        jnp.take_along_axis(logits, out[:, :, None], axis=-1)[..., 0] - logz
+        jnp.take_along_axis(logits_out, out[:, :, None], axis=-1)[..., 0] - logz
     )                                                      # [B, T]
     if top_n > 0:
-        flat_vals, flat_ids = top_k_logprobs(logits.reshape(B * T, -1), top_n)
+        flat_vals, flat_ids = top_k_logprobs(logits_out.reshape(B * T, -1), top_n)
         top_vals = flat_vals.reshape(B, T, top_n)
         top_ids = flat_ids.reshape(B, T, top_n)
     else:
         top_vals = jnp.zeros((B, T, 0), jnp.float32)
         top_ids = jnp.zeros((B, T, 0), jnp.int32)
     last_tok = jnp.take_along_axis(out, (n_emit - 1)[:, None], axis=1)[:, 0]
-    return out, n_emit, logps, top_vals, top_ids, last_tok, cache
+    return out, n_emit, logps, cand, top_vals, top_ids, last_tok, cache
 
 
 def embed_impl(

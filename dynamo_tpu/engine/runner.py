@@ -271,18 +271,29 @@ class LocalRunner:
 
     def spec_verify(self, S1, mode, tokens, positions0, draft_len, tables,
                     active, temps, seeds, steps0, fold_slots=None, top_n=0,
-                    *, rid=None) -> StepRef:
+                    tree=None, *, rid=None) -> StepRef:
         """One speculative verify pass: a single forward over ``S1``
-        consecutive positions per row (one weight stream) with on-device
-        acceptance. The pass's FINAL emitted token folds into the
-        per-slot chain buffer like a window's last sample. Ref arrays:
-        (out [B, S1], n_emit [B], logps [B, S1], top_vals, top_ids)."""
+        positions per row (one weight stream) with on-device acceptance.
+        ``tree`` = None for a linear draft, or (parents [B, S1],
+        anc [B, S1, S1], depth [B, S1]) numpy arrays for a SpecInfer
+        token tree — the topology mask rides the same fused gather and
+        the accepted root path is compacted on device. The pass's FINAL
+        emitted token folds into the per-slot chain buffer like a
+        window's last sample. Ref arrays: (out [B, S1], n_emit [B],
+        logps [B, S1], cand [B, S1], top_vals, top_ids)."""
         self._ensure_last_toks()
-        out, n_emit, logps, tvals, tids, last_tok, self.cache = M.spec_verify(
+        tp = ta = td = None
+        if tree is not None:
+            parents, anc, depth = tree
+            tp = jnp.asarray(parents, jnp.int32)
+            ta = jnp.asarray(anc, jnp.int8)
+            td = jnp.asarray(depth, jnp.int32)
+        out, n_emit, logps, cand, tvals, tids, last_tok, self.cache = M.spec_verify(
             self.cfg, int(S1), mode, int(top_n), self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions0),
             jnp.asarray(draft_len), jnp.asarray(tables), jnp.asarray(active),
             jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
+            tp, ta, td,
             fused=self.args.spec_fused, attn_impl=self.attn_impl,
         )
         if fold_slots is None:
@@ -290,7 +301,7 @@ class LocalRunner:
         self._last_toks = _fold_tokens(
             self._last_toks, last_tok, jnp.asarray(fold_slots, jnp.int32)
         )
-        return self._new_ref((out, n_emit, logps, tvals, tids), rid)
+        return self._new_ref((out, n_emit, logps, cand, tvals, tids), rid)
 
     def stack_rows(self, srcs) -> jax.Array:
         """srcs: list of (StepRef-or-rid, row|None); row None → arr is [V]."""
@@ -481,7 +492,7 @@ class LeaderRunner(LocalRunner):
 
     def spec_verify(self, S1, mode, tokens, positions0, draft_len, tables,
                     active, temps, seeds, steps0, fold_slots=None, top_n=0,
-                    *, rid=None) -> StepRef:
+                    tree=None, *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "spec_verify", "rid": rid, "S1": int(S1), "mode": mode,
                     "tokens": _pack_np(tokens), "positions0": _pack_np(positions0),
@@ -489,10 +500,13 @@ class LeaderRunner(LocalRunner):
                     "active": _pack_np(active), "temps": _pack_np(temps),
                     "seeds": _pack_np(seeds), "steps0": _pack_np(steps0),
                     "top_n": int(top_n),
+                    "tree": None if tree is None else [
+                        _pack_np(np.asarray(a)) for a in tree
+                    ],
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().spec_verify(S1, mode, tokens, positions0, draft_len,
                                    tables, active, temps, seeds, steps0,
-                                   fold_slots, top_n, rid=rid)
+                                   fold_slots, top_n, tree, rid=rid)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
                     steps, full: bool, fold_slots=None, top_n: int = 0):
@@ -603,6 +617,7 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 rid=desc["rid"])
         elif op == "spec_verify":
             fold = desc.get("fold")
+            tree = desc.get("tree")
             runner.spec_verify(
                 desc["S1"], desc["mode"], _unpack_np(desc["tokens"]),
                 _unpack_np(desc["positions0"]), _unpack_np(desc["draft_len"]),
@@ -610,7 +625,9 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["temps"]), _unpack_np(desc["seeds"]),
                 _unpack_np(desc["steps0"]),
                 None if fold is None else _unpack_np(fold),
-                desc.get("top_n", 0), rid=desc["rid"])
+                desc.get("top_n", 0),
+                None if tree is None else tuple(_unpack_np(a) for a in tree),
+                rid=desc["rid"])
         elif op == "sample_rows":
             fold = desc.get("fold")
             runner.sample_rows(

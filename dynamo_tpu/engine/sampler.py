@@ -27,6 +27,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 _GREEDY_EPS = 1e-5
 
@@ -253,6 +254,151 @@ def spec_acceptance(
         return out, a + 1
     a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)  # [B]
     return out, a + 1
+
+
+def _tree_uniform(seeds: jax.Array, steps0: jax.Array, S1: int) -> jax.Array:
+    """Per-(row, tree-node) accept uniforms for multi-path rejection
+    sampling: key = fold(fold(fold(PRNGKey(seed), steps0), node), 3).
+    Keyed by NODE SLOT (not depth): sibling rounds at one parent need
+    independent draws. Tag 3 keeps the stream disjoint from the dense
+    gumbels, the linear-spec uniforms (tag 1) and residuals (tag 2)."""
+
+    def one(s, e0, j):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(jax.random.PRNGKey(s), e0), j), 3
+        )
+        return jax.random.uniform(key, (), jnp.float32, minval=1e-12, maxval=1.0)
+
+    nodes = jnp.arange(S1, dtype=jnp.int32)
+    return jax.vmap(
+        jax.vmap(one, in_axes=(None, None, 0)), in_axes=(0, 0, None)
+    )(seeds, steps0, nodes)
+
+
+def _tree_gumbel(seeds: jax.Array, steps0: jax.Array, dense_stream: jax.Array,
+                 S1: int, V: int) -> jax.Array:
+    """Per-(row, tree-node) gumbel noise for correction/bonus samples at
+    the traversal's stopping node. Rows with ``dense_stream`` True (no
+    draft at all) draw node 0 from the dense path's exact (seed, step)
+    key — speculation is then a true no-op for them; every other draw is
+    tag-folded (4) so it stays disjoint from all dense draws."""
+
+    def one(s, e0, j, dense):
+        base = jax.random.fold_in(jax.random.PRNGKey(s), e0)
+        tagged = jax.random.fold_in(jax.random.fold_in(base, j), 4)
+        key = jnp.where(dense & (j == 0), base, tagged)
+        return jax.random.gumbel(key, (V,), jnp.float32)
+
+    nodes = jnp.arange(S1, dtype=jnp.int32)
+    return jax.vmap(
+        jax.vmap(one, in_axes=(None, None, 0, None)),
+        in_axes=(0, 0, None, 0),
+    )(seeds, steps0, nodes, dense_stream)
+
+
+def spec_tree_acceptance(
+    logits: jax.Array,       # [B, S1, V] fp32 — verify logits per tree node
+    tokens: jax.Array,       # [B, S1] int32 — node input tokens (slot 0 = root)
+    parents: jax.Array,      # [B, S1] int32 — parent NODE index (< own index; 0 for root)
+    draft_len: jax.Array,    # [B] int32 — live draft nodes (tree size - 1)
+    temperature: jax.Array,  # [B] fp32 (<= 0 → greedy row)
+    seeds: jax.Array,        # [B] uint32 per-row sample seed
+    steps0: jax.Array,       # [B] int32 emission index of the pass's first token
+    mode: str,               # static — "greedy" | "simple"
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Multi-path (SpecInfer-style) acceptance over one TREE verify pass
+    → (out [B, S1], n_emit [B], path [B, S1], cand [B, S1]).
+
+    Traversal starts at the root and walks accepted edges: at each node
+    its live children are tried in slot order and the walk descends into
+    the first accepted one; when none accepts (or the node is a leaf)
+    the node emits one final corrected/bonus token and the walk stops.
+    ``out[:, :n_emit]`` is the emitted run, ``path[k]`` the node whose
+    logits emitted ``out[k]`` (clamped to the stopping node past the
+    end — the KV-compaction gather and logprob reads stay in-bounds).
+
+    - "greedy": edge (v → j) accepts iff ``tokens[j] == argmax(p_v)``;
+      the emitted run IS the argmax chain, byte-identical to dense
+      greedy for any tree shape (a linear chain reduces to
+      ``spec_acceptance``'s rule exactly).
+    - "simple": multi-round rejection sampling per node — child i (slot
+      order) accepts with probability p_v(x_i) / (1 - Σ_{j<i} p_v(x_j)),
+      the point-mass multi-draft residual schedule; after k rejections
+      the stopping node samples the residual with all tried children
+      masked (gumbel-argmax), which leaves the target distribution
+      exactly unchanged. Sibling tokens must be DISTINCT (the drafters
+      guarantee it); width-1 trees reduce to Leviathan acceptance.
+      Greedy rows inside a simple batch use the argmax rule."""
+    B, S1, V = logits.shape
+    node = jnp.arange(S1, dtype=jnp.int32)
+    live = (node[None, :] <= draft_len[:, None]) & (node[None, :] >= 1)  # edges
+    cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)                 # [B, S1]
+    cand_par = jnp.take_along_axis(cand, parents, axis=1)                # cand[parent[j]]
+    acc_greedy = (tokens == cand_par) & live
+    if mode == "greedy":
+        acc = acc_greedy
+        final_per_node = cand
+    else:
+        greedy = temperature < _GREEDY_EPS
+        temp = jnp.where(greedy, 1.0, temperature)
+        scaled = logits / temp[:, None, None]
+        logz = jax.nn.logsumexp(scaled, axis=-1)                         # [B, S1]
+        bidx = jnp.arange(B)[:, None]
+        # p of node j's token under its PARENT's distribution.
+        ptok = jnp.exp(scaled[bidx, parents, tokens] - logz[bidx, parents])
+        ptok = jnp.where(live, ptok, 0.0)                                # [B, S1]
+        # Earlier-sibling mass: Σ p_v(x_j') over live siblings j' < j.
+        sib = (
+            (parents[:, :, None] == parents[:, None, :])
+            & (node[None, None, :] < node[None, :, None])
+            & live[:, None, :]
+        )                                                                # [B, j, j']
+        prevmass = jnp.einsum("bjk,bk->bj", sib.astype(jnp.float32), ptok)
+        Z = 1.0 - prevmass
+        u = _tree_uniform(seeds, steps0, S1)
+        acc_samp = live & (Z > 0.0) & (u * Z < ptok)
+        acc = jnp.where(greedy[:, None], acc_greedy, acc_samp)
+        # Final corrected/bonus token per candidate stopping node v:
+        # gumbel-argmax of the scaled logits with v's live children
+        # masked out. At a leaf the mask is empty (pure bonus sample);
+        # after k rejections it is exactly the k-round residual.
+        contrib = jnp.zeros((B, S1, V), jnp.float32).at[
+            bidx, parents, tokens
+        ].add(live.astype(jnp.float32))
+        child_mask = contrib > 0.0
+        gumbel = _tree_gumbel(seeds, steps0, draft_len == 0, S1, V)
+        noisy = jnp.where(child_mask, -jnp.inf, scaled + gumbel)
+        final_sampled = jnp.argmax(noisy, axis=-1).astype(jnp.int32)
+        final_per_node = jnp.where(greedy[:, None], cand, final_sampled)
+    # First accepted child per node (slot order = sibling try order;
+    # acc[j] already conditions on every earlier sibling rejecting).
+    childmat = (parents[:, None, :] == node[None, :, None]) & acc[:, None, :]
+    chosen = jnp.min(
+        jnp.where(childmat, node[None, None, :], S1), axis=2
+    ).astype(jnp.int32)                                                  # [B, S1]
+
+    def walk(cur, _):
+        nxt = jnp.take_along_axis(chosen, cur[:, None], axis=1)[:, 0]
+        ok = nxt < S1
+        new = jnp.where(ok, nxt, cur)
+        return new, (new, ok)
+
+    _, (steps_nodes, oks) = lax.scan(
+        walk, jnp.zeros((B,), jnp.int32), None, length=S1 - 1
+    )
+    path = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), jnp.transpose(steps_nodes)], axis=1
+    )                                                                    # [B, S1]
+    a = jnp.sum(oks.astype(jnp.int32), axis=0)                           # [B]
+    # out[k < a] = token of the accepted depth-(k+1) node; out[a] = the
+    # stopping node's final sample; beyond n_emit the values are junk.
+    child_at = jnp.concatenate([path[:, 1:], path[:, -1:]], axis=1)
+    tok_child = jnp.take_along_axis(tokens, child_at, axis=1)
+    final = jnp.take_along_axis(
+        final_per_node, jnp.take_along_axis(path, a[:, None], axis=1), axis=1
+    )                                                                    # [B, 1]
+    out = jnp.where(node[None, :] < a[:, None], tok_child, final)
+    return out, a + 1, path, cand
 
 
 def row_needs_full(top_k, top_p, freq_penalty, pres_penalty) -> bool:

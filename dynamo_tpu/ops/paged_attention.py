@@ -138,6 +138,7 @@ def paged_spec_attention_xla(
     lengths: jax.Array,      # [B, T] int32 — query t attends [0, lengths[b, t])
     k_scale: jax.Array | None = None,  # [L, N, bs, KVH] fp32 — int8 cache only
     v_scale: jax.Array | None = None,
+    anc: jax.Array | None = None,  # [B, T, T] — tree topology mask (below)
 ) -> jax.Array:
     """Multi-query generalization of ``paged_decode_attention_xla`` for
     the speculative verify pass: T consecutive positions per row attend
@@ -147,6 +148,17 @@ def paged_spec_attention_xla(
     T=1 reduces exactly to the decode formulation, so CPU/XLA greedy
     byte-identity between the spec and dense paths holds by construction.
     With scales the gathered pages dequantize in the same expression.
+
+    **Tree mode** (``anc`` given): the T in-flight rows form a draft
+    TREE. Node j's KV is written at slot position ``hist + j``, where
+    ``hist`` is the row's paged-history horizon — ``lengths[b, t]``
+    carries that per-query horizon (the caller passes positions0 for
+    every live query, 0 for dead ones).  Query t attends ``[0, hist)``
+    paged history PLUS exactly the in-flight slots s with
+    ``anc[b, t, s]`` nonzero — its ancestor-or-self set.  The linear draft is the special case
+    ``anc[t, s] = (s <= t)`` with ``lengths[b, t] = hist`` (equivalent
+    to the non-tree call with ``lengths[b, t] = hist + t + 1``), so the
+    tree mask is a strict generalization of the causal ramp.
     Returns [B, T, KVH, G, hd] in q.dtype. (``paged_spec_attention`` is
     the Pallas upgrade: the gather+dequant happen in-register, no
     materialized relayout copy.)"""
@@ -161,9 +173,20 @@ def paged_spec_attention_xla(
     pv = gather_dequant_pages(layer_v, sv, block_tables, KVH, hd, q.dtype)
     scale = hd ** -0.5
     ctx = jnp.arange(pk.shape[1], dtype=jnp.int32)
-    mask = jnp.where(
-        ctx[None, None, :] < lengths[:, :, None], 0.0, jnp.float32(NEG_INF)
-    )                                                       # [B, T, W*bs]
+    hist_mask = ctx[None, None, :] < lengths[:, :, None]    # [B, T, W*bs]
+    if anc is None:
+        attend = hist_mask
+    else:
+        # Tree: slot s of the in-flight rows lives at paged position
+        # hist + s; gather the per-query ancestor bit for positions in
+        # the slot window.
+        slot = ctx[None, None, :] - lengths[:, :, None]     # [B, T, C]
+        in_window = (slot >= 0) & (slot < T)
+        anc_g = jnp.take_along_axis(
+            (anc != 0), jnp.clip(slot, 0, T - 1), axis=2
+        )                                                   # [B, T, C]
+        attend = hist_mask | (in_window & anc_g)
+    mask = jnp.where(attend, 0.0, jnp.float32(NEG_INF))
     s = jnp.einsum("btkgh,bckh->btkgc", q, pk).astype(jnp.float32) * scale
     s = s + mask[:, :, None, None, :]
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
@@ -189,24 +212,34 @@ def _mq_kernel(
     layer_ref,    # [1] int32
     rowlen_ref,   # [B] int32 — max attend length per row (chunk walk bound)
     tables_ref,   # [B, W] int32
-    # operands (kscale/vscale present only when quantized)
+    # operands (anc present only in tree mode; kscale/vscale when quantized)
     *refs,
     # static
     pages_per_chunk: int,
     head_dim: int,
     quantized: bool,
+    tree_slots: int = 0,
 ):
+    refs = list(refs)
+    qbd_ref, lenvec_ref = refs[:2]
+    refs = refs[2:]
+    anc_ref = None
+    if tree_slots:
+        anc_ref, refs = refs[0], refs[1:]
     if quantized:
-        (qbd_ref, lenvec_ref, kscale_ref, vscale_ref, k_hbm, v_hbm,
+        (kscale_ref, vscale_ref, k_hbm, v_hbm,
          o_ref, kbuf, vbuf, m_scr, l_scr, acc_scr, slot_ref, started_ref,
          sem) = refs
     else:
-        (qbd_ref, lenvec_ref, k_hbm, v_hbm,
+        (k_hbm, v_hbm,
          o_ref, kbuf, vbuf, m_scr, l_scr, acc_scr, slot_ref, started_ref,
          sem) = refs
         kscale_ref = vscale_ref = None
     # qbd_ref    VMEM [1, KVH*hd, H] — block-diag q, softmax scale folded in
-    # lenvec_ref VMEM [1, H] int32 — per query COLUMN attend length
+    # lenvec_ref VMEM [1, H] int32 — per query COLUMN attend length; in
+    #            tree mode the per-column HISTORY horizon (slots ride on top)
+    # anc_ref    VMEM [1, T, H] int8 — tree mode: anc[s, col] = query col
+    #            may attend in-flight slot s (its ancestor-or-self set)
     # kscale_ref VMEM [1, W, bs, KVH] f32 — per-position-per-head scales
     # k_hbm      ANY  [L, N, bs, KVH*hd]
     # o_ref      VMEM [1, KVH*hd, H] — attention out, transposed
@@ -338,8 +371,18 @@ def _mq_kernel(
         )                                                  # [P*bs, H]
         # Per-COLUMN causal horizon: column (k, t, g) attends positions
         # [0, lengths[b, t]) — for decode (T=1) every column carries the
-        # row length and this is exactly the old row mask.
-        s = jnp.where(pos < lenvec_ref[0:1, :], s, NEG_INF)
+        # row length and this is exactly the old row mask. Tree mode
+        # adds the topology bits: in-flight slot s_i sits at paged
+        # position hist + s_i and column t attends it only when
+        # anc[s_i, col] is set (T compares on the VPU, T is small).
+        att = pos < lenvec_ref[0:1, :]
+        if tree_slots:
+            for s_i in range(tree_slots):
+                att = att | (
+                    (pos == lenvec_ref[0:1, :] + s_i)
+                    & (anc_ref[0, s_i, :][None, :] != 0)
+                )
+        s = jnp.where(att, s, NEG_INF)
 
         m_prev = m_scr[0:1, :H]                            # [1, H]
         l_prev = l_scr[0:1, :H]
@@ -382,6 +425,7 @@ def _paged_attention_mq(
     v_scale: jax.Array | None,
     pages_per_chunk: int,
     interpret: bool,
+    anc: jax.Array | None = None,  # [B, T, T] — tree topology mask
 ) -> jax.Array:
     """Shared Pallas driver: T query positions per row walk the row's
     true pages once. Returns [B, T, KVH, G, hd] in q.dtype."""
@@ -415,12 +459,29 @@ def _paged_attention_mq(
         lengths[:, None, :, None], (B, KVH, T, G)
     ).reshape(B, H)
     rowlen = jnp.max(lengths, axis=1)  # chunk-walk bound per row
+    if anc is not None:
+        # Tree mode: the walk must also cover the T in-flight slots at
+        # positions [hist, hist + T); rows with no live node at all
+        # (anc identically zero — padding rows) stay empty so the
+        # prefetch skip keeps them ~free.
+        live_row = jnp.any(anc != 0, axis=(1, 2))
+        rowlen = jnp.where(live_row, rowlen + T, 0)
 
     operands = [qbd, lenvec]
     in_specs = [
         pl.BlockSpec((1, KVH * hd, H), lambda b, c, *_: (b, 0, 0)),
         pl.BlockSpec((1, H), lambda b, c, *_: (b, 0)),
     ]
+    if anc is not None:
+        # Column-order ancestor bits [B, T_slot, H]: anc_cols[b, s, col]
+        # with col = (k*T + t)*G + g — the same (k, t, g) layout as
+        # lenvec/qbd, prefetched per row block alongside the scales.
+        anc_b = jnp.asarray(anc != 0, jnp.int8).transpose(0, 2, 1)  # [B, Ts, Tq]
+        anc_cols = jnp.broadcast_to(
+            anc_b[:, :, None, :, None], (B, T, KVH, T, G)
+        ).reshape(B, T, H)
+        operands.append(anc_cols)
+        in_specs.append(pl.BlockSpec((1, T, H), lambda b, c, *_: (b, 0, 0)))
     if quantized:
         # Scales ride as per-row VMEM blocks gathered OUTSIDE the kernel:
         # [B, W, bs, KVH] fp32 is 1/head_dim the page bytes, so the XLA
@@ -439,7 +500,8 @@ def _paged_attention_mq(
     ]
 
     kernel = functools.partial(
-        _mq_kernel, pages_per_chunk=P, head_dim=hd, quantized=quantized
+        _mq_kernel, pages_per_chunk=P, head_dim=hd, quantized=quantized,
+        tree_slots=T if anc is not None else 0,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -516,6 +578,7 @@ def paged_spec_attention(
     lengths: jax.Array,      # [B, T] int32
     k_scale: jax.Array | None = None,  # [L, N, bs, KVH] fp32 — int8 cache only
     v_scale: jax.Array | None = None,
+    anc: jax.Array | None = None,  # [B, T, T] — tree topology mask
     *,
     pages_per_chunk: int = 0,
     interpret: bool = False,
@@ -525,9 +588,14 @@ def paged_spec_attention(
     in-register dequant when the cache is int8, online softmax — instead
     of the XLA path's materialized (dequantized) relayout copy of the
     whole gathered table (the ~9ms/layer tax in the module header).
+    With ``anc`` the rows form a draft TREE: ``lengths`` carries each
+    query's paged-history horizon and the [T, T] ancestor mask rides as
+    one more per-row prefetched operand (see
+    ``paged_spec_attention_xla``) — tree verify is the same
+    one-weight-stream gather, just with T extra VPU compares per chunk.
     Requires KVH*T*G ≤ 128 lanes; callers fall back to
     ``paged_spec_attention_xla`` beyond that (model.spec_verify does)."""
     return _paged_attention_mq(
         q, k_cache, v_cache, layer_idx, block_tables, lengths,
-        k_scale, v_scale, pages_per_chunk, interpret,
+        k_scale, v_scale, pages_per_chunk, interpret, anc,
     )

@@ -96,6 +96,12 @@ def parse_args(argv=None):
                    help="verify drafts with a stepwise scan (bitwise parity "
                         "with the dense path; forfeits the single-weight-"
                         "stream win) instead of the fused single-pass forward")
+    p.add_argument("--spec-tree-width", type=int, default=1,
+                   help="max draft-tree branching factor (1 = linear drafts; "
+                        ">= 2 enables SpecInfer-style tree verification with "
+                        "the topology-masked kernel + Lookahead Jacobi pool)")
+    p.add_argument("--spec-tree-depth", type=int, default=0,
+                   help="max draft-tree path depth (0 = spec-tokens)")
     p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
                    default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -438,6 +444,8 @@ def _engine_args(args, model):
         spec_tokens=args.spec_tokens,
         spec_ngram=args.spec_ngram,
         spec_fused=not args.spec_stepwise,
+        spec_tree_width=args.spec_tree_width,
+        spec_tree_depth=args.spec_tree_depth,
         attn_impl=args.attn_impl,
         quant=args.quant,
         kv_quant=args.kv_quant,

@@ -249,6 +249,114 @@ def test_spec_kernel_single_page_chunks():
     )
 
 
+def _tree_anc(parents: list[int], T: int) -> np.ndarray:
+    """Ancestor-or-self closure for node parents (node 0 = root)."""
+    anc = np.zeros((T, T), np.int8)
+    anc[0, 0] = 1
+    for j, p in enumerate(parents, start=1):
+        anc[j] = anc[p]
+        anc[j, j] = 1
+    return anc
+
+
+def test_tree_mask_chain_reduces_to_linear():
+    """A lower-triangular topology mask with per-query history horizons
+    must reproduce the legacy linear-lengths call exactly (the tree mask
+    is a strict generalization of the causal ramp)."""
+    rng = np.random.default_rng(30)
+    L, N, bs, KVH, hd = 2, 48, 8, 2, 64
+    B, W, G, T = 3, 6, 2, 5
+    k_cache = _mk(rng, (L, N, bs, KVH * hd))
+    v_cache = _mk(rng, (L, N, bs, KVH * hd))
+    q = _mk(rng, (B, T, KVH, G, hd))
+    tables = jnp.asarray(rng.integers(1, N, size=(B, W)), jnp.int32)
+    hist = np.array([7, 12, 3], np.int32)
+    lens_linear = hist[:, None] + np.arange(1, T + 1, dtype=np.int32)[None, :]
+    lens_tree = np.broadcast_to(hist[:, None], (B, T)).copy()
+    anc = np.broadcast_to(np.tril(np.ones((T, T), np.int8)), (B, T, T)).copy()
+    ref = paged_spec_attention_xla(
+        q, k_cache, v_cache, jnp.int32(0), tables, jnp.asarray(lens_linear)
+    )
+    tree = paged_spec_attention_xla(
+        q, k_cache, v_cache, jnp.int32(0), tables, jnp.asarray(lens_tree),
+        anc=jnp.asarray(anc),
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(tree), atol=1e-6)
+    out = paged_spec_attention(
+        q, k_cache, v_cache, jnp.int32(0), tables, jnp.asarray(lens_tree),
+        anc=jnp.asarray(anc), interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("hist", [
+    [7, 8, 3, 0],    # page-boundary crossing (bs=8), on-boundary, partial, dead
+    [15, 1, 9, 5],   # slot window straddles a page boundary for row 0
+])
+def test_tree_mask_kernel_matches_xla(hist):
+    """Topology-masked kernel vs the XLA reference on a real branched
+    tree: root with two subtrees, dead row, dead trailing slots."""
+    rng = np.random.default_rng(31)
+    L, N, bs, KVH, hd = 2, 48, 8, 2, 64
+    B, W, G, T = 4, 6, 2, 5
+    k_cache = _mk(rng, (L, N, bs, KVH * hd))
+    v_cache = _mk(rng, (L, N, bs, KVH * hd))
+    q = _mk(rng, (B, T, KVH, G, hd))
+    tables = jnp.asarray(rng.integers(1, N, size=(B, W)), jnp.int32)
+    # parents [-,0,0,1,1]: two children of the root, two of node 1.
+    anc1 = _tree_anc([0, 0, 1, 1], T)
+    anc = np.broadcast_to(anc1, (B, T, T)).copy()
+    anc[3] = 0  # dead row: no live node at all
+    anc[2, 4, :] = 0
+    anc[2, :, 4] = 0  # row 2: trailing slot undrafted
+    h = np.asarray(hist, np.int32)
+    lens = np.broadcast_to(h[:, None], (B, T)).copy()
+    lens[3, :] = 0
+    live = np.asarray(anc.any(axis=2))
+    for layer in (0, 1):
+        ref = paged_spec_attention_xla(
+            q, k_cache, v_cache, jnp.int32(layer), tables, jnp.asarray(lens),
+            anc=jnp.asarray(anc),
+        )
+        out = paged_spec_attention(
+            q, k_cache, v_cache, jnp.int32(layer), tables, jnp.asarray(lens),
+            anc=jnp.asarray(anc), interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref)[live], np.asarray(out)[live], atol=2e-5, rtol=2e-5
+        )
+
+
+def test_tree_mask_kernel_quantized_and_single_page():
+    """int8 cache + topology mask, pages_per_chunk=1 (hardest chunk
+    pipeline): in-kernel dequant composes with the ancestor bits."""
+    rng = np.random.default_rng(32)
+    L, N, bs, KVH, hd = 2, 32, 8, 2, 64
+    B, W, G, T = 3, 4, 2, 4
+    kq, vq, ks, vs = _mk_quant_cache(rng, L, N, bs, KVH, hd)
+    q = _mk(rng, (B, T, KVH, G, hd))
+    tables = jnp.asarray(rng.integers(1, N, size=(B, W)), jnp.int32)
+    anc = np.broadcast_to(_tree_anc([0, 0, 2], T), (B, T, T)).copy()
+    hist = np.array([9, 16, 2], np.int32)
+    lens = np.broadcast_to(hist[:, None], (B, T)).copy()
+    ref = paged_spec_attention_xla(
+        q, kq, vq, jnp.int32(1), tables, jnp.asarray(lens), ks, vs,
+        anc=jnp.asarray(anc),
+    )
+    out = paged_spec_attention(
+        q, kq, vq, jnp.int32(1), tables, jnp.asarray(lens), ks, vs,
+        jnp.asarray(anc), pages_per_chunk=1, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5, rtol=2e-5)
+    # f32 reference over the dequantized cache: the masked in-kernel
+    # dequant must BE the dequant.
+    ref_f = paged_spec_attention_xla(
+        q, _dequant(kq, ks, KVH, hd), _dequant(vq, vs, KVH, hd),
+        jnp.int32(1), tables, jnp.asarray(lens), anc=jnp.asarray(anc),
+    )
+    np.testing.assert_allclose(np.asarray(ref_f), np.asarray(out), atol=2e-5, rtol=2e-5)
+
+
 def test_decode_step_int8_cache_logit_error_bound():
     """Full decode step on an int8 cache: sampled logits stay within a
     small bound of the f32-cache step (KV rounding is ~0.4% relative per

@@ -43,8 +43,11 @@ def test_disk_pool_roundtrip_and_capacity(tmp_path):
     assert got is not None and got[0].dtype == ml_dtypes.bfloat16
     np.testing.assert_array_equal(got[0].view(np.uint16), k1.view(np.uint16))
     disk.put(2, page(2), page(2))
-    disk.put(3, page(3), page(3))  # evicts 1's file
-    assert disk.get(1) is None
+    # 1 was HIT above, so the frequency-aware evictor spares it and
+    # evicts the cold 2 instead (blind LRU would have flushed 1).
+    disk.put(3, page(3), page(3))
+    assert disk.get(2) is None
+    assert disk.get(1) is not None
     assert len(disk) == 2
 
     # A fresh pool over the same dir adopts existing files.
@@ -62,6 +65,81 @@ def test_tier_stack_promotes_g3_to_g2(tmp_path):
     assert len(run) == 2
     assert host.contains(11) and host.contains(12)  # promoted
     assert stack.stats()["onboarded_blocks"] == 2
+
+
+def test_host_pool_protected_blocks_survive_churn():
+    """Frequency/fan-out-aware eviction: a protected (high-fan-out)
+    block must survive a burst of one-off puts that would flush it
+    under blind LRU, and the spare events are counted."""
+    host = HostBlockPool(4)
+    host.put(100, page(100), page(100), protected=True)
+    # A one-off burst larger than capacity: blind LRU would evict 100
+    # first; the credit spares it (twice) while the burst churns.
+    for h in range(1, 9):
+        host.put(h, page(h), page(h))
+    assert host.contains(100), "protected block flushed by one-off burst"
+    assert host.protected_evictions >= 1
+    # Hits keep earning credit: touch it, churn again, still resident.
+    assert host.get(100) is not None
+    for h in range(20, 26):
+        host.put(h, page(h), page(h))
+    assert host.contains(100)
+    # A protected block that stops earning hits eventually ages out
+    # (credits decay one per spared scan) — no permanent pinning.
+    for h in range(40, 80):
+        host.put(h, page(h), page(h))
+    assert not host.contains(100)
+
+
+def test_disk_pool_protected_and_counters(tmp_path):
+    disk = DiskBlockPool(str(tmp_path), capacity_blocks=2)
+    disk.put(1, page(1), page(1), protected=True)
+    disk.put(2, page(2), page(2))
+    disk.put(3, page(3), page(3))  # evicts 2 (1 is spared)
+    assert disk.contains(1) and not disk.contains(2)
+    assert disk.protected_evictions >= 1
+
+
+def test_tier_stack_protected_offload_and_hit_rate():
+    host = HostBlockPool(2)
+    stack = TierStack(host, None)
+    stack.offload([(1, page(1), page(1)), (2, page(2), page(2))],
+                  protected=[True, False])
+    stack.offload([(3, page(3), page(3))], protected=[False])  # churn
+    assert host.contains(1) and not host.contains(2)
+    assert stack.protected_evictions >= 1
+    assert stack.lookup_run([1]) and not stack.lookup_run([2])
+    s = stack.stats()
+    assert s["protected_evictions"] >= 1
+    assert 0.0 < s["hit_rate"] < 1.0
+    assert abs(stack.hit_rate - s["hit_rate"]) < 1e-3
+
+
+def test_block_pool_fanout_protection():
+    """The radix tree's fan-out feeds tier protection: a hash two
+    registered children diverge from is protected; eviction unwinds the
+    counts."""
+    from dynamo_tpu.block_manager.pool import BlockPool
+
+    pool = BlockPool(num_blocks=16, block_size=4)
+    ids, _ = pool.allocate_sequence([], 3)
+    pool.register_block(ids[0], 100, None)
+    pool.register_block(ids[1], 201, 100)
+    assert pool.hash_fanout(100) == 1
+    assert not pool.hash_protected(100)   # single child, single ref
+    pool.register_block(ids[2], 202, 100)
+    assert pool.hash_fanout(100) == 2
+    assert pool.hash_protected(100)       # branch point
+    # Shared live block: ref_count >= 2 protects even without children.
+    ids2, _ = pool.allocate_sequence([100], 1)
+    assert ids2[0] == ids[0]
+    assert pool.hash_protected(201) is False
+    pool.free_sequence(ids2)
+    # Churn everything out; the children accounting unwinds cleanly.
+    pool.free_sequence(ids)
+    pool.clear()
+    assert pool.hash_fanout(100) == 0
+    assert not pool.hash_protected(100)
 
 
 def test_tier_stack_offload_bound():

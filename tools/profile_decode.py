@@ -86,7 +86,10 @@ async def engine_hotloop(
     spec_ngram: int = 3,
     spec_gate: float | None = None,
     spec_fused: bool = True,
+    spec_tree_width: int = 1,
+    spec_tree_depth: int = 0,
     repetitive: bool = False,
+    branchy: bool = False,
     kv_quant: str = "none",
     max_num_seqs: int = 8,
     num_kv_blocks: int = 256,
@@ -96,7 +99,9 @@ async def engine_hotloop(
     host_phase_s, prefill_pad_ratio, decode_tok_s} plus the speculation
     series (accept rate, tokens/pass, draft overhead) when spec_tokens
     > 0. ``repetitive`` tiles a short pattern into each prompt (the
-    n-gram-overlap shape speculation targets)."""
+    n-gram-overlap shape speculation targets); ``branchy`` tiles
+    period-4 [a, b, a, c] patterns — the SAME context recurs with
+    DIFFERENT continuations, the shape tree drafting branches on."""
     from dynamo_tpu.engine.config import EngineArgs, ModelConfig
     from dynamo_tpu.engine.engine import BLOCKING_PHASES, TpuEngine
     from dynamo_tpu.llm.protocols import PreprocessedRequest
@@ -112,7 +117,8 @@ async def engine_hotloop(
         decode_steps=decode_steps,
         pipeline_depth=pipeline_depth, pipeline_windows=pipeline_depth > 0,
         spec_tokens=spec_tokens, spec_ngram=spec_ngram,
-        spec_fused=spec_fused, kv_quant=kv_quant, **kw,
+        spec_fused=spec_fused, spec_tree_width=spec_tree_width,
+        spec_tree_depth=spec_tree_depth, kv_quant=kv_quant, **kw,
     )
     engine = await TpuEngine(eargs, seed=0).start()
     try:
@@ -120,7 +126,11 @@ async def engine_hotloop(
         reqs = []
         for i in range(n_requests):
             plen = int(prompt_len + (i * 7) % 17)  # mixed lengths, deterministic
-            if repetitive:
+            if branchy:
+                a, b, c = (int(x) for x in rng.integers(1, cfg.vocab_size - 1, 3))
+                pat = [a, b, a, c if c != b else (c % (cfg.vocab_size - 2)) + 1]
+                toks = (pat * (plen // 4 + 1))[:plen]
+            elif repetitive:
                 pat = rng.integers(1, cfg.vocab_size - 1, size=4 + i % 5).tolist()
                 toks = (pat * (plen // len(pat) + 1))[:plen]
             else:
@@ -171,6 +181,9 @@ async def engine_hotloop(
             ),
         }
         if spec_tokens > 0:
+            hist = await engine.run_on_engine_thread(
+                lambda: dict(engine._spec_depth_hist)
+            )
             out.update({
                 "spec_tokens": spec_tokens,
                 "spec_rows": engine.total_spec_rows,
@@ -181,6 +194,11 @@ async def engine_hotloop(
                 ),
                 "spec_tokens_per_pass": round(
                     engine.total_spec_emitted / max(1, engine.total_spec_rows), 2
+                ),
+                "spec_tree_passes": engine.total_spec_tree_passes,
+                "spec_accept_depth_hist": {str(k): v for k, v in sorted(hist.items())},
+                "tokens_per_weight_pass": round(
+                    engine.total_row_tokens / max(1, engine.total_row_passes), 3
                 ),
                 "spec_draft_s": round(phase1.get("draft", 0.0), 4),
             })
@@ -253,6 +271,29 @@ def run_spec_sweep(*, quick: bool = False, pipeline_depth: int = 2,
     return out
 
 
+def run_spec_tree_sweep(*, quick: bool = False, pipeline_depth: int = 2,
+                        decode_steps: int = 4) -> dict:
+    """``--spec-tree`` probe: a width x depth grid over the branchy
+    workload on the real scheduler (width=1 row = the linear-draft
+    reference at the same S budget) → per-shape acceptance, accept-depth
+    histogram, tokens_per_weight_pass and tok/s. ngram=1 so the period-4
+    [a, b, a, c] patterns give the tree drafter real branch points."""
+    gen_len = QUICK_SPEC_GEN if quick else 64
+    n_requests = QUICK_SPEC_REQUESTS if quick else 8
+    grid = [(1, 0), (2, 4)] if quick else [(1, 0), (2, 4), (2, 8), (4, 4), (4, 2)]
+    out = {}
+    for width, depth in grid:
+        r = asyncio.run(engine_hotloop(
+            pipeline_depth, decode_steps=decode_steps,
+            n_requests=n_requests, gen_len=gen_len,
+            spec_tokens=8, spec_ngram=1, spec_gate=0.0,
+            spec_tree_width=width, spec_tree_depth=depth,
+            branchy=True,
+        ))
+        out[f"w{width}d{depth or 8}"] = r
+    return out
+
+
 def run_quick() -> int:
     """Tier-1 smoke: ablations at toy shapes + hot-loop probe at depths
     0/2 with golden token equality + the --spec sweep with golden
@@ -285,6 +326,25 @@ def run_quick() -> int:
     assert any(r.get("spec_rows", 0) > 0 for r in spec.values()), (
         "spec sweep never dispatched a verify pass — the probe has rotted"
     )
+    # Tree speculation smoke: a dense run, a tree run and a linear run
+    # over the SAME branchy workload must produce identical greedy token
+    # streams, and the tree run must actually dispatch a branched pass.
+    tree_dense = asyncio.run(engine_hotloop(
+        2, decode_steps=4, n_requests=QUICK_SPEC_REQUESTS,
+        gen_len=QUICK_SPEC_GEN, branchy=True,
+    ))
+    tree = run_spec_tree_sweep(quick=True)
+    for label, r in tree.items():
+        assert r["total_tokens"] == QUICK_SPEC_REQUESTS * QUICK_SPEC_GEN, (
+            f"spec-tree {label}: lost tokens — {r['total_tokens']}"
+        )
+        assert r["tokens"] == tree_dense["tokens"], (
+            f"spec-tree {label} token streams diverged from dense"
+        )
+    assert any(r.get("spec_tree_passes", 0) > 0 for r in tree.values()), (
+        "spec-tree sweep never dispatched a BRANCHED pass — the branchy "
+        "workload or the tree drafter has rotted"
+    )
     # int8-KV sweep: every configuration keeps full token accounting
     # (quantization must never lose or duplicate tokens), the 2x-batch
     # pool fits in the f32 pool's byte budget, and the capacity math
@@ -314,11 +374,15 @@ def run_quick() -> int:
         S: {k: v for k, v in r.items() if k != "tokens"}
         for S, r in spec.items()
     }
+    tree_out = {
+        label: {k: v for k, v in r.items() if k != "tokens"}
+        for label, r in tree.items()
+    }
     kvq_out = {
         kq: {k: v for k, v in r.items() if k != "tokens"}
         for kq, r in kvq.items()
     }
-    print(json.dumps({"hotloop": out, "spec": spec_out,
+    print(json.dumps({"hotloop": out, "spec": spec_out, "spec_tree": tree_out,
                       "kv_quant": kvq_out, "kv_capacity_ratio_8b": round(ratio, 3)}))
     print("QUICK-OK")
     return 0
@@ -340,6 +404,11 @@ def main():
                    help="sweep speculative draft length S in {0,2,4,8} on the "
                         "real scheduler (repetitive workload): acceptance, "
                         "tok/s, host overhead per S")
+    p.add_argument("--spec-tree", action="store_true",
+                   help="sweep tree-speculation width x depth on the real "
+                        "scheduler (branchy workload): acceptance, accept-"
+                        "depth histogram, tokens_per_weight_pass per shape "
+                        "(width=1 row = linear reference at equal budget)")
     p.add_argument("--kv-quant", action="store_true",
                    help="sweep KV storage none vs int8 (matched batch and the "
                         "2x batch the same HBM budget fits): tok/s + pool "
@@ -374,6 +443,14 @@ def main():
         for S, r in sweep.items():
             r.pop("tokens")
             print(json.dumps({"spec_tokens": S, **r}))
+        return 0
+    if args.spec_tree:
+        sweep = run_spec_tree_sweep(
+            pipeline_depth=args.pipeline_depth, decode_steps=args.decode_steps,
+        )
+        for label, r in sweep.items():
+            r.pop("tokens")
+            print(json.dumps({"spec_tree_shape": label, **r}))
         return 0
     if args.kv_quant:
         sweep = run_kv_quant_sweep(
