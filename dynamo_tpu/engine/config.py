@@ -322,6 +322,23 @@ class EngineArgs:
     # passes) and ramp phases where loops have not formed yet. 0 = always
     # speculate when any draft exists (golden tests use this).
     spec_gate: float = 1.5
+    # Batch-level adaptive tree budgets (engine.alloc_spec_budgets):
+    # instead of a uniform spec_tokens draft-node allowance per row, each
+    # verify pass reallocates the FIXED batch node budget
+    # (rows x spec_tokens) by acceptance EMA — draft nodes move from
+    # EMA-cold rows to hot ones (hot rows may draft up to 2x spec_tokens;
+    # every non-cooling row keeps a >= 1-node probe so it can re-heat).
+    # Grammar-constrained rows are typically the hottest, so the whole
+    # batch's weight-pass amortization improves at EQUAL total budget.
+    # False = the uniform per-row allowance (PR 10 behavior, the bench
+    # A/B baseline). Correctness is allocation-independent: greedy
+    # streams stay byte-identical to dense for any budget split.
+    spec_budget_adaptive: bool = True
+    # Tokenizer spec dict ({"type": "byte"} / {"type": "hf", ...}) the
+    # engine compiles grammar token-mask FSMs over (engine/grammar.py).
+    # None = byte tokenizer. Must match the serving tokenizer or masks
+    # would legalize undecodable ids; the worker wires its own spec.
+    grammar_tokenizer: dict | None = None
 
     def __post_init__(self):
         # Fail fast on a mistyped ladder spec: anything that is not a

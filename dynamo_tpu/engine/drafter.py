@@ -48,6 +48,51 @@ POOL_MAX_CONTEXTS = 512
 POOL_MAX_CANDS = 4
 
 
+class DraftConstraint:
+    """Grammar hook for constrained drafting (duck-typed; the engine
+    passes a token-FSM adapter). ``state`` is the FSM state at the draft
+    ROOT (after every emitted token); ``step`` returns the successor
+    state for a legal continuation or None; ``forced`` names the single
+    legal continuation of a non-terminal state (or None). An illegal
+    draft node can never be accepted — the verify mask zeroes it — so
+    pruning to legal continuations is pure win, and a forced token is
+    draftable with CERTAINTY (no model signal needed): JSON structure
+    (braces, keys, separators) fast-forwards through the draft for
+    free."""
+
+    __slots__ = ("state", "step", "forced")
+
+    def __init__(self, state, step, forced):
+        self.state = state
+        self.step = step
+        self.forced = forced
+
+
+def constrain_chain(draft: list[int], constraint: DraftConstraint,
+                    budget: int) -> list[int]:
+    """Linear-draft constraint filter: truncate at the first FSM-illegal
+    token, then extend with forced continuations up to ``budget`` (the
+    grammar often knows the next run of tokens exactly — structural JSON
+    — even when the n-gram index has nothing)."""
+    out: list[int] = []
+    st = constraint.state
+    for tok in draft:
+        if len(out) >= budget:
+            return out
+        ns = constraint.step(st, tok)
+        if ns is None:
+            break
+        out.append(int(tok))
+        st = ns
+    while len(out) < budget:
+        f = constraint.forced(st)
+        if f is None:
+            break
+        out.append(int(f))
+        st = constraint.step(st, f)
+    return out
+
+
 class TreeDraft:
     """One proposed draft tree. Node 0 is the implicit ROOT (the
     sequence's last real token — the verify pass's slot-0 input);
@@ -85,6 +130,15 @@ class TreeDraft:
         the PR 5 linear verify op unchanged (width=1 ≡ linear by
         construction)."""
         return all(p == i for i, p in enumerate(self.parents))
+
+    def truncate(self, n_nodes: int) -> None:
+        """Keep only the first ``n_nodes`` draft nodes (batch-budget
+        trim). Creation order is topological (a parent always precedes
+        its children), so dropping a suffix always leaves a valid tree
+        — and with primary-chain-first expansion the kept prefix is
+        exactly what a smaller budget would have drafted."""
+        del self.tokens[n_nodes:]
+        del self.parents[n_nodes:]
 
     def chain_tokens(self) -> list[int]:
         assert self.is_chain()
@@ -285,10 +339,18 @@ class TreeDrafter(NgramDrafter):
 
     def draft_tree(self, tokens: list[int], state: NgramState,
                    budget: int, width: int | None = None,
-                   depth: int | None = None) -> TreeDraft:
+                   depth: int | None = None,
+                   constraint: DraftConstraint | None = None) -> TreeDraft:
         """→ a TreeDraft with up to ``budget`` draft nodes, branching up
         to ``width`` per node, paths up to ``depth`` deep. Empty when
-        neither the index nor the pool has anything to say."""
+        neither the index nor the pool has anything to say.
+
+        With a ``constraint``, candidates are filtered to FSM-legal
+        continuations BEFORE a node is added (illegal nodes can never be
+        accepted — pruning is pure win), forced states contribute their
+        single legal token even with zero index/pool signal, and paths
+        may run to the full node budget (forced runs are certainties;
+        the depth knob only shapes model-guessed branches)."""
         width = self.width if width is None else width
         depth = self.depth if depth is None else depth
         tree = TreeDraft()
@@ -298,10 +360,23 @@ class TreeDrafter(NgramDrafter):
 
         remaining = [budget]
 
-        def expand(path: tuple[int, ...], parent_idx: int, depth_left: int) -> None:
+        def expand(path: tuple[int, ...], parent_idx: int, depth_left: int,
+                   fsm_state=None) -> None:
             if depth_left <= 0 or remaining[0] <= 0:
                 return
-            for tok in self._candidates(tokens, state, path, width):
+            if constraint is None:
+                cands = self._candidates(tokens, state, path, width)
+            else:
+                forced = constraint.forced(fsm_state)
+                if forced is not None:
+                    cands = [forced]
+                else:
+                    cands = [
+                        tok for tok in
+                        self._candidates(tokens, state, path, width * 2)
+                        if constraint.step(fsm_state, tok) is not None
+                    ][:width]
+            for tok in cands:
                 if remaining[0] <= 0:
                     return
                 tree.tokens.append(int(tok))
@@ -310,9 +385,17 @@ class TreeDrafter(NgramDrafter):
                 # Primary-chain-first: recurse before trying the next
                 # sibling, so the best chain reaches full depth before
                 # any budget goes to alternates.
-                expand(path + (int(tok),), len(tree.tokens), depth_left - 1)
+                expand(
+                    path + (int(tok),), len(tree.tokens), depth_left - 1,
+                    None if constraint is None
+                    else constraint.step(fsm_state, tok),
+                )
 
-        expand((), 0, min(depth, budget))
+        # Constrained paths may use the whole budget (forced fast-
+        # forward); unconstrained trees keep the depth shape knob.
+        depth_cap = budget if constraint is not None else min(depth, budget)
+        expand((), 0, depth_cap,
+               None if constraint is None else constraint.state)
         return tree
 
 

@@ -40,7 +40,18 @@ import numpy as np
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine.config import EngineArgs
-from dynamo_tpu.engine.drafter import TreeDraft, build_drafter
+from dynamo_tpu.engine.drafter import (
+    DraftConstraint,
+    TreeDraft,
+    build_drafter,
+    constrain_chain,
+)
+from dynamo_tpu.engine.grammar import (
+    GrammarError,
+    build_compiler,
+    mask_words,
+    pack_token_ids,
+)
 from dynamo_tpu.engine.runner import host_ready, start_host_fetch
 from dynamo_tpu.engine.sampler import needs_full, row_needs_full
 from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, KvStats, WorkerStats
@@ -60,9 +71,63 @@ log = get_logger("engine")
 
 _SENTINEL_DONE = object()
 
+# Adaptive tree budgets: the per-row draft-node cap, as a multiple of
+# spec_tokens. Bounding hot rows at 2x keeps the verify-shape lattice at
+# two S1 values (S+1 and 2S+1) instead of one compile per allocation.
+SPEC_BUDGET_MAX_MULT = 2
+
 
 class RequestValidationError(Exception):
     """Client error (clean rejection, no stack trace)."""
+
+
+def trim_spec_budgets(rows: list[tuple[float, int]], S: int) -> list[int]:
+    """Batch-level draft-node reallocation (ROADMAP 6 fold-in), the trim
+    half: rows drafted OPTIMISTICALLY (each up to min(cap, 2S) nodes —
+    drafting is host dict probes, cheap), and this decides how many
+    nodes each row KEEPS so the batch stays under the fixed uniform
+    budget ``len(rows) * S``. ``rows`` = per-row (spec_ema,
+    drafted_len).
+
+    Rows that drafted short (no index/pool hit, cooldown, near model
+    end) implicitly donate their unused allowance; when the total still
+    exceeds the budget, EMA-cold rows are trimmed back toward their
+    EMA-desired length — the SAME shrink the uniform path applies
+    (S * ema / 0.5, floor 1) — coldest first.
+
+    Invariants (pinned by tests):
+    - sum(keep) <= len(rows) * S (never exceeds the uniform total);
+    - keep_i >= min(drafted_i, 1) (a drafting row is never starved —
+      its probe survives, so its EMA can re-heat);
+    - keep_i >= min(drafted_i, desired_i) (no row keeps fewer nodes
+      than the uniform path's EMA shrink would have drafted — per-row
+      drafts dominate uniform's, so greedy batch tokens-per-weight-pass
+      can only go up at equal total node budget);
+    - keep_i <= drafted_i.
+
+    Feasibility: sum(min(drafted, desired)) <= len(rows) * S always
+    (desired <= S per row), so trimming to desired always lands under
+    budget. Hot rows — grammar-constrained rows above all (near-perfect
+    drafts: forced JSON structure runs past S) — keep their full 2S
+    drafts whenever cold rows leave room, which is where the
+    reallocation pays."""
+    n = len(rows)
+    keep = [d for _, d in rows]
+    if n == 0 or S <= 0:
+        return [0] * n
+    total = sum(keep)
+    limit = n * S
+    if total <= limit:
+        return keep
+    order = sorted(range(n), key=lambda i: (rows[i][0], i))  # coldest first
+    for i in order:
+        if total <= limit:
+            break
+        desired = max(1, round(S * min(1.0, rows[i][0] / 0.5)))
+        cut = min(keep[i] - min(keep[i], desired), total - limit)
+        keep[i] -= cut
+        total -= cut
+    return keep
 
 
 class _Seq:
@@ -74,6 +139,7 @@ class _Seq:
         "slot", "first_pend", "t_admit",
         "spec_ema", "spec_cool", "draft_state",
         "export_handle", "export_stream", "export_pub_blocks",
+        "grammar", "grammar_state", "grammar_eos_bits",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -121,6 +187,15 @@ class _Seq:
         self.spec_ema = 1.0
         self.spec_cool = 0
         self.draft_state = None
+        # Grammar-constrained decoding (engine/grammar.py): the compiled
+        # token-FSM shared by every request using the same schema, this
+        # sequence's FSM state (advanced host-side per EMITTED token —
+        # the prompt is unconstrained), and the packed EOS bitset OR-ed
+        # into terminal-state masks. Attached by generate() before
+        # submission; None = unconstrained.
+        self.grammar = None
+        self.grammar_state = 0
+        self.grammar_eos_bits: np.ndarray | None = None
         # Disaggregation (engine side of llm/disagg.py):
         ktp = req.kv_transfer_params or {}
         self.export = bool(ktp.get("do_remote_decode"))  # prefill-only + export KV
@@ -299,6 +374,22 @@ def register_engine_metrics(registry):
             "misses)) — the churn-resistance signal for the "
             "frequency-aware eviction policy",
         ),
+        registry.gauge(
+            "engine_grammar_active_seqs",
+            "Running sequences decoding under a grammar constraint "
+            "(response_format token-mask FSMs)",
+        ),
+        registry.gauge(
+            "engine_grammar_mask_seconds",
+            "Cumulative host seconds spent building/packing grammar "
+            "token masks (FSM walks + bitset gathers per verify slot)",
+        ),
+        registry.counter(
+            "engine_spec_budget_reallocs_total",
+            "Speculative verify passes whose batch-level draft-node "
+            "budget was reallocated away from the uniform per-row split "
+            "(EMA-hot rows drafting past spec_tokens)",
+        ),
     )
 
 
@@ -309,11 +400,16 @@ class TpuEngine:
     # async-side code may touch one ONLY under `with self._wakeup:` (the
     # handoff protocol for _submissions/_embed_jobs/_host_jobs and the
     # cancel flag) or by shipping a closure via run_on_engine_thread.
-    # Deliberately NOT owned: spec_tokens (documented idle-engine toggle,
-    # read once per scheduler iteration), the total_* counters (monotonic
-    # ints read racily by bench/metrics — stale reads are harmless),
-    # _stopping (always mutex-guarded), and pool/tiers (internally
-    # consistent; cross-thread readers get point-in-time values).
+    # Deliberately NOT owned: spec_tokens + spec_budget_adaptive
+    # (documented idle-engine toggles, read once per scheduler
+    # iteration), the total_* counters incl. total_grammar_mask_s
+    # (monotonic values read racily by bench/metrics — stale reads are
+    # harmless), _stopping (always mutex-guarded), pool/tiers
+    # (internally consistent; cross-thread readers get point-in-time
+    # values), and _grammar_compiler (built under _grammar_lock from
+    # generate() coroutines; the compiled FSMs it hands out are
+    # internally locked, so scheduler-thread mask lookups race async
+    # compiles safely).
     _SCHED_OWNED = frozenset({
         "_submissions", "_waiting", "_running", "_fetchq", "_free_slots",
         "_embed_jobs", "_host_jobs", "_offload_pending", "_exports",
@@ -391,6 +487,17 @@ class TpuEngine:
         # dispatch).
         self._drafter = build_drafter(args)
         self.spec_tokens = args.spec_tokens
+        # Batch-budget mode toggle: like spec_tokens, a documented
+        # idle-engine runtime switch (bench A/Bs adaptive vs uniform on
+        # one warmed engine); read once per _try_speculative call.
+        self.spec_budget_adaptive = args.spec_budget_adaptive
+        # Grammar-constrained decoding: the compiler (vocab + schema
+        # cache) is built lazily on the first constrained request, OFF
+        # the scheduler thread (generate() compiles via to_thread; the
+        # compiled FSMs are internally locked, so scheduler-thread mask
+        # lookups race compiles safely). Not scheduler-owned.
+        self._grammar_compiler = None
+        self._grammar_lock = threading.Lock()
         # Scheduler-step counter + last-ticked stamp: _decode_iteration
         # can re-enter _try_speculative within one step (drain → replan),
         # and probe cooldowns must tick once per STEP, not per attempt.
@@ -411,6 +518,15 @@ class TpuEngine:
         self.total_spec_tree_rows = 0
         self.total_spec_tree_depth = 0
         self._spec_depth_hist: collections.Counter = collections.Counter()
+        # Grammar + budget accounting (same racy-read contract as the
+        # other total_* counters: monotonic, stale reads harmless).
+        # total_grammar_mask_s: host seconds building/packing masks;
+        # total_spec_budget_reallocs: passes dispatched with a
+        # non-uniform node split; total_grammar_seqs: constrained
+        # sequences admitted.
+        self.total_grammar_mask_s = 0.0
+        self.total_spec_budget_reallocs = 0
+        self.total_grammar_seqs = 0
         # Tokens-per-weight-pass accounting: every (row, substep) of a
         # drained window or single step is one per-sequence weight pass
         # yielding one token; a spec row-pass is one weight pass yielding
@@ -437,9 +553,9 @@ class TpuEngine:
         # already been fed (engine keeps plain ints; registry counters
         # get the delta once per step).
         self._gauges = None
-        # (proposed, accepted, tree passes, protected tier evictions)
-        # already inc'd into the registry counters.
-        self._ctr_pushed = [0, 0, 0, 0]
+        # (proposed, accepted, tree passes, protected tier evictions,
+        # budget reallocs) already inc'd into the registry counters.
+        self._ctr_pushed = [0, 0, 0, 0, 0]
 
     def bind_metrics(self, registry) -> None:
         """Attach the engine gauges to a MetricsRegistry; updated once
@@ -450,7 +566,8 @@ class TpuEngine:
         if self._gauges is None:
             return
         (g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp,
-         g_kvb, g_kvq, c_tree, g_tree_depth, c_tier_prot, g_tier_hit) = self._gauges
+         g_kvb, g_kvq, c_tree, g_tree_depth, c_tier_prot, g_tier_hit,
+         g_gram_seqs, g_gram_mask, c_budget) = self._gauges
         g_kvb.set(self.args.kv_bytes_per_block() * self.args.num_kv_blocks)
         g_kvq.set(1 if self.args.kv_quant == "int8" else 0)
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
@@ -475,6 +592,11 @@ class TpuEngine:
             c_tier_prot.inc(prot - self._ctr_pushed[3])
             self._ctr_pushed[3] = prot
         g_tier_hit.set(self.tiers.hit_rate)
+        g_gram_seqs.set(sum(1 for s in self._running if s.grammar is not None))
+        g_gram_mask.set(self.total_grammar_mask_s)
+        if self.total_spec_budget_reallocs > self._ctr_pushed[4]:
+            c_budget.inc(self.total_spec_budget_reallocs - self._ctr_pushed[4])
+            self._ctr_pushed[4] = self.total_spec_budget_reallocs
 
     def _phase(self, key: str, t0: float) -> float:
         """Accumulate perf_counter()-t0 into phase `key`; → new t0."""
@@ -537,6 +659,43 @@ class TpuEngine:
             ),
         )
 
+    # -- grammar-constrained decoding -------------------------------------
+
+    def _compile_grammar(self, rf: dict):
+        """response_format dict → CompiledGrammar (None = unconstrained).
+        Called via to_thread from generate(); the compiler is built once
+        per engine over the serving tokenizer's vocabulary and caches by
+        schema hash, so structured traffic sharing a schema pays the DFA
+        construction exactly once."""
+        comp = self._grammar_compiler
+        if comp is None:
+            with self._grammar_lock:
+                comp = self._grammar_compiler
+                if comp is None:
+                    comp = build_compiler(
+                        self.args.grammar_tokenizer, self.cfg.vocab_size
+                    )
+                    self._grammar_compiler = comp
+        return comp.compile(rf)
+
+    def _grammar_row_masks(self, seqs: list[_Seq], B: int) -> np.ndarray | None:
+        """Per-row packed grammar masks for a dense sampling dispatch
+        (admission first tokens / single-step decode) → [B, W32] uint32,
+        or None when no row is constrained (the unmasked jit variant —
+        unconstrained traffic never pays the where()). Unconstrained
+        rows in a mixed batch ride all-ones masks (bitwise identity)."""
+        if not any(s.grammar is not None for s in seqs):
+            return None
+        t0 = time.perf_counter()
+        masks = np.full(
+            (B, mask_words(self.cfg.vocab_size)), 0xFFFFFFFF, np.uint32
+        )
+        for i, s in enumerate(seqs):
+            if s.grammar is not None:
+                masks[i] = s.grammar.mask(s.grammar_state, s.grammar_eos_bits)
+        self.total_grammar_mask_s += time.perf_counter() - t0
+        return masks
+
     # -- async API --------------------------------------------------------
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
@@ -565,9 +724,33 @@ class TpuEngine:
                 min(req.sampling.top_logprobs, self.args.top_logprobs_max)
                 if req.sampling.logprobs else 0
             )
+        # Grammar-constrained decoding: compile (or cache-hit) the
+        # token-mask FSM for this request's response_format OFF the
+        # event loop and the scheduler thread. Malformed specs error
+        # this stream only (the frontend already 400s them; engine-
+        # direct callers get the typed message).
+        grammar = None
+        if req.response_format:
+            try:
+                grammar = await asyncio.to_thread(
+                    self._compile_grammar, req.response_format
+                )
+            except GrammarError as e:
+                yield LLMEngineOutput(
+                    finish_reason=FinishReason.ERROR,
+                    error=f"invalid response_format: {e}",
+                ).to_dict()
+                return
         queue: asyncio.Queue = asyncio.Queue()
         t_submit = time.perf_counter()
         seq = _Seq(context.id, req, queue)
+        if grammar is not None:
+            seq.grammar = grammar
+            seq.grammar_state = grammar.start
+            seq.grammar_eos_bits = pack_token_ids(
+                seq.eos_ids, self.cfg.vocab_size
+            )
+            self.total_grammar_seqs += 1
         with self._wakeup:
             if self._stopping:
                 raise RuntimeError("engine is stopping")
@@ -900,63 +1083,79 @@ class TpuEngine:
             )
 
     async def warm_spec(self, modes: tuple[str, ...] = ("greedy",),
-                        top_ns: tuple[int, ...] = (0,)) -> int:
+                        top_ns: tuple[int, ...] = (0,),
+                        grammar: bool = False) -> int:
         """AOT-compile the REQUESTED subset of the spec_verify variant
         lattice: one inert dispatch (all rows inactive → KV writes land
         in garbage block 0) per (decode bucket x table bucket x mode x
-        top_n). Drafts cannot be forced through real traffic — they
-        depend on the model looping — so cold variants would otherwise
-        compile mid-serving. The default covers the bench shape (greedy,
-        no top_logprobs); a serving worker expecting sampled or
-        top_logprobs traffic should pass modes=("greedy", "simple") and
-        top_ns=(0, args.top_logprobs_max), or rely on the persistent
-        compile cache (DYNTPU_COMPILE_CACHE) like every other variant
-        family. → number of variants dispatched."""
+        top_n x S1 shape). Drafts cannot be forced through real traffic
+        — they depend on the model looping — so cold variants would
+        otherwise compile mid-serving. The default covers the bench
+        shape (greedy, no top_logprobs); a serving worker expecting
+        sampled or top_logprobs traffic should pass modes=("greedy",
+        "simple") and top_ns=(0, args.top_logprobs_max), or rely on the
+        persistent compile cache (DYNTPU_COMPILE_CACHE) like every
+        other variant family. Adaptive batch budgets add the 2S+1 shape
+        (hot rows drafting past S); ``grammar=True`` adds the
+        masked-tree variants constrained traffic dispatches. → number
+        of variants dispatched."""
         S = self.spec_tokens
         if S <= 0:
             return 0
         args = self.args
+        s1_list = [S + 1]
+        if self.spec_budget_adaptive:
+            s1_list.append(SPEC_BUDGET_MAX_MULT * S + 1)
 
         def _warm():
             count = 0
-            S1 = S + 1
-            # Tree lattice rides the same loop when tree drafting is on:
-            # the topology arrays are traced by SHAPE only, so one inert
-            # chain-shaped dispatch warms every tree a real batch can
-            # produce at this (B, W, mode, top_n).
-            shapes: list[tuple | None] = [None]
-            if args.spec_tree_width > 1:
+            for S1 in s1_list:
+                # Tree lattice rides the same loop when tree drafting is
+                # on: the topology arrays are traced by SHAPE only, so
+                # one inert chain-shaped dispatch warms every tree a
+                # real batch can produce at this (B, W, mode, top_n).
+                # Grammar masks are one more shape-only operand: the
+                # masked variant covers every schema.
+                shapes: list[tuple[bool, bool]] = [(False, False)]
+                if args.spec_tree_width > 1 or grammar:
+                    shapes.append((True, False))
+                if grammar:
+                    shapes.append((True, True))
                 chain_parents = np.maximum(
                     np.arange(S1, dtype=np.int32) - 1, 0
                 )
-                shapes.append((chain_parents, np.tril(np.ones((S1, S1), np.int8)),
-                               np.arange(S1, dtype=np.int32)))
-            for mode in modes:
-                for top_n in top_ns:
-                    for B in args.decode_buckets:
-                        for W in args.table_buckets:
-                            for shape in shapes:
-                                tree = None
-                                if shape is not None:
-                                    p, anc, dep = shape
-                                    tree = (
-                                        np.broadcast_to(p, (B, S1)).copy(),
-                                        np.broadcast_to(anc, (B, S1, S1)).copy(),
-                                        np.broadcast_to(dep, (B, S1)).copy(),
+                chain_anc = np.tril(np.ones((S1, S1), np.int8))
+                chain_depth = np.arange(S1, dtype=np.int32)
+                W32 = mask_words(self.cfg.vocab_size)
+                for mode in modes:
+                    for top_n in top_ns:
+                        for B in args.decode_buckets:
+                            for W in args.table_buckets:
+                                for with_tree, with_mask in shapes:
+                                    tree = masks = None
+                                    if with_tree:
+                                        tree = (
+                                            np.broadcast_to(chain_parents, (B, S1)).copy(),
+                                            np.broadcast_to(chain_anc, (B, S1, S1)).copy(),
+                                            np.broadcast_to(chain_depth, (B, S1)).copy(),
+                                        )
+                                    if with_mask:
+                                        masks = np.full(
+                                            (B, S1, W32), 0xFFFFFFFF, np.uint32
+                                        )
+                                    self._runner.spec_verify(
+                                        S1, mode,
+                                        np.zeros((B, S1), np.int32),
+                                        np.zeros((B,), np.int32),
+                                        np.full((B,), S1 - 1, np.int32),
+                                        np.zeros((B, W), np.int32),
+                                        np.zeros((B,), bool),
+                                        np.ones((B,), np.float32),
+                                        np.zeros((B,), np.uint32),
+                                        np.zeros((B,), np.int32),
+                                        None, top_n, tree, masks,
                                     )
-                                self._runner.spec_verify(
-                                    S1, mode,
-                                    np.zeros((B, S1), np.int32),
-                                    np.zeros((B,), np.int32),
-                                    np.full((B,), S, np.int32),
-                                    np.zeros((B, W), np.int32),
-                                    np.zeros((B,), bool),
-                                    np.ones((B,), np.float32),
-                                    np.zeros((B,), np.uint32),
-                                    np.zeros((B,), np.int32),
-                                    None, top_n, tree,
-                                )
-                                count += 1
+                                    count += 1
             return count
 
         return await self.run_on_engine_thread(_warm)
@@ -1572,13 +1771,21 @@ class TpuEngine:
 
     def _plan_window(self) -> tuple[int, int]:
         """→ (K, depth). K=1 is the end-of-life tail near max_model_len;
-        pipelining (depth > 0) needs K>1 and no full-sampler rows."""
+        pipelining (depth > 0) needs K>1 and no full-sampler rows.
+        Grammar rows also force K=1: their FSM advances host-side per
+        emitted token and the NEXT token's mask depends on it, so the
+        fused multi-step window (which samples K tokens on device) could
+        only mask its first substep. The speculative tree path is the
+        constrained fast path — there every node's mask is known at
+        dispatch because the draft tokens are."""
         K = max(1, self.args.decode_steps)
         if K > 1:
             for s in self._running:
                 if len(s.tokens) + self._pend(s) + K > self.args.max_model_len:
                     K = 1
                     break
+        if K > 1 and any(s.grammar is not None for s in self._running):
+            K = 1
         depth = self.args.effective_pipeline_depth
         if K == 1 or any(self._needs_full_sampler(s) for s in self._running):
             depth = 0
@@ -1777,29 +1984,48 @@ class TpuEngine:
     # an acceptance EMA / enter a probe cooldown, so incompressible
     # workloads fall back to the dense window pipeline at full depth.
 
-    def _row_draft(self, seq: _Seq, S: int):
+    def _row_draft(self, seq: _Seq, budget: int):
         """Propose a draft for one row — a token list (linear drafter)
         or a TreeDraft (tree drafter) — applying the adaptive controls.
         Empty ⇒ the row rides the pass with draft_len 0 (a plain
         next-token step) or, if no row drafts, the batch falls back to
-        the dense path entirely."""
+        the dense path entirely. ``budget`` is this row's draft-node
+        allowance: uniform spec_tokens, or 2S under adaptive batch
+        budgets (drafting is optimistic there — the EMA shrink below
+        still applies, scaled to the allowance, and trim_spec_budgets
+        enforces the batch total afterwards)."""
         args = self.args
         # Never draft past the model length: the pass emits up to
         # potential+1 tokens and writes KV slots up to positions0 +
         # draft-node count (tree slots are slot-ordered, so the node
         # budget bounds the write extent for any shape).
-        cap = min(S, args.max_model_len - len(seq.tokens) - 1)
+        cap = min(budget, args.max_model_len - len(seq.tokens) - 1)
         if cap <= 0 or seq.spec_cool > 0:
             return []
         # EMA-proportional shrink: full drafts at ema >= 0.5, linearly
-        # shorter below, floor 1 — a just-re-enabled low-EMA row proposes
-        # a naturally short probe, and acceptance lifts the EMA back up.
-        eff = min(cap, max(1, round(S * min(1.0, seq.spec_ema / 0.5))))
+        # shorter below, floor 1 — a just-re-enabled low-EMA row
+        # proposes a naturally short probe, and acceptance lifts the
+        # EMA back up.
+        eff = min(cap, max(1, round(budget * min(1.0, seq.spec_ema / 0.5))))
         if seq.draft_state is None:
             seq.draft_state = self._drafter.new_state()
+        constraint = None
+        if seq.grammar is not None:
+            # Grammar-pruned drafting: candidates filtered to FSM-legal
+            # continuations, forced states contributing their single
+            # legal token (certainty) — constrained rows draft near-
+            # perfect trees, which is where tree speculation pays
+            # hardest on structured traffic.
+            g, st = seq.grammar, seq.grammar_state
+            constraint = DraftConstraint(st, g.advance, g.forced)
         if hasattr(self._drafter, "draft_tree"):
-            return self._drafter.draft_tree(seq.tokens, seq.draft_state, eff)
-        return self._drafter.draft(seq.tokens, seq.draft_state, eff)
+            return self._drafter.draft_tree(
+                seq.tokens, seq.draft_state, eff, constraint=constraint
+            )
+        d = self._drafter.draft(seq.tokens, seq.draft_state, eff)
+        if constraint is not None:
+            d = constrain_chain(d, constraint, eff)
+        return d
 
     @staticmethod
     def _draft_potential(d) -> int:
@@ -1848,7 +2074,7 @@ class TpuEngine:
                 if s.spec_cool > 0:
                     s.spec_cool -= 1
         t0 = time.perf_counter()
-        drafts = {s: self._row_draft(s, S) for s in self._running}
+        drafts = self._draft_all(S)
         if not self._spec_gate_passes(drafts):
             self._phase("draft", t0)
             return False
@@ -1861,7 +2087,7 @@ class TpuEngine:
             if not self._running:
                 return True
             t0 = time.perf_counter()
-            drafts = {s: self._row_draft(s, S) for s in self._running}
+            drafts = self._draft_all(S)
         t0 = self._phase("draft", t0)
         if not self._spec_gate_passes(drafts):
             return False
@@ -1873,7 +2099,14 @@ class TpuEngine:
             if not self._ensure_block(seq, lookahead=len(drafts[seq]) + 1):
                 return False
         B = self.args.bucket_decode(len(batch))
-        S1 = S + 1
+        # Verify-shape bucket: the uniform S+1 covers every draft at or
+        # under the per-row allowance; an adaptive reallocation that let
+        # a hot row draft past S (its only way past S) upgrades the pass
+        # to the 2S+1 shape — two S1 buckets total, both AOT-warmable.
+        max_nodes = max((len(d) for d in drafts.values()), default=0)
+        S1 = S + 1 if max_nodes <= S else SPEC_BUDGET_MAX_MULT * S + 1
+        if max_nodes > S:
+            self.total_spec_budget_reallocs += 1
         W = self.args.bucket_table(max(len(s.block_ids) for s in batch))
         tokens = np.zeros((B, S1), np.int32)
         pos0_arr = np.zeros((B,), np.int32)
@@ -1892,8 +2125,12 @@ class TpuEngine:
         # A batch whose proposals are all CHAINS dispatches through the
         # PR 5 linear op (byte-for-byte that path, including stepwise
         # parity); any branched proposal upgrades the whole batch to the
-        # topology-masked tree op (chains are trees too).
-        any_tree = any(
+        # topology-masked tree op (chains are trees too). Grammar rows
+        # ALSO force the tree op: per-node masks ride only the tree
+        # acceptance path (even a draft-less constrained row needs its
+        # root mask for the bonus sample).
+        any_gram = any(s.grammar is not None for s in batch)
+        any_tree = any_gram or any(
             isinstance(d, TreeDraft) and not d.is_chain()
             for d in drafts.values()
         )
@@ -1927,9 +2164,13 @@ class TpuEngine:
         tree = None
         if any_tree:
             tree = self._build_tree_args(B, S1, node_parents)
+        masks = None
+        if any_gram:
+            masks = self._build_tree_masks(batch, B, S1, node_tokens,
+                                           node_parents)
         ref = self._runner.spec_verify(
             S1, mode, tokens, pos0_arr, dlen, tables, active,
-            temps, seeds, steps0, fold_slots, top_n, tree,
+            temps, seeds, steps0, fold_slots, top_n, tree, masks,
         )
         item = _Spec(
             batch, pos0, draft_lens, ref, top_n,
@@ -1940,6 +2181,68 @@ class TpuEngine:
         self._fetchq.append(item)
         self._phase("spec_dispatch", t0)
         return True
+
+    def _draft_all(self, S: int) -> dict:
+        """Draft every running row under the batch node budget. Uniform
+        mode: each row proposes up to S (EMA-shrunk — PR 10 behavior,
+        byte-for-byte). Adaptive mode (spec_budget_adaptive): every row
+        drafts optimistically up to 2S (its EMA shrink still applies,
+        scaled to the doubled allowance), then trim_spec_budgets
+        enforces the FIXED batch total rows x S by trimming EMA-cold
+        rows back toward their uniform-path draft length — rows with
+        nothing to say donate their allowance, and the hot rows (above
+        all grammar-constrained rows, whose forced JSON runs exceed S)
+        spend it."""
+        if not self.spec_budget_adaptive:
+            return {s: self._row_draft(s, S) for s in self._running}
+        rows = list(self._running)
+        cap = SPEC_BUDGET_MAX_MULT * S
+        drafts = {s: self._row_draft(s, cap) for s in rows}
+        keep = trim_spec_budgets(
+            [(s.spec_ema, len(drafts[s])) for s in rows], S
+        )
+        for s, k in zip(rows, keep):
+            d = drafts[s]
+            if len(d) <= k:
+                continue
+            if isinstance(d, TreeDraft):
+                d.truncate(k)
+            else:
+                drafts[s] = d[:k]
+        return drafts
+
+    def _build_tree_masks(
+        self, batch: list[_Seq], B: int, S1: int,
+        node_tokens: list[list[int]], node_parents: list[list[int]],
+    ) -> np.ndarray:
+        """Per-(row, node) packed grammar masks for one tree verify
+        dispatch → [B, S1, W32] uint32. Node j masks by ITS OWN FSM
+        state — the state reached by walking the draft tokens from the
+        sequence's current state along the tree's parent chain — because
+        node j's logits are the distribution its children are checked
+        against and its correction/bonus token samples from.
+        Unconstrained rows (and dead slots) ride all-ones masks: bitwise
+        identity under where(). Pruned drafting guarantees every walk
+        step succeeds; the defensive parent-state fallback only matters
+        for an illegal draft node, which acceptance can never reach
+        anyway (its own edge probability is masked to zero)."""
+        t0 = time.perf_counter()
+        masks = np.full(
+            (B, S1, mask_words(self.cfg.vocab_size)), 0xFFFFFFFF, np.uint32
+        )
+        for i, seq in enumerate(batch):
+            g = seq.grammar
+            if g is None:
+                continue
+            states = [seq.grammar_state]
+            masks[i, 0] = g.mask(states[0], seq.grammar_eos_bits)
+            toks_i, pars_i = node_tokens[i], node_parents[i]
+            for j in range(1, len(toks_i)):
+                st = g.advance(states[pars_i[j]], toks_i[j])
+                states.append(st if st is not None else states[pars_i[j]])
+                masks[i, j] = g.mask(states[j], seq.grammar_eos_bits)
+        self.total_grammar_mask_s += time.perf_counter() - t0
+        return masks
 
     @staticmethod
     def _build_tree_args(
@@ -2126,9 +2429,14 @@ class TpuEngine:
             self._penalty_window(seqs, B) if full
             else np.full((B, 1), -1, np.int32)
         )
+        # Grammar rows sample from their FSM state's masked vocabulary
+        # (admission = the start state; single-step = the state after
+        # every emitted token, host-visible because grammar batches
+        # always run force-drained K=1).
+        masks = self._grammar_row_masks(seqs, B)
         return self._runner.sample_rows(
             srcs, temps, tks, tps, pen, freqs, press, seeds, steps, full,
-            fold_slots, top_n,
+            fold_slots, top_n, masks,
         )
 
     # -- token emission / finish ------------------------------------------
@@ -2147,6 +2455,16 @@ class TpuEngine:
             seq.emitted += 1
             self.total_generated += 1
             kept.append(token)
+            # Advance the grammar FSM per emitted token (EOS stops the
+            # walk, it is not part of the match). Masked sampling makes
+            # every emitted token legal by construction; the defensive
+            # None check keeps a state-desync from cascading (the row
+            # would just stop constraining instead of crashing the
+            # scheduler thread).
+            if seq.grammar is not None and token not in seq.eos_ids:
+                ns = seq.grammar.advance(seq.grammar_state, token)
+                if ns is not None:
+                    seq.grammar_state = ns
             # Block-hash bookkeeping only; registration waits until the
             # sealed block's KV is fully written (_register_written_blocks).
             if seq.block_seq is not None:

@@ -640,6 +640,7 @@ def spec_verify_impl(
     tree_parents: jax.Array | None = None,  # [B, S1] int32 — tree mode (below)
     tree_anc: jax.Array | None = None,      # [B, S1, S1] int8 ancestor-or-self
     tree_depth: jax.Array | None = None,    # [B, S1] int32 per-node depth
+    mask_bits: jax.Array | None = None,     # [B, S1, W32] uint32 per-node grammar masks
     *,
     fused: bool = True,       # static — single-pass forward vs stepwise scan
     attn_impl: str = "auto",  # attention backend: stepwise decode steps AND
@@ -830,9 +831,15 @@ def spec_verify_impl(
         logits = jnp.transpose(logits_t, (1, 0, 2))  # [B, T, V] fp32
 
     if tree:
+        # Grammar masks ride the tree path only: every constrained batch
+        # dispatches as a tree (chains are trees), so the linear op below
+        # never sees a mask. Acceptance + correction/bonus sampling then
+        # renormalize over each node's LEGAL vocabulary
+        # (sampler.spec_tree_acceptance) while the reported logprobs stay
+        # raw-model values (OpenAI semantics), masked or not.
         out, n_emit, path, cand = spec_tree_acceptance(
             logits, tokens, tree_parents, draft_len, temperature, seeds,
-            steps0, mode,
+            steps0, mode, mask_bits,
         )
         # Everything downstream reads PATH-ALIGNED logits: emitted token
         # k came from node path[k]'s distribution (path is clamped to

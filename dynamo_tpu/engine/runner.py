@@ -271,16 +271,20 @@ class LocalRunner:
 
     def spec_verify(self, S1, mode, tokens, positions0, draft_len, tables,
                     active, temps, seeds, steps0, fold_slots=None, top_n=0,
-                    tree=None, *, rid=None) -> StepRef:
+                    tree=None, masks=None, *, rid=None) -> StepRef:
         """One speculative verify pass: a single forward over ``S1``
         positions per row (one weight stream) with on-device acceptance.
         ``tree`` = None for a linear draft, or (parents [B, S1],
         anc [B, S1, S1], depth [B, S1]) numpy arrays for a SpecInfer
         token tree — the topology mask rides the same fused gather and
-        the accepted root path is compacted on device. The pass's FINAL
-        emitted token folds into the per-slot chain buffer like a
-        window's last sample. Ref arrays: (out [B, S1], n_emit [B],
-        logps [B, S1], cand [B, S1], top_vals, top_ids)."""
+        the accepted root path is compacted on device. ``masks`` = None
+        or [B, S1, W32] uint32 packed per-node grammar bitsets (tree
+        dispatches only — a constrained batch always upgrades to the
+        tree op); acceptance then renormalizes over each node's legal
+        vocabulary. The pass's FINAL emitted token folds into the
+        per-slot chain buffer like a window's last sample. Ref arrays:
+        (out [B, S1], n_emit [B], logps [B, S1], cand [B, S1],
+        top_vals, top_ids)."""
         self._ensure_last_toks()
         tp = ta = td = None
         if tree is not None:
@@ -288,12 +292,13 @@ class LocalRunner:
             tp = jnp.asarray(parents, jnp.int32)
             ta = jnp.asarray(anc, jnp.int8)
             td = jnp.asarray(depth, jnp.int32)
+        mb = None if masks is None else jnp.asarray(masks, jnp.uint32)
         out, n_emit, logps, cand, tvals, tids, last_tok, self.cache = M.spec_verify(
             self.cfg, int(S1), mode, int(top_n), self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions0),
             jnp.asarray(draft_len), jnp.asarray(tables), jnp.asarray(active),
             jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
-            tp, ta, td,
+            tp, ta, td, mb,
             fused=self.args.spec_fused, attn_impl=self.attn_impl,
         )
         if fold_slots is None:
@@ -314,24 +319,29 @@ class LocalRunner:
         return jnp.stack(rows)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
-                    steps, full: bool, fold_slots=None, top_n: int = 0):
+                    steps, full: bool, fold_slots=None, top_n: int = 0,
+                    masks=None):
         """→ (tokens [B], logprobs [B], top_ref|None) as device arrays
         (leader fetches). With ``fold_slots``, the sampled tokens also
         land in the per-slot chain buffer so the next decode window can
         consume them without a host sync (async admission). ``top_n``
         adds ranked alternatives computed from the SAME stacked logits
-        (one gather, one logsumexp — not a second pass)."""
+        (one gather, one logsumexp — not a second pass). ``masks`` =
+        None or [B, W32] packed grammar bitsets — the dense-row masked
+        sampling path (admission first tokens + single-step decode)."""
         from dynamo_tpu.engine.sampler import top_k_logprobs
 
         logits = self.stack_rows(srcs)
+        mb = None if masks is None else jnp.asarray(masks, jnp.uint32)
         if full:
             out = sample_full(
                 logits, jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps),
                 jnp.asarray(pen), jnp.asarray(freqs), jnp.asarray(press),
-                jnp.asarray(seeds), jnp.asarray(steps),
+                jnp.asarray(seeds), jnp.asarray(steps), mb,
             )
         else:
-            out = sample_simple(logits, jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps))
+            out = sample_simple(logits, jnp.asarray(temps), jnp.asarray(seeds),
+                                jnp.asarray(steps), mb)
         if fold_slots is not None:
             self._ensure_last_toks()
             self._last_toks = _fold_tokens(
@@ -492,7 +502,7 @@ class LeaderRunner(LocalRunner):
 
     def spec_verify(self, S1, mode, tokens, positions0, draft_len, tables,
                     active, temps, seeds, steps0, fold_slots=None, top_n=0,
-                    tree=None, *, rid=None) -> StepRef:
+                    tree=None, masks=None, *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "spec_verify", "rid": rid, "S1": int(S1), "mode": mode,
                     "tokens": _pack_np(tokens), "positions0": _pack_np(positions0),
@@ -503,13 +513,17 @@ class LeaderRunner(LocalRunner):
                     "tree": None if tree is None else [
                         _pack_np(np.asarray(a)) for a in tree
                     ],
+                    "masks": None if masks is None else _pack_np(
+                        np.asarray(masks, np.uint32)
+                    ),
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().spec_verify(S1, mode, tokens, positions0, draft_len,
                                    tables, active, temps, seeds, steps0,
-                                   fold_slots, top_n, tree, rid=rid)
+                                   fold_slots, top_n, tree, masks, rid=rid)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
-                    steps, full: bool, fold_slots=None, top_n: int = 0):
+                    steps, full: bool, fold_slots=None, top_n: int = 0,
+                    masks=None):
         wire_srcs = [
             [ref.rid if isinstance(ref, StepRef) else ref,
              None if row is None else int(row)]
@@ -521,9 +535,12 @@ class LeaderRunner(LocalRunner):
                     "freqs": _pack_np(freqs), "press": _pack_np(press),
                     "seeds": _pack_np(seeds), "steps": _pack_np(steps),
                     "full": bool(full), "top_n": int(top_n),
+                    "masks": None if masks is None else _pack_np(
+                        np.asarray(masks, np.uint32)
+                    ),
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().sample_rows(srcs, temps, tks, tps, pen, freqs, press,
-                                   seeds, steps, full, fold_slots, top_n)
+                                   seeds, steps, full, fold_slots, top_n, masks)
 
     def embed(self, toks, tlen, *, rid=None) -> StepRef:
         rid = self._rid
@@ -618,6 +635,7 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
         elif op == "spec_verify":
             fold = desc.get("fold")
             tree = desc.get("tree")
+            wire_masks = desc.get("masks")
             runner.spec_verify(
                 desc["S1"], desc["mode"], _unpack_np(desc["tokens"]),
                 _unpack_np(desc["positions0"]), _unpack_np(desc["draft_len"]),
@@ -627,9 +645,11 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 None if fold is None else _unpack_np(fold),
                 desc.get("top_n", 0),
                 None if tree is None else tuple(_unpack_np(a) for a in tree),
+                None if wire_masks is None else _unpack_np(wire_masks),
                 rid=desc["rid"])
         elif op == "sample_rows":
             fold = desc.get("fold")
+            wire_masks = desc.get("masks")
             runner.sample_rows(
                 [(s[0], s[1]) for s in desc["srcs"]],
                 _unpack_np(desc["temps"]), _unpack_np(desc["tks"]),
@@ -637,7 +657,8 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
                 _unpack_np(desc["seeds"]), _unpack_np(desc["steps"]),
                 desc["full"], None if fold is None else _unpack_np(fold),
-                desc.get("top_n", 0))
+                desc.get("top_n", 0),
+                None if wire_masks is None else _unpack_np(wire_masks))
         elif op == "embed":
             runner.embed(_unpack_np(desc["toks"]), desc["tlen"], rid=desc["rid"])
         elif op == "extract_pages":

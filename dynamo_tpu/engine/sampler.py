@@ -30,6 +30,30 @@ import jax.numpy as jnp
 from jax import lax
 
 _GREEDY_EPS = 1e-5
+_MASKED = -jnp.inf
+
+
+def unpack_mask(bits: jax.Array, V: int) -> jax.Array:
+    """Packed uint32 bitsets → boolean legality mask: [..., W32] →
+    [..., V]. Bit t of the flattened words marks token t legal. The
+    packed form is what rides host→device (32x fewer bytes than a bool
+    mask; grammar masks are per-(row, verify-slot))."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    b = (bits[..., :, None] >> shifts) & jnp.uint32(1)
+    return b.reshape(*bits.shape[:-1], -1)[..., :V] != 0
+
+
+def apply_mask(logits: jax.Array, mask_bits: jax.Array | None) -> jax.Array:
+    """Grammar-mask logits: illegal tokens → -inf, so every downstream
+    softmax/argmax/gumbel-max renormalizes over the LEGAL vocabulary —
+    masked sampling is exactly the constrained target distribution, and
+    masked greedy is the constrained argmax. None = unconstrained
+    (byte-identical passthrough; callers dispatch None when no row in
+    the batch carries a grammar, so unconstrained traffic never pays a
+    where())."""
+    if mask_bits is None:
+        return logits
+    return jnp.where(unpack_mask(mask_bits, logits.shape[-1]), logits, _MASKED)
 
 
 def _row_gumbel(seeds: jax.Array, steps: jax.Array, V: int) -> jax.Array:
@@ -48,7 +72,9 @@ def sample_simple(
     temperature: jax.Array,   # [B] fp32
     seeds: jax.Array,         # [B] uint32 per-row seed
     steps: jax.Array,         # [B] int32 per-row emission index
+    mask_bits: jax.Array | None = None,  # [B, W32] uint32 grammar masks
 ) -> jax.Array:
+    logits = apply_mask(logits, mask_bits)
     greedy = temperature < _GREEDY_EPS
     temp = jnp.where(greedy, 1.0, temperature)
     scaled = logits / temp[:, None]
@@ -120,7 +146,9 @@ def sample_full(
     pres_penalty: jax.Array,   # [B] fp32
     seeds: jax.Array,          # [B] uint32
     steps: jax.Array,          # [B] int32
+    mask_bits: jax.Array | None = None,  # [B, W32] uint32 grammar masks
 ) -> jax.Array:
+    logits = apply_mask(logits, mask_bits)
     V = logits.shape[1]
     counts = token_counts(penalty_tokens, V)
     logits = apply_penalties(logits, counts, freq_penalty, pres_penalty)
@@ -305,6 +333,7 @@ def spec_tree_acceptance(
     seeds: jax.Array,        # [B] uint32 per-row sample seed
     steps0: jax.Array,       # [B] int32 emission index of the pass's first token
     mode: str,               # static — "greedy" | "simple"
+    mask_bits: jax.Array | None = None,  # [B, S1, W32] uint32 per-NODE grammar masks
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Multi-path (SpecInfer-style) acceptance over one TREE verify pass
     → (out [B, S1], n_emit [B], path [B, S1], cand [B, S1]).
@@ -328,8 +357,22 @@ def spec_tree_acceptance(
       masked (gumbel-argmax), which leaves the target distribution
       exactly unchanged. Sibling tokens must be DISTINCT (the drafters
       guarantee it); width-1 trees reduce to Leviathan acceptance.
-      Greedy rows inside a simple batch use the argmax rule."""
+      Greedy rows inside a simple batch use the argmax rule.
+
+    **Grammar masks** (``mask_bits`` given): node j's packed bitset
+    constrains the distribution AT node j (the one its children are
+    checked against and its correction/bonus token samples from) — the
+    mask of the FSM state reached after consuming node j's token,
+    threaded host-side alongside parents/anc/depth. Illegal logits go to
+    -inf BEFORE any of the math above, so acceptance probabilities use
+    the masked-RENORMALIZED target p(x)/Z_mask, residuals renormalize
+    over the masked vocabulary, and greedy rows take the constrained
+    argmax chain — constrained sampled streams are exactly the
+    constrained target distribution, constrained greedy is byte-stable
+    against the masked-dense path. All-ones rows pass through
+    numerically unchanged (where() with an all-true mask is identity)."""
     B, S1, V = logits.shape
+    logits = apply_mask(logits, mask_bits)
     node = jnp.arange(S1, dtype=jnp.int32)
     live = (node[None, :] <= draft_len[:, None]) & (node[None, :] >= 1)  # edges
     cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)                 # [B, S1]

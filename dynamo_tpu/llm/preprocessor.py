@@ -134,6 +134,21 @@ class OpenAIPreprocessor:
             min_tokens=int(req.min_tokens or 0),
             ignore_eos=req.ignore_eos,
         )
+        # Structured output: the wire shape was validated at parse time;
+        # deep-validate the schema HERE (compiles the constraint regex,
+        # no vocabulary needed) so unsupported constructs 400 at the
+        # frontend instead of erroring a worker stream mid-flight.
+        response_format = getattr(req, "response_format", None)
+        if response_format is not None:
+            from dynamo_tpu.engine.grammar import (
+                GrammarError,
+                compile_response_format_regex,
+            )
+
+            try:
+                compile_response_format_regex(response_format)
+            except GrammarError as e:
+                raise OpenAIError(f"invalid response_format: {e}") from e
         return PreprocessedRequest(
             model=self.card.name,
             token_ids=token_ids,
@@ -141,6 +156,7 @@ class OpenAIPreprocessor:
             stop=stop,
             eos_token_ids=self._eos_ids,
             annotations=annotations,
+            response_format=response_format,
         )
 
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:

@@ -124,6 +124,11 @@ class PreprocessedRequest:
     # request whose KV should be exported; the in-process decode handler
     # attaches {"inject": {...}} with fetched pages before admission.
     kv_transfer_params: dict[str, Any] | None = None
+    # Structured output: the validated OpenAI response_format dict
+    # (json_object / json_schema). Travels the wire as plain JSON; the
+    # worker engine compiles it to a token-mask FSM, cached by schema
+    # hash, and decodes under the mask (engine/grammar.py).
+    response_format: dict[str, Any] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -137,6 +142,8 @@ class PreprocessedRequest:
         }
         if self.kv_transfer_params is not None:
             d["kv_transfer_params"] = self.kv_transfer_params
+        if self.response_format is not None:
+            d["response_format"] = self.response_format
         return d
 
     @classmethod
@@ -150,6 +157,7 @@ class PreprocessedRequest:
             estimated_prefix_hit_num_blocks=d.get("estimated_prefix_hit_num_blocks"),
             annotations=dict(d.get("annotations") or {}),
             kv_transfer_params=d.get("kv_transfer_params"),
+            response_format=d.get("response_format"),
         )
 
 
@@ -290,6 +298,48 @@ def _opt_float(d: dict, key: str, lo: float, hi: float) -> float | None:
     return v
 
 
+def validate_response_format(d: dict) -> dict | None:
+    """Parse + structurally validate an OpenAI ``response_format`` value
+    → a normalized dict ({"type": "json_object"} or {"type":
+    "json_schema", "json_schema": {...}}), None for text/absent.
+    Malformed specs raise a 400 :class:`OpenAIError` with a typed body.
+    Deep schema validation (unsupported constructs, bad patterns)
+    happens in the preprocessor via the grammar compiler — this layer
+    only enforces the wire shape."""
+    rf = d.get("response_format")
+    if rf is None:
+        return None
+    if not isinstance(rf, dict):
+        raise OpenAIError("'response_format' must be an object")
+    ftype = rf.get("type")
+    if ftype == "text":
+        return None
+    if ftype == "json_object":
+        return {"type": "json_object"}
+    if ftype == "json_schema":
+        js = rf.get("json_schema")
+        if not isinstance(js, dict):
+            raise OpenAIError(
+                "'response_format.json_schema' must be an object"
+            )
+        schema = js.get("schema")
+        if not isinstance(schema, dict):
+            raise OpenAIError(
+                "'response_format.json_schema.schema' must be a JSON schema object"
+            )
+        out: dict[str, Any] = {"type": "json_schema",
+                               "json_schema": {"schema": schema}}
+        if js.get("name") is not None:
+            out["json_schema"]["name"] = str(js["name"])
+        if js.get("strict") is not None:
+            out["json_schema"]["strict"] = bool(js["strict"])
+        return out
+    raise OpenAIError(
+        "'response_format.type' must be one of 'text', 'json_object', "
+        "'json_schema'"
+    )
+
+
 def _parse_stop(d: dict) -> list[str]:
     stop = d.get("stop")
     if stop is None:
@@ -326,6 +376,10 @@ class ChatCompletionRequest:
     presence_penalty: float | None = None
     min_tokens: int | None = None     # extension
     ignore_eos: bool = False          # extension
+    # OpenAI structured output: None | {"type": "json_object"} |
+    # {"type": "json_schema", "json_schema": {"schema": ...}} — compiled
+    # to a token-mask FSM engine-side (engine/grammar.py).
+    response_format: dict[str, Any] | None = None
     annotations: list[str] = field(default_factory=list)  # nvext-style debug annotations
     raw: dict[str, Any] = field(default_factory=dict)
 
@@ -372,6 +426,7 @@ class ChatCompletionRequest:
             presence_penalty=_opt_float(d, "presence_penalty", -2.0, 2.0),
             min_tokens=d.get("min_tokens"),
             ignore_eos=bool(d.get("ignore_eos", False)),
+            response_format=validate_response_format(d),
             annotations=list(ext.get("annotations") or []),
             raw=d,
         )
@@ -462,12 +517,16 @@ class ResponsesRequest:
     top_k: int | None = None
     seed: int | None = None
     instructions: str | None = None
+    # Responses-API structured output: `text.format` mapped to the chat
+    # response_format shape (json_object / json_schema — the Responses
+    # flavor flattens name/schema/strict into the format object).
+    response_format: dict[str, Any] | None = None
     raw: dict[str, Any] = field(default_factory=dict)
 
     _UNSUPPORTED = (
         "background", "include", "max_tool_calls", "parallel_tool_calls",
         "previous_response_id", "prompt", "reasoning", "service_tier",
-        "text", "tool_choice", "tools", "truncation",
+        "tool_choice", "tools", "truncation",
     )
     # Values of "unsupported" fields that mean the same as omitting them
     # (incl. everything responses_body echoes back, so a response's own
@@ -476,8 +535,53 @@ class ResponsesRequest:
         "truncation": ("disabled",),
         "tool_choice": ("none", "auto"),
         "service_tier": ("auto", "default"),
-        "text": ({"format": {"type": "text"}},),
     }
+
+    @staticmethod
+    def _parse_text_format(d: dict) -> dict | None:
+        """`text.format` (Responses structured output) → the chat
+        ``response_format`` shape. Previously 501-rejected; now mapped."""
+        text = d.get("text")
+        if text in (None, {}):
+            return None
+        if not isinstance(text, dict):
+            raise OpenAIError("'text' must be an object")
+        # Only `format` is implemented; other text.* options (verbosity,
+        # ...) keep the explicit unsupported signal they had when the
+        # whole field was 501-rejected — silently dropping them would
+        # lie to clients that rely on them.
+        extra = sorted(set(text) - {"format"})
+        if extra:
+            raise OpenAIError(
+                f"'text.{extra[0]}' is not supported", status=501,
+                err_type="not_implemented_error",
+            )
+        fmt = text.get("format")
+        if fmt in (None, {}):
+            return None
+        if not isinstance(fmt, dict):
+            raise OpenAIError("'text.format' must be an object")
+        ftype = fmt.get("type")
+        if ftype == "text":
+            return None
+        if ftype == "json_object":
+            return {"type": "json_object"}
+        if ftype == "json_schema":
+            schema = fmt.get("schema")
+            if not isinstance(schema, dict):
+                raise OpenAIError(
+                    "'text.format.schema' must be a JSON schema object"
+                )
+            js: dict[str, Any] = {"schema": schema}
+            if fmt.get("name") is not None:
+                js["name"] = str(fmt["name"])
+            if fmt.get("strict") is not None:
+                js["strict"] = bool(fmt["strict"])
+            return {"type": "json_schema", "json_schema": js}
+        raise OpenAIError(
+            "'text.format.type' must be one of 'text', 'json_object', "
+            "'json_schema'"
+        )
 
     @classmethod
     def parse(cls, d: Any) -> "ResponsesRequest":
@@ -519,6 +623,7 @@ class ResponsesRequest:
             top_k=d.get("top_k"),
             seed=d.get("seed"),
             instructions=instructions,
+            response_format=cls._parse_text_format(d),
             raw=d,
         )
 
@@ -573,6 +678,7 @@ class ResponsesRequest:
             top_p=self.top_p,
             top_k=self.top_k,
             seed=self.seed,
+            response_format=self.response_format,
             raw=self.raw,
         )
 

@@ -111,6 +111,9 @@ async def build_pipeline(args) -> LocalPipeline:
             num_kv_blocks=args.num_kv_blocks, max_num_seqs=args.max_num_seqs,
             max_model_len=args.max_model_len, dtype=args.dtype,
             decode_steps=args.decode_steps,
+            # response_format token-mask FSMs compile over the SERVING
+            # tokenizer's vocabulary (engine/grammar.py).
+            grammar_tokenizer=parse_tokenizer_spec(args.tokenizer),
         ), params=params, seed=args.seed).start()
         tokenizer = load_tokenizer(parse_tokenizer_spec(args.tokenizer))
         name = model.name

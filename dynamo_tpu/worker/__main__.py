@@ -102,6 +102,12 @@ def parse_args(argv=None):
                         "the topology-masked kernel + Lookahead Jacobi pool)")
     p.add_argument("--spec-tree-depth", type=int, default=0,
                    help="max draft-tree path depth (0 = spec-tokens)")
+    p.add_argument("--spec-budget", choices=["adaptive", "uniform"],
+                   default="adaptive",
+                   help="per-pass draft-node allocation: adaptive moves nodes "
+                        "from acceptance-EMA-cold rows to hot ones under the "
+                        "fixed batch budget (rows x spec-tokens); uniform = "
+                        "every row gets spec-tokens (the pre-r11 behavior)")
     p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
                    default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -425,6 +431,7 @@ def _step_addr(args) -> str:
 
 def _engine_args(args, model):
     from dynamo_tpu.engine.config import EngineArgs
+    from dynamo_tpu.llm.tokenizer import parse_tokenizer_spec as tokenizer_spec
 
     return EngineArgs(
         model=model,
@@ -446,6 +453,11 @@ def _engine_args(args, model):
         spec_fused=not args.spec_stepwise,
         spec_tree_width=args.spec_tree_width,
         spec_tree_depth=args.spec_tree_depth,
+        spec_budget_adaptive=args.spec_budget == "adaptive",
+        # Grammar token-mask FSMs compile over the SERVING tokenizer's
+        # vocabulary (engine/grammar.py) — response_format masks must
+        # legalize exactly the ids the detokenizer can render.
+        grammar_tokenizer=tokenizer_spec(args.tokenizer),
         attn_impl=args.attn_impl,
         quant=args.quant,
         kv_quant=args.kv_quant,

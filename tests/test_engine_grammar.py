@@ -1,0 +1,389 @@
+"""Golden suite for grammar-constrained decoding x tree speculation.
+
+Contract (mirrors tests/test_engine_spec_tree.py, under constraints):
+masked sampling changes WHICH tokens are legal, never the math —
+constrained greedy tree streams are byte-identical to constrained dense
+for any (width x depth), constrained sampled streams follow exactly the
+masked-renormalized target distribution (verified empirically at the
+sampler level), every constrained output parses as schema-valid JSON
+ending on a terminal-state EOS, and batch-level adaptive tree budgets
+never exceed the uniform node total while never starving a drafting
+row. Every request is explicitly seeded (PR 4 lesson)."""
+
+import asyncio
+import json
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import sampler
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.drafter import DraftConstraint, TreeDrafter, constrain_chain
+from dynamo_tpu.engine.engine import TpuEngine, trim_spec_budgets
+from dynamo_tpu.engine.grammar import GrammarCompiler, grammar_vocab, pack_token_ids
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.runtime.engine import Context
+
+CFG = ModelConfig()  # test-tiny
+EOS = ByteTokenizer.EOS
+TOK = ByteTokenizer()
+
+SCHEMA = {"type": "object", "properties": {
+    "name": {"type": "string", "maxLength": 8},
+    "age": {"type": "integer"},
+    "active": {"type": "boolean"},
+}}
+RF = {"type": "json_schema", "json_schema": {"name": "x", "schema": SCHEMA}}
+
+
+def engine_args(S: int = 0, width: int = 2, depth: int = 0,
+                adaptive: bool = True, **kw) -> EngineArgs:
+    defaults = dict(
+        model=CFG, block_size=4, num_kv_blocks=320, max_num_seqs=8,
+        max_model_len=256, max_prefill_tokens=64, dtype="float32",
+        decode_steps=4, spec_tokens=S, spec_gate=0.0, spec_ngram=3,
+        spec_tree_width=width, spec_tree_depth=depth,
+        spec_budget_adaptive=adaptive,
+    )
+    defaults.update(kw)
+    return EngineArgs(**defaults)
+
+
+def request(prompt: str, max_tokens: int = 96, temperature: float = 0.0,
+            seed: int = 0, rf=RF) -> PreprocessedRequest:
+    req = PreprocessedRequest(model="t", token_ids=TOK.encode(prompt))
+    req.sampling.temperature = temperature
+    req.sampling.seed = seed
+    req.stop.max_tokens = max_tokens
+    req.eos_token_ids = [EOS]
+    if rf is not None:
+        req.response_format = rf
+    return req
+
+
+async def run_stream(engine, req):
+    toks, finish = [], None
+    async for item in engine.generate(req, Context()):
+        toks.extend(item.get("token_ids") or [])
+        if item.get("finish_reason"):
+            finish = item["finish_reason"]
+    return toks, finish
+
+
+async def run_workload(eargs, reqs):
+    engine = await TpuEngine(eargs).start()
+    try:
+        out = await asyncio.gather(*(run_stream(engine, r) for r in reqs))
+        stats = {
+            "spec_passes": engine.total_spec_passes,
+            "tree_passes": engine.total_spec_tree_passes,
+            "reallocs": engine.total_spec_budget_reallocs,
+            "mask_s": engine.total_grammar_mask_s,
+            "grammar_seqs": engine.total_grammar_seqs,
+        }
+        return out, stats
+    finally:
+        await engine.stop()
+
+
+def reqs_mixed():
+    # Small on purpose: this workload re-runs per (width x depth) grid
+    # cell inside the tier-1 budget. max_tokens 64 still spans several
+    # forced-run/free-position alternations of the schema.
+    return [
+        request("extract record one: alpha beta", seed=1, max_tokens=64),
+        # generic unconstrained row riding the same batches
+        request("free running text " * 2, seed=3, rf=None, max_tokens=16),
+        request("extract record three: delta", seed=4, max_tokens=64),
+    ]
+
+
+def decode_bytes(toks):
+    return TOK.decode([t for t in toks if t < 256])
+
+
+def assert_schema_valid(text: str):
+    obj = json.loads(text)
+    assert set(obj) == {"name", "age", "active"}
+    assert isinstance(obj["name"], str) and len(obj["name"]) <= 8
+    assert isinstance(obj["age"], int) and not isinstance(obj["age"], bool)
+    assert isinstance(obj["active"], bool)
+
+
+# ---------------------------------------------------------------------------
+# greedy byte-identity: constrained tree == constrained dense
+# ---------------------------------------------------------------------------
+
+
+class TestGreedyByteIdentity:
+    def test_constrained_tree_equals_dense_across_shapes(self):
+        dense, _ = asyncio.run(run_workload(engine_args(S=0), reqs_mixed()))
+        for i, (toks, finish) in enumerate(dense):
+            if i != 1:  # row 1 is the unconstrained rider
+                assert finish == "stop"
+                assert_schema_valid(decode_bytes(toks))
+        for width in (1, 2, 4):
+            for depth in (1, 2, 4):
+                out, stats = asyncio.run(run_workload(
+                    engine_args(S=4, width=width, depth=depth), reqs_mixed()
+                ))
+                assert out == dense, (
+                    f"width={width} depth={depth}: constrained tree stream "
+                    f"diverged from constrained dense"
+                )
+                assert stats["spec_passes"] > 0
+                # any grammar batch dispatches the tree op, even width 1
+                assert stats["tree_passes"] > 0
+
+    def test_uniform_budget_also_byte_identical(self):
+        dense, _ = asyncio.run(run_workload(engine_args(S=0), reqs_mixed()))
+        out, stats = asyncio.run(run_workload(
+            engine_args(S=8, adaptive=False), reqs_mixed()
+        ))
+        assert out == dense
+        assert stats["reallocs"] == 0
+
+    def test_adaptive_budget_byte_identical_and_reallocates(self):
+        dense, _ = asyncio.run(run_workload(engine_args(S=0), reqs_mixed()))
+        out, stats = asyncio.run(run_workload(
+            engine_args(S=4, adaptive=True), reqs_mixed()
+        ))
+        assert out == dense
+        # forced JSON runs exceed S=4, so the trim must have let hot rows
+        # keep >S nodes at least once (the 2S+1 dispatch shape)
+        assert stats["reallocs"] > 0
+
+
+# ---------------------------------------------------------------------------
+# sampled constrained streams
+# ---------------------------------------------------------------------------
+
+
+class TestSampledConstrained:
+    def test_sampled_valid_and_deterministic(self):
+        reqs = lambda: [
+            request(f"record {i}", temperature=0.9, seed=50 + i, max_tokens=96)
+            for i in range(3)
+        ]
+        a, _ = asyncio.run(run_workload(engine_args(S=8), reqs()))
+        b, _ = asyncio.run(run_workload(engine_args(S=8), reqs()))
+        assert a == b, "seeded constrained sampling must be reproducible"
+        for toks, finish in a:
+            assert finish == "stop"
+            assert_schema_valid(decode_bytes(toks))
+
+    def test_malformed_response_format_errors_stream(self):
+        async def go():
+            engine = await TpuEngine(engine_args()).start()
+            try:
+                req = request("x", rf={"type": "json_schema",
+                                       "json_schema": {"schema": {"type": "zzz"}}})
+                items = []
+                async for item in engine.generate(req, Context()):
+                    items.append(item)
+                assert items[-1]["finish_reason"] == "error"
+                assert "response_format" in items[-1]["error"]
+            finally:
+                await engine.stop()
+        asyncio.run(go())
+
+    def test_schema_cache_shared_across_requests(self):
+        async def go():
+            engine = await TpuEngine(engine_args()).start()
+            try:
+                reqs = [request(f"r{i}", seed=i, max_tokens=64) for i in range(3)]
+                await asyncio.gather(*(run_stream(engine, r) for r in reqs))
+                comp = engine._grammar_compiler
+                assert comp is not None
+                assert comp.misses == 1 and comp.hits == 2
+            finally:
+                await engine.stop()
+        asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# sampler-level distribution exactness of masked acceptance
+# ---------------------------------------------------------------------------
+
+
+class TestMaskedDistributionExactness:
+    """Masked multi-round rejection sampling must emit tokens from
+    EXACTLY the masked-renormalized target — empirical histogram vs the
+    analytic masked softmax, and vs the masked-dense sampler."""
+
+    V = 48
+    LEGAL = (2, 5, 9, 17, 30, 41)
+
+    def _bits(self, shape):
+        W32 = (self.V + 31) // 32
+        bits = np.zeros(shape + (W32,), np.uint32)
+        for t in self.LEGAL:
+            bits[..., t >> 5] |= np.uint32(1 << (t & 31))
+        return bits
+
+    def test_tree_acceptance_first_token_masked_exact(self):
+        rng = np.random.default_rng(3)
+        logits_row = rng.normal(0.0, 1.5, (self.V,)).astype(np.float32)
+        N = 4000
+        S1 = 3
+        logits = jnp.asarray(np.broadcast_to(logits_row, (N, S1, self.V)).copy())
+        # root with two sibling children carrying two distinct LEGAL
+        # draft tokens — the multi-round rejection path.
+        tokens = jnp.asarray(
+            np.broadcast_to(np.array([0, self.LEGAL[0], self.LEGAL[1]],
+                                     np.int32), (N, S1)).copy())
+        parents = jnp.asarray(
+            np.broadcast_to(np.array([0, 0, 0], np.int32), (N, S1)).copy())
+        out, n_emit, path, cand = sampler.spec_tree_acceptance(
+            logits, tokens, parents,
+            jnp.full((N,), 2, jnp.int32),          # two live children
+            jnp.ones((N,), jnp.float32),           # temperature 1
+            jnp.arange(N, dtype=jnp.uint32),       # one seed per trial
+            jnp.zeros((N,), jnp.int32),
+            "simple",
+            jnp.asarray(self._bits((N, S1))),
+        )
+        first = np.asarray(out)[:, 0]
+        assert set(np.unique(first)) <= set(self.LEGAL), (
+            "masked acceptance emitted an illegal token"
+        )
+        z = np.exp(logits_row[list(self.LEGAL)])
+        p_ref = z / z.sum()
+        p_emp = np.array([(first == t).mean() for t in self.LEGAL])
+        assert np.abs(p_emp - p_ref).max() < 0.05, (p_emp, p_ref)
+        # masked-dense reference: same masked softmax through
+        # sample_simple over independent seeds
+        dense = np.asarray(sampler.sample_simple(
+            jnp.asarray(np.broadcast_to(logits_row, (N, self.V)).copy()),
+            jnp.ones((N,), jnp.float32),
+            jnp.arange(N, dtype=jnp.uint32) + 10_000,
+            jnp.zeros((N,), jnp.int32),
+            jnp.asarray(self._bits((N,))),
+        ))
+        p_dense = np.array([(dense == t).mean() for t in self.LEGAL])
+        assert np.abs(p_emp - p_dense).max() < 0.07, (p_emp, p_dense)
+
+    def test_greedy_masked_tree_is_constrained_argmax(self):
+        rng = np.random.default_rng(4)
+        logits_row = rng.normal(0.0, 1.5, (self.V,)).astype(np.float32)
+        S1 = 2
+        logits = jnp.asarray(logits_row[None, None, :].repeat(S1, 1))
+        tokens = jnp.asarray([[0, self.LEGAL[0]]], jnp.int32)
+        parents = jnp.asarray([[0, 0]], jnp.int32)
+        out, n_emit, path, cand = sampler.spec_tree_acceptance(
+            logits, tokens, parents, jnp.asarray([1], jnp.int32),
+            jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.uint32),
+            jnp.zeros((1,), jnp.int32), "greedy",
+            jnp.asarray(self._bits((1, S1))),
+        )
+        best = self.LEGAL[int(np.argmax(logits_row[list(self.LEGAL)]))]
+        assert int(np.asarray(cand)[0, 0]) == best
+
+
+# ---------------------------------------------------------------------------
+# batch-budget reallocation invariants
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetInvariants:
+    def test_trim_invariants_randomized(self):
+        rng = random.Random(11)
+        for _ in range(300):
+            n = rng.randint(1, 12)
+            S = rng.choice((1, 2, 4, 8))
+            rows = [
+                (rng.random(), rng.randint(0, 2 * S))
+                for _ in range(n)
+            ]
+            keep = trim_spec_budgets(rows, S)
+            assert sum(keep) <= n * S, (rows, S, keep)
+            for (ema, drafted), k in zip(rows, keep):
+                assert 0 <= k <= drafted
+                # never starved: a drafting row keeps its probe
+                assert k >= min(drafted, 1)
+                # never trimmed below the uniform path's EMA shrink
+                desired = max(1, round(S * min(1.0, ema / 0.5)))
+                assert k >= min(drafted, desired), (rows, S, keep)
+
+    def test_under_budget_keeps_everything(self):
+        rows = [(1.0, 3), (0.1, 2), (0.5, 1)]
+        assert trim_spec_budgets(rows, 4) == [3, 2, 1]
+
+    def test_over_budget_trims_coldest_first(self):
+        # budget 2*2=4; drafted 4+4=8 → trim 4, all from the cold row
+        # down to its desired (floor 1), then the hot row if needed
+        rows = [(1.0, 4), (0.0, 4)]
+        keep = trim_spec_budgets(rows, 2)
+        assert sum(keep) <= 4
+        assert keep[0] == 4 - (4 - keep[1]) or keep[0] >= keep[1]
+        assert keep[1] >= 1
+
+    def test_empty_and_zero_budget(self):
+        assert trim_spec_budgets([], 4) == []
+        assert trim_spec_budgets([(1.0, 3)], 0) == [0]
+
+
+# ---------------------------------------------------------------------------
+# constrained drafting units
+# ---------------------------------------------------------------------------
+
+
+class _FakeFsm:
+    """Linear token FSM over a fixed legal chain, with a branch point."""
+
+    def __init__(self, chain, branch_at=None, branch_tok=None):
+        self.chain = list(chain)
+        self.branch_at = branch_at
+        self.branch_tok = branch_tok
+
+    def step(self, state, tok):
+        if state < len(self.chain) and tok == self.chain[state]:
+            return state + 1
+        if state == self.branch_at and tok == self.branch_tok:
+            return state + 1
+        return None
+
+    def forced(self, state):
+        if state == self.branch_at or state >= len(self.chain):
+            return None
+        return self.chain[state]
+
+
+class TestConstrainedDrafting:
+    def test_constrain_chain_truncates_and_fast_forwards(self):
+        fsm = _FakeFsm([10, 11, 12, 13])
+        c = DraftConstraint(0, fsm.step, fsm.forced)
+        # draft proposes a legal prefix then garbage: truncate at the
+        # illegal token, then extend with forced continuations
+        assert constrain_chain([10, 99, 98], c, 4) == [10, 11, 12, 13]
+        # empty draft still fast-forwards the forced run
+        assert constrain_chain([], c, 3) == [10, 11, 12]
+        # budget bounds everything
+        assert constrain_chain([10, 11], c, 2) == [10, 11]
+
+    def test_tree_drafter_prunes_to_legal(self):
+        drafter = TreeDrafter(n=1, width=2, depth=4)
+        state = drafter.new_state()
+        # history with two continuations of token 7: 20 (older) and 21
+        tokens = [7, 20, 7, 21, 7]
+        fsm = _FakeFsm([21, 30], branch_at=0, branch_tok=99)
+        c = DraftConstraint(0, fsm.step, fsm.forced)
+        tree = drafter.draft_tree(tokens, state, budget=4, constraint=c)
+        # 20 is FSM-illegal and must be pruned; 21 survives and the
+        # forced continuation 30 rides behind it
+        assert 20 not in tree.tokens
+        assert tree.tokens[:2] == [21, 30]
+
+    def test_forced_token_drafted_without_signal(self):
+        drafter = TreeDrafter(n=3, width=2, depth=4)
+        state = drafter.new_state()
+        fsm = _FakeFsm([40, 41, 42])
+        c = DraftConstraint(0, fsm.step, fsm.forced)
+        # history has NO n-gram hits at all — the forced run drafts anyway
+        tree = drafter.draft_tree([1, 2, 3, 4], state, budget=3, constraint=c)
+        assert tree.tokens == [40, 41, 42]
+        assert tree.is_chain()

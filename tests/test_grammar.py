@@ -1,0 +1,277 @@
+"""Unit tests for the constraint compiler (engine/grammar.py): the
+byte-level regex subset, JSON-schema → regex, the token-level FSM lift
+(terminal-state EOS semantics, forced states, tokenizer-boundary walks),
+and the schema-hash compile cache. Host-only — no jax, no engine."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.grammar import (
+    CompiledGrammar,
+    GrammarCompiler,
+    GrammarError,
+    _ByteDfa,
+    build_compiler,
+    compile_response_format_regex,
+    grammar_vocab,
+    mask_words,
+    pack_token_ids,
+    schema_to_regex,
+)
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+EOS = ByteTokenizer.EOS
+V = 512  # test-tiny model vocab
+
+
+def bit(mask: np.ndarray, t: int) -> bool:
+    return bool(mask[t >> 5] & np.uint32(1 << (t & 31)))
+
+
+def legal_set(g: CompiledGrammar, state: int, eos_bits=None) -> set[int]:
+    m = g.mask(state, eos_bits)
+    return {t for t in range(V) if bit(m, t)}
+
+
+def make_compiler() -> GrammarCompiler:
+    return GrammarCompiler(grammar_vocab(ByteTokenizer()), V)
+
+
+# ---------------------------------------------------------------------------
+# byte-level regex engine
+# ---------------------------------------------------------------------------
+
+
+class TestByteDfa:
+    def accepts(self, pattern: str, text: str) -> bool:
+        dfa = _ByteDfa(pattern)
+        sid = dfa.walk(dfa.start, text.encode())
+        return sid is not None and dfa.accepting(sid)
+
+    def test_literals_and_alternation(self):
+        assert self.accepts("abc", "abc")
+        assert not self.accepts("abc", "abd")
+        assert self.accepts("ab|cd", "cd")
+        assert not self.accepts("ab|cd", "ad")
+
+    def test_classes_ranges_negation(self):
+        assert self.accepts("[a-c]+", "abccba")
+        assert not self.accepts("[a-c]+", "abd")
+        assert self.accepts("[^abc]", "z")
+        assert not self.accepts("[^abc]", "b")
+        # negation complements over printable bytes only
+        assert not self.accepts("[^a]", "\x00")
+
+    def test_quantifiers(self):
+        assert self.accepts("a*", "")
+        assert self.accepts("a?b", "b")
+        assert self.accepts("a+", "aaa")
+        assert not self.accepts("a+", "")
+        assert self.accepts("a{2,3}", "aa")
+        assert self.accepts("a{2,3}", "aaa")
+        assert not self.accepts("a{2,3}", "aaaa")
+        assert self.accepts("a{2}", "aa")
+        assert self.accepts("a{2,}", "aaaaa")
+
+    def test_escapes_and_groups(self):
+        assert self.accepts(r"\d{3}", "407")
+        assert self.accepts(r"\w+", "ab_9")
+        assert self.accepts(r"\.", ".")
+        assert self.accepts(r"(ab)+c", "ababc")
+        assert self.accepts("(?:xy|z)w", "zw")
+
+    def test_parse_errors(self):
+        for bad in ("a{", "a{x}", "[abc", "(ab", "*a", "a{3,1}", "a\\"):
+            with pytest.raises(GrammarError):
+                _ByteDfa(bad)
+
+    def test_unsupported_alnum_escapes_rejected(self):
+        # \x / \u / \b / backrefs would silently compile the WRONG
+        # language if treated as literals — they must raise instead,
+        # both top-level and inside classes.
+        for bad in (r"\x41", r"\A", r"a\b", r"(a)\1", r"[\x41]"):
+            with pytest.raises(GrammarError):
+                _ByteDfa(bad)
+        # punctuation escapes stay literal
+        assert self.accepts(r"\{\}", "{}")
+
+
+# ---------------------------------------------------------------------------
+# JSON schema → regex
+# ---------------------------------------------------------------------------
+
+
+class TestSchemaToRegex:
+    def test_scalars(self):
+        assert schema_to_regex({"type": "boolean"}) == "(?:true|false)"
+        assert schema_to_regex({"type": "null"}) == "null"
+        assert "0|[1-9]" in schema_to_regex({"type": "integer"})
+
+    def test_enum_const(self):
+        r = schema_to_regex({"enum": ["a", "b"]})
+        assert '"a"' in r and '"b"' in r
+        assert schema_to_regex({"const": 7}) == "7"
+
+    def test_object_layout_is_canonical(self):
+        r = schema_to_regex({"type": "object", "properties": {
+            "x": {"type": "integer"}, "y": {"type": "boolean"}}})
+        assert r.startswith('\\{"x": ')
+        assert '", "y": ' in r.replace("\\", "", 0) or '"y": ' in r
+
+    def test_ref_resolution(self):
+        schema = {"$defs": {"leaf": {"type": "boolean"}},
+                  "type": "object",
+                  "properties": {"v": {"$ref": "#/$defs/leaf"}}}
+        r = schema_to_regex(schema)
+        assert "true|false" in r
+
+    def test_unsupported_rejected(self):
+        with pytest.raises(GrammarError):
+            schema_to_regex({"type": "frobnicate"})
+        with pytest.raises(GrammarError):
+            schema_to_regex({"$ref": "http://x/y"})
+        with pytest.raises(GrammarError):
+            schema_to_regex({"type": "string", "minLength": 5, "maxLength": 2})
+        # nesting past the depth budget
+        deep: dict = {"type": "object", "properties": {}}
+        node = deep
+        for _ in range(8):
+            node["properties"] = {"n": {"type": "object", "properties": {}}}
+            node = node["properties"]["n"]
+        with pytest.raises(GrammarError):
+            schema_to_regex(deep)
+
+    def test_response_format_shapes(self):
+        assert compile_response_format_regex({"type": "text"}) is None
+        assert compile_response_format_regex({"type": "json_object"})
+        with pytest.raises(GrammarError):
+            compile_response_format_regex({"type": "json_schema"})
+        with pytest.raises(GrammarError):
+            compile_response_format_regex({"type": "nope"})
+        with pytest.raises(GrammarError):
+            compile_response_format_regex("not a dict")
+
+
+# ---------------------------------------------------------------------------
+# token-level FSM
+# ---------------------------------------------------------------------------
+
+SCHEMA = {"type": "object", "properties": {
+    "name": {"type": "string", "maxLength": 6},
+    "ok": {"type": "boolean"},
+}}
+RF = {"type": "json_schema", "json_schema": {"name": "t", "schema": SCHEMA}}
+
+
+class TestTokenFsm:
+    def test_forced_run_through_structure(self):
+        g = make_compiler().compile(RF)
+        state = g.start
+        emitted = []
+        # The opening structure {"name": " is fully forced.
+        for _ in range(20):
+            f = g.forced(state)
+            if f is None:
+                break
+            emitted.append(f)
+            state = g.advance(state, f)
+        assert bytes(emitted).decode() == '{"name": "'
+
+    def test_terminal_eos_semantics(self):
+        g = make_compiler().compile(RF)
+        eos_bits = pack_token_ids([EOS], V)
+        # start state: EOS masked
+        assert EOS not in legal_set(g, g.start, eos_bits)
+        # drive a full match; at the terminal state EOS is the ONLY move
+        state = g.start
+        for b in b'{"name": "ab", "ok": true}':
+            state = g.advance(state, b)
+            assert state is not None
+        assert g.is_terminal(state)
+        assert legal_set(g, state, eos_bits) == {EOS}
+        # without eos bits the completed state has an empty mask
+        assert legal_set(g, state) == set()
+
+    def test_advance_illegal_returns_none(self):
+        g = make_compiler().compile(RF)
+        assert g.advance(g.start, ord("x")) is None
+        assert g.legal(g.start, ord("{"))
+        assert not g.legal(g.start, ord("}"))
+
+    def test_vocab_ids_past_tokenizer_range_always_illegal(self):
+        g = make_compiler().compile(RF)
+        for state in (g.start,):
+            legal = legal_set(g, state)
+            assert all(t < 256 for t in legal)
+
+    def test_token_boundary_multibyte_tokens(self):
+        """A multi-byte token is legal iff its WHOLE byte walk survives
+        — the tokenizer-boundary case (BPE-style merged tokens)."""
+        vocab = {1: b"tr", 2: b"ue", 3: b"true", 4: b"tX", 5: b"t",
+                 6: b"truefalse"}
+        comp = GrammarCompiler(vocab, 16)
+        g = comp.compile({"type": "json_schema",
+                          "json_schema": {"schema": {"type": "boolean"}}})
+        legal = {t for t in range(16) if bit(g.mask(g.start), t)}
+        # "tr", "true", "t" survive from the start; "tX" and the
+        # overshooting "truefalse" die mid-walk.
+        assert legal == {1, 3, 5}
+        st = g.advance(g.start, 1)  # consumed "tr"
+        legal2 = {t for t in range(16) if bit(g.mask(st), t)}
+        assert legal2 == {2}       # only "ue" completes
+        done = g.advance(st, 2)
+        assert g.is_terminal(done)
+
+    def test_masked_random_walks_always_valid(self):
+        g = make_compiler().compile(RF)
+        eos_bits = pack_token_ids([EOS], V)
+        rng = random.Random(7)
+        for _ in range(25):
+            state, out = g.start, []
+            for _ in range(200):
+                legal = sorted(legal_set(g, state, eos_bits))
+                assert legal, "reached a dead state"
+                t = rng.choice(legal)
+                if t == EOS:
+                    break
+                out.append(t)
+                state = g.advance(state, t)
+            assert g.is_terminal(state)
+            obj = json.loads(bytes(out).decode())
+            assert set(obj) == {"name", "ok"}
+            assert isinstance(obj["name"], str) and len(obj["name"]) <= 6
+            assert isinstance(obj["ok"], bool)
+
+    def test_pack_token_ids(self):
+        m = pack_token_ids([0, 31, 32, 511, 512, -1], 512)
+        assert m.shape == (mask_words(512),) == (16,)
+        assert bit(m, 0) and bit(m, 31) and bit(m, 32) and bit(m, 511)
+        assert int(m.sum()) > 0
+        assert not bit(pack_token_ids([5], 512), 6)
+
+
+class TestCompilerCache:
+    def test_schema_hash_cache_hits(self):
+        comp = make_compiler()
+        g1 = comp.compile(RF)
+        g2 = comp.compile(dict(RF))  # equal spec, different dict identity
+        assert g1 is g2
+        assert comp.misses == 1 and comp.hits == 1
+        other = {"type": "json_schema",
+                 "json_schema": {"schema": {"type": "boolean"}}}
+        g3 = comp.compile(other)
+        assert g3 is not g1
+        assert comp.misses == 2
+
+    def test_text_is_unconstrained(self):
+        comp = make_compiler()
+        assert comp.compile({"type": "text"}) is None
+
+    def test_build_compiler_defaults_to_byte_vocab(self):
+        comp = build_compiler(None, V)
+        g = comp.compile(RF)
+        assert g.vocab_size == V
+        assert ord("{") in legal_set(g, g.start)

@@ -88,8 +88,10 @@ async def engine_hotloop(
     spec_fused: bool = True,
     spec_tree_width: int = 1,
     spec_tree_depth: int = 0,
+    spec_budget: str = "adaptive",
     repetitive: bool = False,
     branchy: bool = False,
+    structured: bool = False,
     kv_quant: str = "none",
     max_num_seqs: int = 8,
     num_kv_blocks: int = 256,
@@ -101,10 +103,15 @@ async def engine_hotloop(
     > 0. ``repetitive`` tiles a short pattern into each prompt (the
     n-gram-overlap shape speculation targets); ``branchy`` tiles
     period-4 [a, b, a, c] patterns — the SAME context recurs with
-    DIFFERENT continuations, the shape tree drafting branches on."""
+    DIFFERENT continuations, the shape tree drafting branches on;
+    ``structured`` makes every request a grammar-constrained JSON
+    extraction (shared schema via response_format — the FSM-masked
+    sampling + pruned-draft path), reporting per-request decoded texts
+    as ``texts``."""
     from dynamo_tpu.engine.config import EngineArgs, ModelConfig
     from dynamo_tpu.engine.engine import BLOCKING_PHASES, TpuEngine
     from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
     from dynamo_tpu.runtime.engine import Context
 
     cfg = ModelConfig.preset(model)
@@ -118,14 +125,29 @@ async def engine_hotloop(
         pipeline_depth=pipeline_depth, pipeline_windows=pipeline_depth > 0,
         spec_tokens=spec_tokens, spec_ngram=spec_ngram,
         spec_fused=spec_fused, spec_tree_width=spec_tree_width,
-        spec_tree_depth=spec_tree_depth, kv_quant=kv_quant, **kw,
+        spec_tree_depth=spec_tree_depth,
+        spec_budget_adaptive=spec_budget == "adaptive",
+        kv_quant=kv_quant, **kw,
     )
+    tok = ByteTokenizer()
     engine = await TpuEngine(eargs, seed=0).start()
     try:
         rng = np.random.default_rng(seed)
         reqs = []
         for i in range(n_requests):
             plen = int(prompt_len + (i * 7) % 17)  # mixed lengths, deterministic
+            if structured:
+                req = PreprocessedRequest(
+                    model=cfg.name,
+                    token_ids=tok.encode(f"extract record {i} as json: item{i}"),
+                )
+                req.response_format = GRAMMAR_RF
+                req.eos_token_ids = [ByteTokenizer.EOS]
+                req.sampling.temperature = 0.0
+                req.sampling.seed = i
+                req.stop.max_tokens = max(gen_len, 96)
+                reqs.append(req)
+                continue
             if branchy:
                 a, b, c = (int(x) for x in rng.integers(1, cfg.vocab_size - 1, 3))
                 pat = [a, b, a, c if c != b else (c % (cfg.vocab_size - 2)) + 1]
@@ -180,6 +202,12 @@ async def engine_hotloop(
                 engine.total_prefill_padded / max(1, engine.total_prefilled), 3
             ),
         }
+        if structured:
+            out["texts"] = [
+                tok.decode([t for t in s if t < 256]) for s in streams
+            ]
+            out["grammar_mask_s"] = round(engine.total_grammar_mask_s, 4)
+            out["budget_reallocs"] = engine.total_spec_budget_reallocs
         if spec_tokens > 0:
             hist = await engine.run_on_engine_thread(
                 lambda: dict(engine._spec_depth_hist)
@@ -211,6 +239,61 @@ async def engine_hotloop(
 # token-accounting assertion so retuning one can't silently break the other.
 QUICK_SPEC_REQUESTS = 6
 QUICK_SPEC_GEN = 24
+
+# Grammar probe schema (engine/grammar.py token-mask FSMs): forced JSON
+# structure around free string/int/bool value positions.
+GRAMMAR_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 8},
+        "age": {"type": "integer"},
+        "active": {"type": "boolean"},
+    },
+}
+GRAMMAR_RF = {
+    "type": "json_schema",
+    "json_schema": {"name": "extract", "schema": GRAMMAR_SCHEMA},
+}
+
+
+def _grammar_valid(text: str) -> bool:
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return False
+    return (
+        isinstance(obj, dict) and set(obj) == {"name", "age", "active"}
+        and isinstance(obj["name"], str)
+        and isinstance(obj["age"], int) and not isinstance(obj["age"], bool)
+        and isinstance(obj["active"], bool)
+    )
+
+
+def run_grammar_sweep(*, quick: bool = False, pipeline_depth: int = 2,
+                      decode_steps: int = 4) -> dict:
+    """``--grammar`` probe: grammar-constrained JSON extraction on the
+    real scheduler — masked-dense (spec 0), constrained tree with
+    adaptive batch budgets, and constrained tree with the uniform
+    per-row budget, on the IDENTICAL seeded schedule. Reports
+    tokens_per_weight_pass, accept-depth histogram, mask-build seconds
+    and the decoded outputs (every one must be schema-valid)."""
+    n_requests = QUICK_SPEC_REQUESTS if quick else 8
+    rows = [
+        ("dense", dict(spec_tokens=0)),
+        ("tree_adaptive", dict(spec_tokens=8, spec_tree_width=2,
+                               spec_gate=0.0, spec_budget="adaptive")),
+    ]
+    if not quick:  # the budget A/B row (tier-1 keeps the smoke lean)
+        rows.append(
+            ("tree_uniform", dict(spec_tokens=8, spec_tree_width=2,
+                                  spec_gate=0.0, spec_budget="uniform")))
+    out = {}
+    for label, kw in rows:
+        out[label] = asyncio.run(engine_hotloop(
+            pipeline_depth, decode_steps=decode_steps,
+            n_requests=n_requests, structured=True, **kw,
+        ))
+    return out
 
 
 def run_kv_quant_sweep(*, quick: bool = False, pipeline_depth: int = 2,
@@ -345,6 +428,27 @@ def run_quick() -> int:
         "spec-tree sweep never dispatched a BRANCHED pass — the branchy "
         "workload or the tree drafter has rotted"
     )
+    # Grammar-constrained smoke: every constrained output parses as
+    # schema-valid JSON, constrained greedy tree (either budget mode) is
+    # byte-identical to constrained dense, the probe is byte-stable
+    # across runs, and the tree rows actually dispatched masked passes.
+    gram = run_grammar_sweep(quick=True)
+    gram2 = asyncio.run(engine_hotloop(
+        2, decode_steps=4, n_requests=QUICK_SPEC_REQUESTS, structured=True,
+        spec_tokens=8, spec_tree_width=2, spec_gate=0.0,
+    ))
+    for label, r in gram.items():
+        bad = [t for t in r["texts"] if not _grammar_valid(t)]
+        assert not bad, f"grammar {label}: invalid JSON output {bad[:1]}"
+        assert r["tokens"] == gram["dense"]["tokens"], (
+            f"grammar {label} token streams diverged from masked-dense"
+        )
+    assert gram2["tokens"] == gram["tree_adaptive"]["tokens"], (
+        "grammar tree probe is not byte-stable across runs"
+    )
+    assert any(r.get("spec_rows", 0) > 0 for r in gram.values()), (
+        "grammar sweep never dispatched a verify pass"
+    )
     # int8-KV sweep: every configuration keeps full token accounting
     # (quantization must never lose or duplicate tokens), the 2x-batch
     # pool fits in the f32 pool's byte budget, and the capacity math
@@ -382,8 +486,13 @@ def run_quick() -> int:
         kq: {k: v for k, v in r.items() if k != "tokens"}
         for kq, r in kvq.items()
     }
+    gram_out = {
+        label: {k: v for k, v in r.items() if k not in ("tokens", "texts")}
+        for label, r in gram.items()
+    }
     print(json.dumps({"hotloop": out, "spec": spec_out, "spec_tree": tree_out,
-                      "kv_quant": kvq_out, "kv_capacity_ratio_8b": round(ratio, 3)}))
+                      "kv_quant": kvq_out, "grammar": gram_out,
+                      "kv_capacity_ratio_8b": round(ratio, 3)}))
     print("QUICK-OK")
     return 0
 
@@ -413,6 +522,12 @@ def main():
                    help="sweep KV storage none vs int8 (matched batch and the "
                         "2x batch the same HBM budget fits): tok/s + pool "
                         "footprint per configuration")
+    p.add_argument("--grammar", action="store_true",
+                   help="grammar-constrained decoding probe: masked-dense vs "
+                        "constrained tree (adaptive + uniform batch budgets) "
+                        "on one seeded JSON-extraction schedule — tok/weight-"
+                        "pass, accept-depth histogram, mask-build overhead, "
+                        "schema-validity per row")
     p.add_argument("--pipeline-depth", type=int, default=2)
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke: CPU tiny shapes + depth-0/2 golden hot-loop probe")
@@ -458,6 +573,18 @@ def main():
         )
         for label, r in sweep.items():
             r.pop("tokens")
+            print(json.dumps({"config": label, **r}))
+        return 0
+    if args.grammar:
+        sweep = run_grammar_sweep(
+            pipeline_depth=args.pipeline_depth, decode_steps=args.decode_steps,
+        )
+        for label, r in sweep.items():
+            r.pop("tokens")
+            texts = r.pop("texts", [])
+            r["valid_frac"] = round(
+                sum(_grammar_valid(t) for t in texts) / max(1, len(texts)), 3
+            )
             print(json.dumps({"config": label, **r}))
         return 0
 
