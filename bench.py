@@ -42,7 +42,7 @@ def parse_args():
     p.add_argument("--fixed-len", action="store_true", help="disable mixed lengths")
     p.add_argument("--workload", default="lognormal-mixed",
                    choices=["lognormal-mixed", "fixed", "repetitive",
-                            "shared-prefix", "structured"],
+                            "shared-prefix", "structured", "multi-lora"],
                    help="lognormal-mixed = ShareGPT-like regression workload; "
                         "repetitive = agentic/extractive prompts with high "
                         "n-gram overlap (the speculation-friendly shape) — "
@@ -83,6 +83,15 @@ def parse_args():
                         "tokens/row-pass (default: EngineArgs default; raise "
                         "on hosts where the verify pass is compute-bound so "
                         "only high-confidence batches leave the dense path)")
+    p.add_argument("--lora-adapters", type=int, default=8,
+                   help="multi-lora workload: tenant adapters multiplexed on "
+                        "the one engine (each tenant = one fine-tune)")
+    p.add_argument("--lora-slots", type=int, default=6,
+                   help="multi-lora workload: device adapter-bank slots; "
+                        "fewer slots than adapters forces the page-in/evict "
+                        "economy to run during the measurement")
+    p.add_argument("--lora-turns", type=int, default=2,
+                   help="multi-lora workload: conversation turns per tenant")
     p.add_argument("--sp-turns", type=int, default=3,
                    help="shared-prefix workload: conversation turns per user")
     p.add_argument("--sp-system-tokens", type=int, default=0,
@@ -857,6 +866,240 @@ async def bench_shared_prefix(args) -> dict:
     }
 
 
+async def bench_multi_lora(args) -> dict:
+    """Multi-LoRA multiplexing proof (ROADMAP 3): a seeded many-tenant
+    schedule — ``--lora-adapters`` per-tenant fine-tunes plus a base
+    cohort, each tenant running a multi-turn conversation — through ONE
+    engine whose adapter bank has FEWER slots than tenants, so the slot
+    economy (page-in through the G2/G3 tiers, second-chance evict) runs
+    live inside the measurement. The identical schedule (same prompts,
+    same per-turn budgets — greedy ignore_eos keeps lengths equal) then
+    runs base-only on an identical-shape no-LoRA engine: the headline is
+    the throughput ratio at equal batch, with base-cohort byte-identity
+    pinned and ``tier_hit_rate`` recorded under adapter+KV contention —
+    the tier-churn measurement PR 10 left open."""
+    import jax
+
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.runtime.engine import Context
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        # Wider than test-tiny on purpose: the BGMV deltas cost
+        # 2·rank/hidden of the base projection FLOPs (~3% at 512/r8,
+        # ~0.4% at 8B geometry), but at test-tiny width the window is
+        # op-DISPATCH-bound and the extra einsums read as a fake 3x —
+        # the ratio needs matmuls big enough to dominate op overhead to
+        # mean anything.
+        model = ModelConfig(
+            name="bench-small", vocab_size=2048, hidden_size=512,
+            intermediate_size=1024, num_layers=4, num_heads=8,
+            num_kv_heads=4, head_dim=64,
+        )
+    else:
+        model = ModelConfig.preset(args.model)
+    device = str(jax.devices()[0])
+
+    rng = np.random.default_rng(0)
+    n_adapters = max(2, args.lora_adapters)
+    n_base = max(2, n_adapters // 2)          # base cohort (byte-identity anchor)
+    n_tenants = n_adapters + n_base
+    turns = max(1, args.lora_turns)
+    slots = max(2, min(args.lora_slots, n_adapters))
+    sfx_med = max(16, args.prompt_len // 4)
+    gen_med = max(12, args.gen_len // 4)
+    sfx_lens = np.clip(
+        (sfx_med * rng.lognormal(0.0, 0.5, (n_tenants, turns))).astype(int),
+        8, sfx_med * 3,
+    )
+    gen_lens = np.clip(
+        (gen_med * rng.lognormal(0.0, 0.5, (n_tenants, turns))).astype(int),
+        8, gen_med * 3,
+    )
+    tenant_msgs = [
+        [rng.integers(1, model.vocab_size - 1, size=int(sfx_lens[u, t])).tolist()
+         for t in range(turns)]
+        for u in range(n_tenants)
+    ]
+    adapter_of = [
+        f"tenant-{u}" if u < n_adapters else None for u in range(n_tenants)
+    ]
+
+    block_size = args.block_size
+    max_ctx = int((sfx_lens.sum(axis=1) + gen_lens.sum(axis=1)).max())
+    seq_len = max_ctx + (args.pipeline_depth + 1) * args.decode_steps
+    blocks_per_seq = (seq_len + block_size - 1) // block_size + 1
+    dtype = "float32" if args.cpu else "bfloat16"
+    max_num_seqs = max(8, min(args.max_num_seqs, n_tenants))
+    eargs = EngineArgs(
+        model=model,
+        block_size=block_size,
+        num_kv_blocks=(max_num_seqs + 4) * blocks_per_seq,
+        max_num_seqs=max_num_seqs,
+        max_model_len=(blocks_per_seq + 1) * block_size,
+        max_prefill_tokens=max(128, int(sfx_lens.max()) + block_size),
+        dtype=dtype,
+        decode_steps=args.decode_steps,
+        pipeline_depth=args.pipeline_depth,
+        pipeline_windows=args.pipeline_depth > 0,
+        prefill_buckets_spec=args.prefill_buckets,
+        quant=args.quant,
+        kv_quant=args.kv_quant,
+        # Modest G2 so adapter pages and offloaded KV blocks COMPETE for
+        # the same host budget under the second-chance credits — the
+        # churn workload tier_hit_rate is measured under.
+        host_kv_blocks=max(64, 8 * n_tenants),
+    )
+
+    def turn_req(history, u: int, t: int, lora: bool) -> PreprocessedRequest:
+        req = PreprocessedRequest(
+            model=model.name, token_ids=list(history),
+            adapter_id=adapter_of[u] if lora else None,
+        )
+        req.sampling.temperature = 0.0
+        req.sampling.seed = u * 257 + t
+        req.stop.max_tokens = int(gen_lens[u, t])
+        req.stop.ignore_eos = True
+        return req
+
+    async def drive(engine, lora: bool) -> dict:
+        """Tenants concurrent, each tenant's turns sequential (a turn's
+        prompt embeds the full prior history incl. replies). Adapter-
+        tenant concurrency is bounded to the SLOT count in BOTH runs —
+        the admission-shaped arrival process a sticky fleet produces
+        (and what keeps the A/B equal-batch: without the bound the base
+        run would enjoy full concurrency while the lora run serializes
+        on pinned slots, measuring batch shrink instead of LoRA cost).
+        Tenants still outnumber slots, so conversations cycle adapters
+        through the slots: page-ins evict cold residents and later turns
+        re-page them — the slot economy runs inside the measurement."""
+        total_gen = 0
+        streams: dict[int, list[list[int]]] = {u: [] for u in range(n_tenants)}
+        # Applied by TENANT INDEX, identically in the base run: both
+        # sides see the same concurrency schedule.
+        adapter_gate = asyncio.Semaphore(slots)
+
+        async def conversation(u: int):
+            nonlocal total_gen
+            history = list(tenant_msgs[u][0])
+            for t in range(turns):
+                if t:
+                    history = history + tenant_msgs[u][t]
+                out: list[int] = []
+                async for item in engine.generate(
+                    turn_req(history, u, t, lora), Context()
+                ):
+                    if item.get("error"):
+                        raise RuntimeError(item["error"])
+                    out.extend(item.get("token_ids") or [])
+                total_gen += len(out)
+                streams[u].append(out)
+                history = history + out
+
+        async def gated(u: int):
+            if u < n_adapters:
+                async with adapter_gate:
+                    await conversation(u)
+            else:
+                await conversation(u)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(gated(u) for u in range(n_tenants)))
+        dur = time.perf_counter() - t0
+        return {
+            "elapsed_s": dur,
+            "gen_tokens": total_gen,
+            "tok_s": total_gen / dur if dur else 0.0,
+            "streams": streams,
+        }
+
+    results = {}
+    for label, lora in (("lora", True), ("base", False)):
+        _stage(f"multi-lora run: adapters={'on' if lora else 'off'}")
+        engine = await TpuEngine(
+            eargs.replace(lora_slots=slots if lora else 0), seed=0
+        ).start()
+        try:
+            if lora:
+                for u in range(n_adapters):
+                    engine.register_adapter(adapter_of[u], rank=8, seed=41)
+            await drive(engine, lora)   # warmup: compiles + first page-ins
+            engine.clear_kv_blocks()
+            stats0 = engine.lora_stats()
+            lora_s0 = engine.total_lora_s
+            results[label] = await drive(engine, lora)
+            if lora:
+                # Deltas over the TIMED run only — warmup pages every
+                # adapter in once, which must not masquerade as churn.
+                stats1 = engine.lora_stats()
+                results[label]["lora_stats"] = {
+                    k: (stats1[k] - stats0[k]
+                        if k not in ("resident", "num_slots") else stats1[k])
+                    for k in stats1
+                }
+                results[label]["tier_stats"] = engine.tiers.stats()
+                results[label]["lora_host_s"] = round(
+                    engine.total_lora_s - lora_s0, 3
+                )
+        finally:
+            await engine.stop()
+        _stage(f"multi-lora {label}: {results[label]['tok_s']:.0f} tok/s")
+
+    lr, br = results["lora"], results["base"]
+    # Base-cohort byte-identity: tenants with no adapter produced the
+    # SAME streams whether or not adapter rows shared their batches.
+    base_identical = all(
+        lr["streams"][u] == br["streams"][u]
+        for u in range(n_adapters, n_tenants)
+    )
+    adapted = sum(
+        1 for u in range(n_adapters) if lr["streams"][u] != br["streams"][u]
+    )
+    ls = lr["lora_stats"]
+    ratio = lr["tok_s"] / max(1e-9, br["tok_s"])
+    result = {
+        "metric": "multi_lora_tok_s_ratio",
+        "value": round(ratio, 3),
+        "unit": "x base-model throughput at equal batch",
+        "vs_baseline": round(ratio, 3),
+        "vs_baseline_basis": "identical seeded schedule, lora engine vs "
+                             "base-only engine, equal max_num_seqs",
+        "workload": "multi-lora",
+        "model": model.name,
+        "device": device,
+        "num_adapters": n_adapters,
+        "num_base_tenants": n_base,
+        "lora_slots": slots,
+        "turns_per_tenant": turns,
+        "lora_tok_s": round(lr["tok_s"], 2),
+        "base_tok_s": round(br["tok_s"], 2),
+        "gen_tokens": lr["gen_tokens"],
+        "base_rows_byte_identical": base_identical,
+        "adapter_rows_diverged": adapted,
+        "lora_pageins": ls["pageins"],
+        "lora_evictions": ls["evictions"],
+        "lora_repageins": ls["repageins"],
+        "lora_resident": ls["resident"],
+        "lora_host_s": lr["lora_host_s"],
+        "tier_hit_rate": lr["tier_stats"]["hit_rate"],
+        "tier_stats": lr["tier_stats"],
+    }
+    if not base_identical:
+        result["error"] = "base-cohort streams diverged under adapter mixing"
+    elif adapted < n_adapters:
+        result["error"] = (
+            f"only {adapted}/{n_adapters} adapter tenants diverged from base"
+        )
+    elif ls["evictions"] < 1 or ls["repageins"] < 1:
+        result["error"] = (
+            f"slot economy never cycled (evictions={ls['evictions']}, "
+            f"repageins={ls['repageins']}) — raise adapters or lower slots"
+        )
+    return result
+
+
 # The structured workload's shared extraction schema: mostly-forced JSON
 # structure around free value positions — the tool-call/JSON-extraction
 # serving shape. Field types cover string/int/bool/array paths.
@@ -1362,6 +1605,8 @@ def main():
             result = asyncio.run(bench_shared_prefix(args))
         elif args.workload == "structured":
             result = asyncio.run(bench_structured(args))
+        elif args.workload == "multi-lora":
+            result = asyncio.run(bench_multi_lora(args))
         else:
             result = asyncio.run(bench(args))
     except Exception as e:  # noqa: BLE001 — bench must always print a line

@@ -14,6 +14,12 @@ The pool emits KV cache events on block registration/eviction — the same
 events that feed the KV-aware router's global index.
 """
 
+from dynamo_tpu.block_manager.adapters import AdapterSlotPool, NoFreeAdapterSlotsError
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 
-__all__ = ["BlockPool", "NoFreeBlocksError"]
+__all__ = [
+    "AdapterSlotPool",
+    "BlockPool",
+    "NoFreeAdapterSlotsError",
+    "NoFreeBlocksError",
+]

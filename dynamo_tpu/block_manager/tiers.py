@@ -85,6 +85,12 @@ class HostBlockPool:
         self.capacity = capacity_blocks
         self._pages: OrderedDict[int, tuple[np.ndarray, ...]] = OrderedDict()
         self._credit: dict[int, int] = {}
+        # Weighted capacity: entries default to 1 unit (KV blocks), but
+        # larger paged objects (LoRA adapters, TierStack.put_object)
+        # charge their byte-honest block-equivalent so the blocks-
+        # denominated budget stays a byte budget.
+        self._weights: dict[int, int] = {}
+        self._units = 0
         self._lock = threading.Lock()
         self._spill = spill  # callable(hash, *pages) — e.g. DiskBlockPool.put
         self.hits = 0
@@ -95,7 +101,8 @@ class HostBlockPool:
         with self._lock:
             return len(self._pages)
 
-    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False) -> None:
+    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False,
+            weight: int = 1) -> None:
         spilled = []
         # Own the storage: callers pass views into shared batch buffers
         # (engine extracts up to 64 blocks per DMA and slices per block);
@@ -108,13 +115,21 @@ class HostBlockPool:
                 _credit_seed(self._credit, seq_hash, protected)
                 return
             self._pages[seq_hash] = pages
+            self._weights[seq_hash] = max(1, int(weight))
+            self._units += self._weights[seq_hash]
             _credit_seed(self._credit, seq_hash, protected)
-            while len(self._pages) > self.capacity:
+            while self._units > self.capacity and self._pages:
                 h, pgs, spared = _second_chance_pop(self._pages, self._credit)
+                w = self._weights.pop(h, 1)
+                self._units -= w
                 self.protected_evictions += spared
-                spilled.append((h, pgs))
-        for h, pgs in spilled:
-            if self._spill is not None:
+                spilled.append((h, pgs, w))
+        for h, pgs, w in spilled:
+            if self._spill is None:
+                continue
+            if w > 1:  # weight kwarg only when it matters: custom spill
+                self._spill(h, *pgs, weight=w)  # sinks predate the kwarg
+            else:
                 self._spill(h, *pgs)
 
     def get(self, seq_hash: int) -> tuple[np.ndarray, ...] | None:
@@ -137,6 +152,8 @@ class HostBlockPool:
             n = len(self._pages)
             self._pages.clear()
             self._credit.clear()
+            self._weights.clear()
+            self._units = 0
             return n
 
 
@@ -151,6 +168,11 @@ class DiskBlockPool:
         self._lock = threading.Lock()
         self._order: OrderedDict[int, None] = OrderedDict()
         self._credit: dict[int, int] = {}
+        # Weighted capacity (same contract as HostBlockPool); adopted
+        # files from a previous process count 1 unit — close enough for
+        # a cache, and exact again once they are re-put.
+        self._weights: dict[int, int] = {}
+        self._units = 0
         for fname in sorted(
             os.listdir(directory),
             key=lambda f: os.path.getmtime(os.path.join(directory, f)),
@@ -158,6 +180,7 @@ class DiskBlockPool:
             if fname.endswith(".npz"):
                 try:
                     self._order[int(fname[:-4])] = None
+                    self._units += 1
                 except ValueError:
                     pass
         self.hits = 0
@@ -171,8 +194,8 @@ class DiskBlockPool:
         with self._lock:
             return len(self._order)
 
-    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False) -> None:
-        k, v = pages[0], pages[1]
+    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False,
+            weight: int = 1) -> None:
         evict: list[int] = []
         with self._lock:
             if seq_hash in self._order:
@@ -180,21 +203,38 @@ class DiskBlockPool:
                 _credit_seed(self._credit, seq_hash, protected)
                 return
             self._order[seq_hash] = None
+            self._weights[seq_hash] = max(1, int(weight))
+            self._units += self._weights[seq_hash]
             _credit_seed(self._credit, seq_hash, protected)
-            while len(self._order) > self.capacity:
+            while self._units > self.capacity and self._order:
                 h, _, spared = _second_chance_pop(self._order, self._credit)
+                self._units -= self._weights.pop(h, 1)
                 self.protected_evictions += spared
                 evict.append(h)
-        # bf16 numpy (ml_dtypes) isn't npz-portable → store uint16 view.
-        kind = str(k.dtype)
-        if kind == "bfloat16":
-            k, v = k.view(np.uint16), v.view(np.uint16)
-        extra = {}
-        if len(pages) == 4:  # int8 pages carry fp32 scale sidecars
-            extra = {"k_scale": pages[2], "v_scale": pages[3]}
+        if len(pages) in (2, 4):
+            # KV page tuples keep the legacy k/v(+scales) layout so a
+            # persistent --disk-kv-dir stays readable across versions.
+            k, v = pages[0], pages[1]
+            # bf16 numpy (ml_dtypes) isn't npz-portable → store uint16 view.
+            kind = str(k.dtype)
+            if kind == "bfloat16":
+                k, v = k.view(np.uint16), v.view(np.uint16)
+            extra = {}
+            if len(pages) == 4:  # int8 pages carry fp32 scale sidecars
+                extra = {"k_scale": pages[2], "v_scale": pages[3]}
+            payload = {"k": k, "v": v, "dtype": np.bytes_(kind), **extra}
+        else:
+            # General object tuples (LoRA adapter pages and any future
+            # paged object): positional arrays + per-array dtype names,
+            # bf16 via the same uint16-view trick.
+            payload = {"n": np.int64(len(pages))}
+            for i, a in enumerate(pages):
+                kind = str(a.dtype)
+                payload[f"d{i}"] = np.bytes_(kind)
+                payload[f"p{i}"] = a.view(np.uint16) if kind == "bfloat16" else a
         tmp = self._path(seq_hash) + ".tmp"
         with open(tmp, "wb") as f:
-            np.savez(f, k=k, v=v, dtype=np.bytes_(kind), **extra)
+            np.savez(f, **payload)
         os.replace(tmp, self._path(seq_hash))
         for h in evict:
             try:
@@ -206,23 +246,35 @@ class DiskBlockPool:
         path = self._path(seq_hash)
         try:
             with np.load(path) as z:
-                k, v, kind = z["k"], z["v"], bytes(z["dtype"]).decode()
-                scales = (
-                    (z["k_scale"], z["v_scale"]) if "k_scale" in z.files else ()
-                )
+                if "n" in z.files:  # general object tuple
+                    pages = []
+                    for i in range(int(z["n"])):
+                        a, kind = z[f"p{i}"], bytes(z[f"d{i}"]).decode()
+                        if kind == "bfloat16":
+                            import ml_dtypes
+
+                            a = a.view(ml_dtypes.bfloat16)
+                        pages.append(a)
+                    out = tuple(pages)
+                else:
+                    k, v, kind = z["k"], z["v"], bytes(z["dtype"]).decode()
+                    scales = (
+                        (z["k_scale"], z["v_scale"]) if "k_scale" in z.files else ()
+                    )
+                    if kind == "bfloat16":
+                        import ml_dtypes
+
+                        k, v = k.view(ml_dtypes.bfloat16), v.view(ml_dtypes.bfloat16)
+                    out = (k, v, *scales)
         except (OSError, KeyError, ValueError):
             self.misses += 1
             return None
-        if kind == "bfloat16":
-            import ml_dtypes
-
-            k, v = k.view(ml_dtypes.bfloat16), v.view(ml_dtypes.bfloat16)
         with self._lock:
             if seq_hash in self._order:
                 self._order.move_to_end(seq_hash)
                 _credit_touch(self._credit, seq_hash)
         self.hits += 1
-        return (k, v, *scales)
+        return out
 
     def contains(self, seq_hash: int) -> bool:
         with self._lock:
@@ -233,6 +285,8 @@ class DiskBlockPool:
             hashes = list(self._order)
             self._order.clear()
             self._credit.clear()
+            self._weights.clear()
+            self._units = 0
         for h in hashes:
             try:
                 os.remove(self._path(h))
@@ -254,13 +308,25 @@ class TierStack:
 
     MAX_OFFLOAD_PER_STEP = 64
 
-    def __init__(self, host: HostBlockPool | None, disk: DiskBlockPool | None):
+    def __init__(self, host: HostBlockPool | None, disk: DiskBlockPool | None,
+                 unit_bytes: int | None = None):
         self.host = host
         self.disk = disk
+        # Bytes one capacity unit represents (the engine passes its
+        # kv_bytes_per_block): NON-KV paged objects charge the pools
+        # ceil(bytes/unit) so the blocks-denominated budget stays a byte
+        # budget. None → every object costs 1 unit (legacy behavior).
+        self.unit_bytes = unit_bytes
         if host is not None and disk is not None:
             host._spill = disk.put
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
+
+    def _object_weight(self, pages: tuple) -> int:
+        if not self.unit_bytes:
+            return 1
+        nbytes = sum(int(np.asarray(p).nbytes) for p in pages)
+        return max(1, -(-nbytes // self.unit_bytes))
 
     @property
     def enabled(self) -> bool:
@@ -310,6 +376,32 @@ class TierStack:
             misses += self.disk.misses
         total = hits + misses
         return hits / total if total else 0.0
+
+    def put_object(self, obj_hash: int, *pages: np.ndarray,
+                   protected: bool = False) -> None:
+        """Write one NON-KV paged object (e.g. a LoRA adapter's packed
+        factors, engine/lora.py) through the tier stack under a synthetic
+        hash. It lands in the same pools as KV blocks and competes under
+        the same second-chance credits — S-LoRA's unified paging: a burst
+        of one-off prompts and a burst of cold tenants press on ONE
+        budget — charging its byte-honest block-equivalent weight."""
+        w = self._object_weight(pages)
+        if self.host is not None:
+            self.host.put(obj_hash, *pages, protected=protected, weight=w)
+        elif self.disk is not None:
+            self.disk.put(obj_hash, *pages, protected=protected, weight=w)
+
+    def get_object(self, obj_hash: int) -> tuple[np.ndarray, ...] | None:
+        """Fetch one paged object, promoting a G3 hit back into G2 (same
+        policy as lookup_run). Hit/miss counts feed tier_hit_rate."""
+        pages = self.host.get(obj_hash) if self.host is not None else None
+        if pages is None and self.disk is not None:
+            pages = self.disk.get(obj_hash)
+            if pages is not None and self.host is not None:
+                self.host.put(
+                    obj_hash, *pages, weight=self._object_weight(pages)
+                )
+        return pages
 
     def peek_run_len(self, hashes: list[int]) -> int:
         """Length of the leading run resident in ANY tier — no page copies,

@@ -339,6 +339,21 @@ class EngineArgs:
     # None = byte tokenizer. Must match the serving tokenizer or masks
     # would legalize undecodable ids; the worker wires its own spec.
     grammar_tokenizer: dict | None = None
+    # Multi-LoRA multiplexing (engine/lora.py + block_manager/adapters.py):
+    # number of device-resident adapter SLOTS in the HBM adapter bank
+    # (0 = LoRA off, no bank allocated, every dispatch byte-identical to
+    # pre-LoRA builds). Many more adapters than slots may be registered —
+    # they page in on first request through the G2/G3 tier economy and
+    # page out cold under second-chance eviction pressure; slots pinned
+    # by running sequences are never victims. Each batch row carries an
+    # adapter_slot index (-1 = base) and the q/k/v/o projections add the
+    # low-rank delta via a batched gathered matmul, so mixed-adapter
+    # batches ride the normal prefill/decode/spec dispatches.
+    lora_slots: int = 0
+    # Static bank rank (max over registered adapters; smaller ranks
+    # zero-pad). One rank keeps the compiled dispatch lattice at 2x
+    # (with/without adapters) instead of per-rank variants.
+    lora_rank: int = 8
 
     def __post_init__(self):
         # Fail fast on a mistyped ladder spec: anything that is not a
@@ -359,6 +374,12 @@ class EngineArgs:
             raise ValueError(
                 f"spec_tree_depth must be >= 0 (0 = spec_tokens); got "
                 f"{self.spec_tree_depth}"
+            )
+        if self.lora_slots < 0:
+            raise ValueError(f"lora_slots must be >= 0; got {self.lora_slots}")
+        if self.lora_slots > 0 and self.lora_rank <= 0:
+            raise ValueError(
+                f"lora_rank must be positive when lora_slots > 0; got {self.lora_rank}"
             )
         if self.max_model_len % self.block_size:
             self.max_model_len = ((self.max_model_len // self.block_size) + 1) * self.block_size

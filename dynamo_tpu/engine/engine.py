@@ -37,9 +37,15 @@ from typing import Any, AsyncIterator
 
 import numpy as np
 
+from dynamo_tpu.block_manager.adapters import AdapterSlotPool
 from dynamo_tpu.block_manager.pool import BlockPool, NoFreeBlocksError
 from dynamo_tpu.engine import kv_transfer
 from dynamo_tpu.engine.config import EngineArgs
+from dynamo_tpu.engine.lora import (
+    LoraAdapterSpec,
+    adapter_tier_hash,
+    make_adapter_pages,
+)
 from dynamo_tpu.engine.drafter import (
     DraftConstraint,
     TreeDraft,
@@ -64,7 +70,11 @@ from dynamo_tpu.llm.protocols import (
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
-from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    adapter_hash_seed,
+    compute_block_hashes,
+)
 from dynamo_tpu.transfer.stream import KvChunk, KvStreamExport
 
 log = get_logger("engine")
@@ -140,6 +150,7 @@ class _Seq:
         "spec_ema", "spec_cool", "draft_state",
         "export_handle", "export_stream", "export_pub_blocks",
         "grammar", "grammar_state", "grammar_eos_bits",
+        "adapter_id", "adapter_slot", "hash_seed",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -196,6 +207,15 @@ class _Seq:
         self.grammar = None
         self.grammar_state = 0
         self.grammar_eos_bits: np.ndarray | None = None
+        # Multi-LoRA: the request's adapter identity (None = base), its
+        # resident bank slot while admitted (-1 = none/base; the pin is
+        # released at finish/preempt), and the adapter-salted hash seed
+        # that partitions KV identity — block hashes, tier keys, KV
+        # events and router stickiness all derive from it, so an
+        # adapter's KV can never prefix-hit another identity's.
+        self.adapter_id = getattr(req, "adapter_id", None)
+        self.adapter_slot = -1
+        self.hash_seed = adapter_hash_seed(self.adapter_id)
         # Disaggregation (engine side of llm/disagg.py):
         ktp = req.kv_transfer_params or {}
         self.export = bool(ktp.get("do_remote_decode"))  # prefill-only + export KV
@@ -390,6 +410,24 @@ def register_engine_metrics(registry):
             "budget was reallocated away from the uniform per-row split "
             "(EMA-hot rows drafting past spec_tokens)",
         ),
+        registry.gauge(
+            "engine_lora_resident_adapters",
+            "LoRA adapters currently resident in the device (G1) bank "
+            "slots (engine/lora.py; 0 when lora_slots is 0)",
+        ),
+        registry.counter(
+            "engine_lora_swap_total",
+            "LoRA adapter page-ins: uploads of adapter factor pages into "
+            "a device bank slot (cold fetch through the G2/G3 tier "
+            "economy; when slots are full each one evicts a colder "
+            "resident)",
+        ),
+        registry.gauge(
+            "engine_lora_gather_seconds",
+            "Cumulative host seconds spent on LoRA multiplexing — "
+            "resolving adapter slots at admission, uploading factor "
+            "pages, and building per-dispatch adapter_slot operands",
+        ),
     )
 
 
@@ -404,9 +442,12 @@ class TpuEngine:
     # (documented idle-engine toggles, read once per scheduler
     # iteration), the total_* counters incl. total_grammar_mask_s
     # (monotonic values read racily by bench/metrics — stale reads are
-    # harmless), _stopping (always mutex-guarded), pool/tiers
-    # (internally consistent; cross-thread readers get point-in-time
-    # values), and _grammar_compiler (built under _grammar_lock from
+    # harmless, total_lora_s included), _stopping (always mutex-guarded),
+    # pool/tiers/_lora_pool (internally consistent; acquire/release on
+    # the scheduler thread, cross-thread readers get point-in-time
+    # values), _lora_registry (always _lora_lock-guarded; registration
+    # runs from setup/async contexts), and _grammar_compiler (built
+    # under _grammar_lock from
     # generate() coroutines; the compiled FSMs it hands out are
     # internally locked, so scheduler-thread mask lookups race async
     # compiles safely).
@@ -527,6 +568,19 @@ class TpuEngine:
         self.total_grammar_mask_s = 0.0
         self.total_spec_budget_reallocs = 0
         self.total_grammar_seqs = 0
+        # Multi-LoRA multiplexing (engine/lora.py): the G1 slot pool
+        # (block_manager/adapters.py; acquire/release on the scheduler
+        # thread, stats read racily — same contract as pool/tiers, so
+        # deliberately NOT scheduler-owned) and the adapter registry
+        # (adapter_id → LoraAdapterSpec; registered from setup/async
+        # contexts under _lora_lock, read at admission). total_lora_s is
+        # the engine_lora_gather_seconds feed (racy-total contract).
+        self._lora_pool = (
+            AdapterSlotPool(args.lora_slots) if args.lora_slots > 0 else None
+        )
+        self._lora_registry: dict[str, tuple[LoraAdapterSpec, tuple | None]] = {}
+        self._lora_lock = threading.Lock()
+        self.total_lora_s = 0.0
         # Tokens-per-weight-pass accounting: every (row, substep) of a
         # drained window or single step is one per-sequence weight pass
         # yielding one token; a spec row-pass is one weight pass yielding
@@ -554,8 +608,9 @@ class TpuEngine:
         # get the delta once per step).
         self._gauges = None
         # (proposed, accepted, tree passes, protected tier evictions,
-        # budget reallocs) already inc'd into the registry counters.
-        self._ctr_pushed = [0, 0, 0, 0, 0]
+        # budget reallocs, lora page-ins) already inc'd into the
+        # registry counters.
+        self._ctr_pushed = [0, 0, 0, 0, 0, 0]
 
     def bind_metrics(self, registry) -> None:
         """Attach the engine gauges to a MetricsRegistry; updated once
@@ -567,7 +622,8 @@ class TpuEngine:
             return
         (g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp,
          g_kvb, g_kvq, c_tree, g_tree_depth, c_tier_prot, g_tier_hit,
-         g_gram_seqs, g_gram_mask, c_budget) = self._gauges
+         g_gram_seqs, g_gram_mask, c_budget,
+         g_lora_res, c_lora_swap, g_lora_s) = self._gauges
         g_kvb.set(self.args.kv_bytes_per_block() * self.args.num_kv_blocks)
         g_kvq.set(1 if self.args.kv_quant == "int8" else 0)
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
@@ -597,6 +653,12 @@ class TpuEngine:
         if self.total_spec_budget_reallocs > self._ctr_pushed[4]:
             c_budget.inc(self.total_spec_budget_reallocs - self._ctr_pushed[4])
             self._ctr_pushed[4] = self.total_spec_budget_reallocs
+        if self._lora_pool is not None:
+            g_lora_res.set(self._lora_pool.resident)
+            if self._lora_pool.pageins > self._ctr_pushed[5]:
+                c_lora_swap.inc(self._lora_pool.pageins - self._ctr_pushed[5])
+                self._ctr_pushed[5] = self._lora_pool.pageins
+        g_lora_s.set(self.total_lora_s)
 
     def _phase(self, key: str, t0: float) -> float:
         """Accumulate perf_counter()-t0 into phase `key`; → new t0."""
@@ -615,7 +677,11 @@ class TpuEngine:
             if args.disk_kv_dir
             else None
         )
-        return TierStack(host, disk)
+        # unit_bytes makes NON-KV paged objects (LoRA adapters) charge
+        # the blocks-denominated capacity by their byte size — a 34 MB
+        # 8B-geometry adapter costs ~50 block units, not 1, so the
+        # host/disk byte budget the capacity was sized for holds.
+        return TierStack(host, disk, unit_bytes=args.kv_bytes_per_block())
 
     # -- lifecycle --------------------------------------------------------
 
@@ -695,6 +761,148 @@ class TpuEngine:
                 masks[i] = s.grammar.mask(s.grammar_state, s.grammar_eos_bits)
         self.total_grammar_mask_s += time.perf_counter() - t0
         return masks
+
+    # -- multi-LoRA adapter multiplexing ----------------------------------
+    #
+    # Serving shape (Punica BGMV + S-LoRA unified paging, engine/lora.py):
+    # MANY per-tenant low-rank fine-tunes of the one base model share this
+    # engine. The device bank holds args.lora_slots resident adapters;
+    # the registry may hold far more — a cold adapter pages in at
+    # admission (blocking only that request's admission, never the
+    # running batch: in-flight windows keep executing and the upload is
+    # device-ordered after them), its factor pages living in the SAME
+    # G2/G3 tier pools as KV blocks under adapter_tier_hash keys, and a
+    # cold resident pages out under the slot pool's second-chance
+    # pressure. Batch rows carry adapter_slot (-1 = base) into every
+    # prefill/decode/spec dispatch; base-only batches pass None and run
+    # the exact pre-LoRA jit variant.
+
+    def register_adapter(
+        self,
+        name: str,
+        rank: int | None = None,
+        seed: int = 0,
+        scaling: float = 1.0,
+        targets: str = "qkvo",
+        pages: tuple | None = None,
+    ) -> None:
+        """Register one serveable adapter. ``pages`` = pre-materialized
+        factor pages (checkpoint loaders); None = deterministic random
+        factors from (name, seed) — the bench/test source. Write-through:
+        pages land in the tier economy now, so later slot eviction is
+        free and a cold re-page-in is a tier read, not a reload.
+        Thread-safe; callable while serving (new tenants onboard live)."""
+        if self._lora_pool is None:
+            raise RequestValidationError(
+                "engine has no adapter bank (lora_slots=0)"
+            )
+        spec = LoraAdapterSpec(
+            name=name, rank=rank if rank is not None else self.args.lora_rank,
+            seed=seed, scaling=scaling, targets=targets,
+        )
+        if spec.rank > self.args.lora_rank:
+            raise RequestValidationError(
+                f"adapter {name!r} rank {spec.rank} exceeds lora_rank="
+                f"{self.args.lora_rank}"
+            )
+        if self.tiers.enabled:
+            tier_pages = (
+                pages if pages is not None
+                else make_adapter_pages(self.cfg, spec, self.args.lora_rank)
+            )
+            self.tiers.put_object(adapter_tier_hash(name), *tier_pages)
+        # Caller-provided pages (real checkpoints) are NOT rematerializable
+        # from the spec, so they stay pinned in the registry even with
+        # tiers enabled — the tiers are a cache (adapter objects compete
+        # with KV blocks and CAN be evicted end to end), never the only
+        # copy. Seed-generated adapters pin nothing (a tier miss
+        # regenerates bit-identically).
+        with self._lora_lock:
+            self._lora_registry[name] = (spec, pages)
+
+    def adapters(self) -> list[str]:
+        """Registered adapter names (thread-safe)."""
+        with self._lora_lock:
+            return sorted(self._lora_registry)
+
+    def lora_stats(self) -> dict:
+        """Slot-pool residency/swap counters (racy snapshot)."""
+        if self._lora_pool is None:
+            return {}
+        return self._lora_pool.stats()
+
+    def _adapter_pages(self, spec: LoraAdapterSpec,
+                       pinned: tuple | None) -> tuple:
+        """Fetch one adapter's factor pages: tier hit (G2, promoting a G3
+        hit — the unified-paging path), registry-pinned pages (real
+        checkpoints — always retained), or rematerialize from the spec's
+        seed source and write back through. Tier hit/miss counts feed
+        tier_hit_rate, so adapter churn shows in the same signal KV
+        churn does."""
+        h = adapter_tier_hash(spec.name)
+        if self.tiers.enabled:
+            pages = self.tiers.get_object(h)
+            if pages is not None:
+                return pages
+        if pinned is not None:
+            if self.tiers.enabled:  # re-warm the cache for the next miss
+                self.tiers.put_object(h, *pinned)
+            return pinned
+        pages = make_adapter_pages(self.cfg, spec, self.args.lora_rank)
+        if self.tiers.enabled:
+            self.tiers.put_object(h, *pages)
+        return pages
+
+    def _acquire_adapter(self, seq: _Seq) -> None:
+        """Resolve seq.adapter_id → pinned bank slot, uploading on a cold
+        miss. Raises RequestValidationError (unknown adapter) or
+        NoFreeAdapterSlotsError (every slot pinned — admission requeues
+        and retries when running sequences release pins)."""
+        if self._lora_pool is None:
+            raise RequestValidationError(
+                f"request names adapter {seq.adapter_id!r} but this engine "
+                "has no adapter bank (lora_slots=0)"
+            )
+        with self._lora_lock:
+            entry = self._lora_registry.get(seq.adapter_id)
+        if entry is None:
+            raise RequestValidationError(f"unknown adapter {seq.adapter_id!r}")
+        spec, pinned = entry
+        t0 = time.perf_counter()
+        slot, needs_upload, _evicted = self._lora_pool.acquire(seq.adapter_id)
+        if needs_upload:
+            try:
+                self._runner.upload_adapter(
+                    slot, self._adapter_pages(spec, pinned)
+                )
+            except BaseException:
+                # The upload never landed: DROP the residency entry (not
+                # just the pin) or the next acquire would skip the upload
+                # and decode against a zero/partial bank slot.
+                self._lora_pool.drop(seq.adapter_id)
+                raise
+        seq.adapter_slot = slot
+        self.total_lora_s += time.perf_counter() - t0
+
+    def _release_adapter(self, seq: _Seq) -> None:
+        if seq.adapter_slot >= 0 and self._lora_pool is not None:
+            self._lora_pool.release(seq.adapter_id)
+        seq.adapter_slot = -1
+
+    def _adapter_row_slots(self, seqs: list[_Seq], B: int) -> np.ndarray | None:
+        """Per-row adapter_slot operand for one dispatch → [B] int32, or
+        None when no row carries an adapter (the unadapted jit variant —
+        base-only traffic pays nothing, byte-identical to a lora-disabled
+        engine). Base rows in a mixed batch ride -1 (where-masked in
+        model._lora_apply, bit-identical)."""
+        if not any(s.adapter_slot >= 0 for s in seqs):
+            return None
+        t0 = time.perf_counter()
+        slots = np.full((B,), -1, np.int32)
+        for i, s in enumerate(seqs):
+            slots[i] = s.adapter_slot
+        self.total_lora_s += time.perf_counter() - t0
+        return slots
 
     # -- async API --------------------------------------------------------
 
@@ -1127,35 +1335,44 @@ class TpuEngine:
                 chain_anc = np.tril(np.ones((S1, S1), np.int8))
                 chain_depth = np.arange(S1, dtype=np.int32)
                 W32 = mask_words(self.cfg.vocab_size)
+                # Adapter-slot operand is one more shape-only variant
+                # axis (mixed-adapter batches dispatch with it; base
+                # batches without).
+                lora_opts = [False, True] if args.lora_slots > 0 else [False]
                 for mode in modes:
                     for top_n in top_ns:
                         for B in args.decode_buckets:
                             for W in args.table_buckets:
                                 for with_tree, with_mask in shapes:
-                                    tree = masks = None
-                                    if with_tree:
-                                        tree = (
-                                            np.broadcast_to(chain_parents, (B, S1)).copy(),
-                                            np.broadcast_to(chain_anc, (B, S1, S1)).copy(),
-                                            np.broadcast_to(chain_depth, (B, S1)).copy(),
+                                    for with_lora in lora_opts:
+                                        tree = masks = None
+                                        if with_tree:
+                                            tree = (
+                                                np.broadcast_to(chain_parents, (B, S1)).copy(),
+                                                np.broadcast_to(chain_anc, (B, S1, S1)).copy(),
+                                                np.broadcast_to(chain_depth, (B, S1)).copy(),
+                                            )
+                                        if with_mask:
+                                            masks = np.full(
+                                                (B, S1, W32), 0xFFFFFFFF, np.uint32
+                                            )
+                                        aslots = (
+                                            np.zeros((B,), np.int32)
+                                            if with_lora else None
                                         )
-                                    if with_mask:
-                                        masks = np.full(
-                                            (B, S1, W32), 0xFFFFFFFF, np.uint32
+                                        self._runner.spec_verify(
+                                            S1, mode,
+                                            np.zeros((B, S1), np.int32),
+                                            np.zeros((B,), np.int32),
+                                            np.full((B,), S1 - 1, np.int32),
+                                            np.zeros((B, W), np.int32),
+                                            np.zeros((B,), bool),
+                                            np.ones((B,), np.float32),
+                                            np.zeros((B,), np.uint32),
+                                            np.zeros((B,), np.int32),
+                                            None, top_n, tree, masks, aslots,
                                         )
-                                    self._runner.spec_verify(
-                                        S1, mode,
-                                        np.zeros((B, S1), np.int32),
-                                        np.zeros((B,), np.int32),
-                                        np.full((B,), S1 - 1, np.int32),
-                                        np.zeros((B, W), np.int32),
-                                        np.zeros((B,), bool),
-                                        np.ones((B,), np.float32),
-                                        np.zeros((B,), np.uint32),
-                                        np.zeros((B,), np.int32),
-                                        None, top_n, tree, masks,
-                                    )
-                                    count += 1
+                                        count += 1
             return count
 
         return await self.run_on_engine_thread(_warm)
@@ -1239,12 +1456,34 @@ class TpuEngine:
         # Flush queued offloads BEFORE allocating: allocation may evict and
         # recycle exactly the pages still waiting to be copied out.
         self._flush_offloads()
+        # Adapter residency first (before any block allocation, so a
+        # failure here has nothing to unwind): resolve adapter_id → a
+        # pinned bank slot, paging the adapter in on a cold miss. Only
+        # THIS request's admission blocks on the fetch — decode windows
+        # already in flight keep executing, and the upload is device-
+        # stream-ordered after them.
+        acquired = False
+        if seq.adapter_id is not None and seq.adapter_slot < 0:
+            self._acquire_adapter(seq)
+            acquired = True
+        try:
+            return self._admit_alloc_blocks(seq)
+        except BaseException:
+            if acquired:
+                self._release_adapter(seq)
+            raise
+
+    def _admit_alloc_blocks(self, seq: _Seq) -> int:
         bs = self.args.block_size
         prompt = seq.tokens
         plen = len(prompt)
         if plen > self.args.max_model_len - 1:
             raise RequestValidationError("prompt exceeds max_model_len")
-        hashes = compute_block_hashes(prompt, bs)
+        # KV identity is (tokens, adapter): the hash seed is salted by
+        # the adapter id (tokens.adapter_hash_seed), so adapter KV never
+        # prefix-hits base/other-adapter blocks — in the G1 radix tree,
+        # the G2/G3 tiers, KV events, and peer fetches alike.
+        hashes = compute_block_hashes(prompt, bs, seq.hash_seed)
         # Never reuse the *entire* prompt: at least one suffix token must be
         # computed to produce logits (vLLM rule).
         max_hit = (plen - 1) // bs
@@ -1253,7 +1492,7 @@ class TpuEngine:
         block_ids, n_hit = self.pool.allocate_sequence(hashes_matchable, total_blocks)
         seq.block_ids = block_ids
         seq.prefix_hit_blocks = n_hit
-        seq.block_seq = TokenBlockSequence(prompt, bs)
+        seq.block_seq = TokenBlockSequence(prompt, bs, seq.hash_seed)
         start = n_hit * bs
 
         # G2/G3 onboard: blocks evicted from HBM but still host-resident
@@ -1371,7 +1610,8 @@ class TpuEngine:
             tables[r, : len(seq.block_ids)] = seq.block_ids
             starts[r] = start
             tlens[r] = len(seq.tokens)
-        ref = self._runner.prefill_batch(toks, tables, starts, tlens)
+        aslots = self._adapter_row_slots([s for s, _ in members], Bp)
+        ref = self._runner.prefill_batch(toks, tables, starts, tlens, aslots)
         self.total_prefill_padded += Bp * t_pad
         for seq, start in members:
             self._finish_prefill_bookkeeping(seq, start)
@@ -1404,7 +1644,8 @@ class TpuEngine:
             toks = np.zeros((t_pad,), np.int32)
             toks[: len(chunk)] = chunk
             logits = self._runner.prefill_chunk(
-                toks, table, pos, min(pos + len(chunk), plen)
+                toks, table, pos, min(pos + len(chunk), plen),
+                seq.adapter_slot if seq.adapter_slot >= 0 else None,
             )
             self.total_prefill_padded += t_pad
             pos += len(chunk)
@@ -1553,13 +1794,17 @@ class TpuEngine:
             ))
         self._export_fetches = keep
 
-    def prefix_hit_length(self, token_ids: list[int]) -> int:
+    def prefix_hit_length(self, token_ids: list[int],
+                          adapter_id: str | None = None) -> int:
         """Tokens of this prompt already resident in the local prefix
-        cache (whole blocks). Used by the disagg decision: a locally-cached
+        cache (whole blocks), probed in the request's (model, adapter)
+        identity domain. Used by the disagg decision: a locally-cached
         prompt should not prefill remotely. Thread-safe."""
         bs = self.args.block_size
         max_hit = (len(token_ids) - 1) // bs
-        hashes = compute_block_hashes(token_ids, bs)[:max_hit]
+        hashes = compute_block_hashes(
+            token_ids, bs, adapter_hash_seed(adapter_id)
+        )[:max_hit]
         return len(self.pool.match_prefix(hashes)) * bs
 
     def take_export(self, handle: str):
@@ -1657,6 +1902,11 @@ class TpuEngine:
         if seq.slot is not None:
             self._free_slots.append(seq.slot)
             seq.slot = None
+        # Unpin the adapter: re-admission re-acquires (a still-resident
+        # adapter is a free hit; an evicted one pages back in). The
+        # serial device stream orders any later slot upload after this
+        # sequence's already-dispatched work.
+        self._release_adapter(seq)
         # Purge queued offloads of the freed blocks: they become evictable
         # now and could be recycled before the next flush.
         freed = set(seq.block_ids)
@@ -1916,11 +2166,12 @@ class TpuEngine:
             self.args.top_logprobs_max
             if any(s.sampling.top_logprobs for s in batch) else 0
         )
+        aslots = self._adapter_row_slots(batch, B)
         t0 = time.perf_counter()
         ref = self._runner.multi_decode(
             K, mode, tokens, wchain, positions, tables, active,
             temps, seeds, steps0, tks, tps, freqs, press, pen, fold_slots,
-            top_n,
+            top_n, aslots,
         )
         w = _Window(batch, pos0, K, ref, top_n)
         start_host_fetch(w.fetch_arrays())
@@ -2171,6 +2422,7 @@ class TpuEngine:
         ref = self._runner.spec_verify(
             S1, mode, tokens, pos0_arr, dlen, tables, active,
             temps, seeds, steps0, fold_slots, top_n, tree, masks,
+            self._adapter_row_slots(batch, B),
         )
         item = _Spec(
             batch, pos0, draft_lens, ref, top_n,
@@ -2352,7 +2604,10 @@ class TpuEngine:
             positions[i] = seq.next_write_pos
             tables[i, : len(seq.block_ids)] = seq.block_ids
             active[i] = True
-        ref = self._runner.decode_step(tokens, positions, tables, active)
+        ref = self._runner.decode_step(
+            tokens, positions, tables, active,
+            self._adapter_row_slots(batch, B),
+        )
         self.total_decode_steps += 1
         self.total_row_passes += len(batch)
         self.total_row_tokens += len(batch)
@@ -2515,6 +2770,7 @@ class TpuEngine:
         if seq.slot is not None:
             self._free_slots.append(seq.slot)
             seq.slot = None
+        self._release_adapter(seq)
         # Purge queued offloads of blocks about to become evictable (same
         # as _preempt): once freed they can be recycled by any allocation
         # before the next flush, and a late extract would snapshot the NEW

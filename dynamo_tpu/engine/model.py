@@ -187,6 +187,57 @@ def _qkv(h: jax.Array, lp: dict, cfg: ModelConfig):
     return q, k, v
 
 
+def _lora_apply(y: jax.Array, h: jax.Array, A: jax.Array, B_: jax.Array,
+                slots: jax.Array) -> jax.Array:
+    """Batched gathered LoRA matmul (Punica's BGMV shape): per batch row
+    b, ``y[b] += (h[b] @ A[slots[b]]) @ B[slots[b]]``. ``A`` [S, in, r]
+    and ``B_`` [S, r, out] are one layer's slice of the device adapter
+    bank; ``slots`` [B] int32 names each row's resident adapter slot,
+    -1 = base. The whole mixed batch rides two skinny einsums — no
+    per-adapter sub-batching, which is what keeps multi-tenant batches
+    at ~base throughput.
+
+    Base rows take a ``where`` on the ORIGINAL projection values, never
+    an add-of-zero (bf16 ``-0.0 + 0.0`` would flip the sign bit), so a
+    base row in an adapter-mixed batch is bit-identical to the same row
+    on a no-LoRA engine — the byte-identity contract the golden suite
+    pins. Per-adapter alpha/rank scaling is folded into B at upload
+    (engine/lora.py), so no scalar operand rides here."""
+    idx = jnp.maximum(slots, 0)
+    Ag = jnp.take(A, idx, axis=0)   # [B, in, r]
+    Bg = jnp.take(B_, idx, axis=0)  # [B, r, out]
+    if h.ndim == 2:                  # decode: h [B, in]
+        t = jnp.einsum("bd,bdr->br", h, Ag)
+        delta = jnp.einsum("br,bro->bo", t, Bg)
+        mask = (slots >= 0)[:, None]
+    else:                            # prefill / spec-verify: h [B, T, in]
+        t = jnp.einsum("btd,bdr->btr", h, Ag)
+        delta = jnp.einsum("btr,bro->bto", t, Bg)
+        mask = (slots >= 0)[:, None, None]
+    return jnp.where(mask, y + delta.astype(y.dtype), y)
+
+
+def _qkv_lora(h: jax.Array, lp: dict, cfg: ModelConfig,
+              ll: dict | None, slots: jax.Array | None):
+    """_qkv plus the per-row adapter deltas when an adapter bank layer
+    slice ``ll`` rides the dispatch (None = the exact base path)."""
+    q, k, v = _qkv(h, lp, cfg)
+    if ll is not None:
+        q = _lora_apply(q, h, ll["qa"], ll["qb"], slots)
+        k = _lora_apply(k, h, ll["ka"], ll["kb"], slots)
+        v = _lora_apply(v, h, ll["va"], ll["vb"], slots)
+    return q, k, v
+
+
+def _wo_lora(o: jax.Array, lp: dict, ll: dict | None,
+             slots: jax.Array | None) -> jax.Array:
+    """o-projection with the optional per-row adapter delta."""
+    y = _dot_q(o, lp, "wo")
+    if ll is not None:
+        y = _lora_apply(y, o, ll["oa"], ll["ob"], slots)
+    return y
+
+
 def _mlp(x, lp):
     g = _dot_q(x, lp, "w_gate")
     u = _dot_q(x, lp, "w_up")
@@ -253,6 +304,8 @@ def prefill_batch_impl(
     block_tables: jax.Array,  # [Bp, W] int32 — blocks for each FULL sequence
     start_pos: jax.Array,     # [Bp] int32 — first suffix position (block-aligned)
     true_len: jax.Array,      # [Bp] int32 — true total length (0 = inactive row)
+    lora: dict | None = None,         # adapter bank {qa..ob: [L, S, ...]}
+    adapter_slots: jax.Array | None = None,  # [Bp] int32, -1 = base row
 ) -> tuple[jax.Array, KVCache]:
     """Packed prefill: run Bp sequences' suffixes through the model in ONE
     dispatch, each attending to its own cached prefix pages. Returns
@@ -307,9 +360,12 @@ def prefill_batch_impl(
 
     def layer(carry, xs):
         x, k_cache, v_cache, k_scale, v_scale = carry
-        lp, layer_idx = xs
+        if lora is not None:
+            lp, ll, layer_idx = xs
+        else:
+            (lp, layer_idx), ll = xs, None
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, lp, cfg)
+        q, k, v = _qkv_lora(h, lp, cfg, ll, adapter_slots)
         q = q.reshape(Bp, T, cfg.num_heads, hd)
         k = k.reshape(Bp, T, KVH, hd)
         v = v.reshape(Bp, T, KVH, hd)
@@ -369,16 +425,19 @@ def prefill_batch_impl(
             + jnp.einsum("btkgs,bskh->btkgh", p_s, v)
         )
         o = o.reshape(Bp, T, cfg.q_size)
-        x = x + _dot_q(o, lp, "wo")
+        x = x + _wo_lora(o, lp, ll, adapter_slots)
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(h, lp, cfg)
         return (x, k_cache, v_cache, k_scale, v_scale), None
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    xs_in = (
+        (params["layers"], lora, layer_ids) if lora is not None
+        else (params["layers"], layer_ids)
+    )
     (x, k_cache, v_cache, k_scale, v_scale), _ = lax.scan(
-        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
-        (params["layers"], layer_ids),
+        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale), xs_in,
     )
 
     last = jnp.clip(true_len - start_pos - 1, 0, T - 1)      # [Bp]
@@ -395,6 +454,8 @@ def prefill_impl(
     block_table: jax.Array,  # [W] int32 — blocks for the FULL sequence
     start_pos: jax.Array,    # scalar int32 — first suffix position (block-aligned)
     true_len: jax.Array,     # scalar int32 — true total length (prefix + suffix)
+    lora: dict | None = None,
+    adapter_slot: jax.Array | None = None,  # scalar int32, -1 = base
 ) -> tuple[jax.Array, KVCache]:
     """Single-sequence prefill: the Bp=1 case of ``prefill_batch_impl``
     (kept as the chunked-prefill / compatibility entry point)."""
@@ -403,6 +464,9 @@ def prefill_impl(
         tokens[None, :], block_table[None, :],
         jnp.asarray(start_pos, jnp.int32).reshape(1),
         jnp.asarray(true_len, jnp.int32).reshape(1),
+        lora,
+        None if adapter_slot is None
+        else jnp.asarray(adapter_slot, jnp.int32).reshape(1),
     )
     return logits[0], cache
 
@@ -420,6 +484,8 @@ def decode_step_impl(
     positions: jax.Array,     # [B] int32 — position of that token (seq_len-1)
     block_tables: jax.Array,  # [B, W] int32
     active: jax.Array,        # [B] bool — padding rows are False
+    lora: dict | None = None,         # adapter bank {qa..ob: [L, S, ...]}
+    adapter_slots: jax.Array | None = None,  # [B] int32, -1 = base row
     *,
     attn_impl: str = "auto",  # static: "auto" | "xla" | "pallas" | "pallas_interpret"
 ) -> tuple[jax.Array, KVCache]:
@@ -453,9 +519,12 @@ def decode_step_impl(
 
     def layer(carry, xs):
         x, k_cache, v_cache, k_scale, v_scale = carry
-        lp, layer_idx = xs
+        if lora is not None:
+            lp, ll, layer_idx = xs
+        else:
+            (lp, layer_idx), ll = xs, None
         h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, lp, cfg)
+        q, k, v = _qkv_lora(h, lp, cfg, ll, adapter_slots)
         q = q.reshape(B, cfg.num_heads, cfg.head_dim)
         k = k.reshape(B, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(B, cfg.num_kv_heads, cfg.head_dim)
@@ -490,16 +559,19 @@ def decode_step_impl(
                 interpret=(impl == "pallas_interpret"),
             )
         o = o.reshape(B, cfg.q_size)
-        x = x + _dot_q(o, lp, "wo")
+        x = x + _wo_lora(o, lp, ll, adapter_slots)
 
         h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(h, lp, cfg)
         return (x, k_cache, v_cache, k_scale, v_scale), None
 
     layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    xs_in = (
+        (params["layers"], lora, layer_ids) if lora is not None
+        else (params["layers"], layer_ids)
+    )
     (x, k_cache, v_cache, k_scale, v_scale), _ = lax.scan(
-        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
-        (params["layers"], layer_ids),
+        layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale), xs_in,
     )
 
     logits = _logits(cfg, params, x)  # [B, V]
@@ -534,6 +606,8 @@ def multi_decode_impl(
                                           # reads the newest on-device token for
                                           # its slot even with several windows
                                           # in flight (pipeline_depth > 1).
+    lora: dict | None = None,             # adapter bank {qa..ob: [L, S, ...]}
+    adapter_slots: jax.Array | None = None,  # [B] int32, -1 = base row
     *,
     attn_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, KVCache]:
@@ -594,7 +668,8 @@ def multi_decode_impl(
     def substep(carry, i):
         cache, tok, pos, counts = carry
         logits, cache = decode_step_impl(
-            cfg, params, cache, tok, pos, block_tables, active, attn_impl=attn_impl
+            cfg, params, cache, tok, pos, block_tables, active,
+            lora, adapter_slots, attn_impl=attn_impl,
         )
         if mode == "greedy":
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -641,6 +716,8 @@ def spec_verify_impl(
     tree_anc: jax.Array | None = None,      # [B, S1, S1] int8 ancestor-or-self
     tree_depth: jax.Array | None = None,    # [B, S1] int32 per-node depth
     mask_bits: jax.Array | None = None,     # [B, S1, W32] uint32 per-node grammar masks
+    lora: dict | None = None,               # adapter bank {qa..ob: [L, S, ...]}
+    adapter_slots: jax.Array | None = None,  # [B] int32, -1 = base row
     *,
     fused: bool = True,       # static — single-pass forward vs stepwise scan
     attn_impl: str = "auto",  # attention backend: stepwise decode steps AND
@@ -753,9 +830,12 @@ def spec_verify_impl(
 
         def layer(carry, xs):
             x, k_cache, v_cache, k_scale, v_scale = carry
-            lp, layer_idx = xs
+            if lora is not None:
+                lp, ll, layer_idx = xs
+            else:
+                (lp, layer_idx), ll = xs, None
             h = _rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = _qkv(h, lp, cfg)
+            q, k, v = _qkv_lora(h, lp, cfg, ll, adapter_slots)
             q = q.reshape(B, T, cfg.num_heads, hd)
             k = k.reshape(B, T, KVH, hd)
             v = v.reshape(B, T, KVH, hd)
@@ -802,16 +882,19 @@ def spec_verify_impl(
                     k_scale, v_scale, anc=anc,
                 )
             o = o.reshape(B, T, cfg.q_size)
-            x = x + _dot_q(o, lp, "wo")
+            x = x + _wo_lora(o, lp, ll, adapter_slots)
 
             h = _rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             x = x + _ffn(h, lp, cfg)
             return (x, k_cache, v_cache, k_scale, v_scale), None
 
         layer_ids = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+        xs_in = (
+            (params["layers"], lora, layer_ids) if lora is not None
+            else (params["layers"], layer_ids)
+        )
         (x, k_cache, v_cache, k_scale, v_scale), _ = lax.scan(
-            layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
-            (params["layers"], layer_ids),
+            layer, (x, cache.k, cache.v, cache.k_scale, cache.v_scale), xs_in,
         )
         logits = _logits(cfg, params, x)  # [B, T, V] fp32
         cache = KVCache(k_cache, v_cache, k_scale, v_scale)
@@ -820,7 +903,7 @@ def spec_verify_impl(
             tok_j, pos_j, use_j = xs
             lg, c = decode_step_impl(
                 cfg, params, c, tok_j, pos_j, block_tables, use_j,
-                attn_impl=attn_impl,
+                lora, adapter_slots, attn_impl=attn_impl,
             )
             return c, lg
 

@@ -24,6 +24,7 @@ as a dead NCCL rank in the reference).
 
 from __future__ import annotations
 
+import functools
 import socket
 import struct
 from collections import OrderedDict
@@ -56,6 +57,16 @@ def _fold_tokens(last_toks, toks, slots):
     tiny compiled variant per batch bucket). ``slots`` names each row's
     stable sequence slot; padding rows point at the dummy tail slot."""
     return last_toks.at[slots].set(toks)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _bank_write(bank_arr, update, slot):
+    """Write one adapter's factor array into bank slot ``slot`` (traced
+    scalar — ONE compile per array shape, not per slot; the bank is
+    donated so the update is in-place)."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        bank_arr, update[:, None], slot, axis=1
+    )
 
 
 class StepRef:
@@ -122,6 +133,12 @@ class LocalRunner:
         # dispatching while first tokens are still in flight). The extra
         # tail slot is the scatter sink for padding rows.
         self._last_toks: jax.Array | None = None
+        # Multi-LoRA adapter bank (engine/lora.py): per-target A/B factor
+        # stacks [L, lora_slots, ...] in HBM. Dispatches whose batch has
+        # at least one adapter row pass (bank, adapter_slots) into the
+        # jitted impls; base-only batches pass None and trace the exact
+        # pre-LoRA variant. None when lora_slots == 0.
+        self.lora_bank: dict[str, jax.Array] | None = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -183,6 +200,20 @@ class LocalRunner:
             "xla" if self.sharding is not None
             else resolve_attn_impl(self.args.attn_impl)
         )
+        if self.args.lora_slots > 0:
+            from dynamo_tpu.engine.lora import bank_shapes
+
+            dt = jnp.dtype(self.args.dtype)
+            # Replicated under tp (GSPMD reshards the skinny deltas);
+            # zero-initialized — a slot is garbage until its first
+            # upload, and the engine never dispatches a row pointing at
+            # an unuploaded slot.
+            self.lora_bank = {
+                k: jnp.zeros(shape, dt)
+                for k, shape in bank_shapes(
+                    self.cfg, self.args.lora_slots, self.args.lora_rank
+                ).items()
+            }
 
     def stop(self) -> None:
         self._refs.clear()
@@ -204,19 +235,51 @@ class LocalRunner:
 
     # -- dispatches -------------------------------------------------------
 
-    def prefill_batch(self, toks, tables, starts, tlens, *, rid=None) -> StepRef:
+    def _lora_operands(self, adapter_slots):
+        """(bank, slots-array) for a dispatch, or (None, None) for the
+        exact base-variant trace."""
+        if adapter_slots is None:
+            return None, None
+        if self.lora_bank is None:
+            raise ValueError("adapter_slots passed but lora_slots == 0")
+        return self.lora_bank, jnp.asarray(adapter_slots, jnp.int32)
+
+    def upload_adapter(self, slot: int, pages) -> None:
+        """Scatter one adapter's packed factor pages (engine/lora.py
+        LORA_PAGE_KEYS order) into bank slot ``slot``. Device-stream
+        ordering makes this safe while windows are in flight: the upload
+        is dispatched AFTER them, so already-issued work reads the old
+        occupant."""
+        from dynamo_tpu.engine.lora import LORA_PAGE_KEYS
+
+        assert self.lora_bank is not None, "lora_slots == 0"
+        for key, arr in zip(LORA_PAGE_KEYS, pages):
+            bank = self.lora_bank[key]
+            self.lora_bank[key] = _bank_write(
+                bank, jnp.asarray(arr, bank.dtype), jnp.int32(slot)
+            )
+
+    def prefill_batch(self, toks, tables, starts, tlens, adapter_slots=None,
+                      *, rid=None) -> StepRef:
+        bank, slots = self._lora_operands(adapter_slots)
         logits, self.cache = M.prefill_batch(
             self.cfg, self.params, self.cache,
             jnp.asarray(toks), jnp.asarray(tables),
             jnp.asarray(starts), jnp.asarray(tlens),
+            bank, slots,
         )
         return self._new_ref((logits,), rid)
 
-    def prefill_chunk(self, toks, table, pos, tlen, *, rid=None) -> StepRef:
+    def prefill_chunk(self, toks, table, pos, tlen, adapter_slot=None,
+                      *, rid=None) -> StepRef:
+        bank = slot = None
+        if adapter_slot is not None and adapter_slot >= 0:
+            bank, slot = self.lora_bank, jnp.int32(adapter_slot)
         logits, self.cache = M.prefill(
             self.cfg, self.params, self.cache,
             jnp.asarray(toks), jnp.asarray(table),
             jnp.int32(pos), jnp.int32(tlen),
+            bank, slot,
         )
         return self._new_ref((logits,), rid)
 
@@ -226,7 +289,8 @@ class LocalRunner:
 
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
-                     fold_slots=None, top_n=0, *, rid=None) -> StepRef:
+                     fold_slots=None, top_n=0, adapter_slots=None,
+                     *, rid=None) -> StepRef:
         """chain: None | (dst rows, src slots) — rows of this window whose
         input token is the latest on-device sample for that sequence SLOT
         (previous window fold or admission first-token fold; no host
@@ -234,7 +298,9 @@ class LocalRunner:
         as a [B] mask + slot map inside the jit. ``fold_slots`` [B] names
         each row's slot so the window's final tokens land back in the
         buffer (padding rows → dummy tail slot). ``top_n`` (static) adds
-        ranked alternative logprobs to the ref."""
+        ranked alternative logprobs to the ref. ``adapter_slots`` = None
+        (base variant) or [B] int32 per-row LoRA bank slots (-1 = base
+        row) — the bank rides the dispatch as one more operand."""
         B = len(tokens)
         self._ensure_last_toks()
         mask = np.zeros((B,), bool)
@@ -243,6 +309,7 @@ class LocalRunner:
             dst, src = chain
             mask[np.asarray(dst, np.int64)] = True
             srcmap[np.asarray(dst, np.int64)] = src
+        bank, aslots = self._lora_operands(adapter_slots)
         toks_d, logps_d, tvals_d, tids_d, self.cache = M.multi_decode(
             self.cfg, K, mode, int(top_n), self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
@@ -251,6 +318,7 @@ class LocalRunner:
             jnp.asarray(tks), jnp.asarray(tps),
             jnp.asarray(freqs), jnp.asarray(press), jnp.asarray(pen),
             jnp.asarray(mask), jnp.asarray(srcmap), self._last_toks,
+            bank, aslots,
             attn_impl=self.attn_impl,
         )
         if fold_slots is None:
@@ -260,18 +328,22 @@ class LocalRunner:
         )
         return self._new_ref((toks_d, logps_d, tvals_d, tids_d), rid)
 
-    def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
+    def decode_step(self, tokens, positions, tables, active,
+                    adapter_slots=None, *, rid=None) -> StepRef:
+        bank, aslots = self._lora_operands(adapter_slots)
         logits, self.cache = M.decode_step(
             self.cfg, self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(tables), jnp.asarray(active),
+            bank, aslots,
             attn_impl=self.attn_impl,
         )
         return self._new_ref((logits,), rid)
 
     def spec_verify(self, S1, mode, tokens, positions0, draft_len, tables,
                     active, temps, seeds, steps0, fold_slots=None, top_n=0,
-                    tree=None, masks=None, *, rid=None) -> StepRef:
+                    tree=None, masks=None, adapter_slots=None,
+                    *, rid=None) -> StepRef:
         """One speculative verify pass: a single forward over ``S1``
         positions per row (one weight stream) with on-device acceptance.
         ``tree`` = None for a linear draft, or (parents [B, S1],
@@ -293,12 +365,13 @@ class LocalRunner:
             ta = jnp.asarray(anc, jnp.int8)
             td = jnp.asarray(depth, jnp.int32)
         mb = None if masks is None else jnp.asarray(masks, jnp.uint32)
+        bank, aslots = self._lora_operands(adapter_slots)
         out, n_emit, logps, cand, tvals, tids, last_tok, self.cache = M.spec_verify(
             self.cfg, int(S1), mode, int(top_n), self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions0),
             jnp.asarray(draft_len), jnp.asarray(tables), jnp.asarray(active),
             jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(steps0),
-            tp, ta, td, mb,
+            tp, ta, td, mb, bank, aslots,
             fused=self.args.spec_fused, attn_impl=self.attn_impl,
         )
         if fold_slots is None:
@@ -458,23 +531,36 @@ class LeaderRunner(LocalRunner):
     # Each dispatch: broadcast first (followers start immediately), then
     # run locally. rid assignment is deterministic on both sides.
 
-    def prefill_batch(self, toks, tables, starts, tlens, *, rid=None) -> StepRef:
+    def prefill_batch(self, toks, tables, starts, tlens, adapter_slots=None,
+                      *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "prefill_batch", "rid": rid,
                     "toks": _pack_np(toks), "tables": _pack_np(tables),
-                    "starts": _pack_np(starts), "tlens": _pack_np(tlens)})
-        return super().prefill_batch(toks, tables, starts, tlens, rid=rid)
+                    "starts": _pack_np(starts), "tlens": _pack_np(tlens),
+                    "aslots": None if adapter_slots is None
+                    else _pack_np(np.asarray(adapter_slots, np.int32))})
+        return super().prefill_batch(toks, tables, starts, tlens,
+                                     adapter_slots, rid=rid)
 
-    def prefill_chunk(self, toks, table, pos, tlen, *, rid=None) -> StepRef:
+    def prefill_chunk(self, toks, table, pos, tlen, adapter_slot=None,
+                      *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "prefill_chunk", "rid": rid,
                     "toks": _pack_np(toks), "table": _pack_np(table),
-                    "pos": int(pos), "tlen": int(tlen)})
-        return super().prefill_chunk(toks, table, pos, tlen, rid=rid)
+                    "pos": int(pos), "tlen": int(tlen),
+                    "aslot": None if adapter_slot is None else int(adapter_slot)})
+        return super().prefill_chunk(toks, table, pos, tlen, adapter_slot,
+                                     rid=rid)
+
+    def upload_adapter(self, slot: int, pages) -> None:
+        self._cast({"op": "upload_adapter", "slot": int(slot),
+                    "pages": [_pack_np(np.asarray(p)) for p in pages]})
+        super().upload_adapter(slot, pages)
 
     def multi_decode(self, K, mode, tokens, chain, positions, tables, active,
                      temps, seeds, steps0, tks, tps, freqs, press, pen,
-                     fold_slots=None, top_n=0, *, rid=None) -> StepRef:
+                     fold_slots=None, top_n=0, adapter_slots=None,
+                     *, rid=None) -> StepRef:
         rid = self._rid
         wire_chain = None
         if chain is not None:
@@ -488,21 +574,29 @@ class LeaderRunner(LocalRunner):
                     "tks": _pack_np(tks), "tps": _pack_np(tps),
                     "freqs": _pack_np(freqs), "press": _pack_np(press),
                     "pen": _pack_np(pen), "top_n": int(top_n),
+                    "aslots": None if adapter_slots is None
+                    else _pack_np(np.asarray(adapter_slots, np.int32)),
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().multi_decode(K, mode, tokens, chain, positions, tables,
                                     active, temps, seeds, steps0, tks, tps,
-                                    freqs, press, pen, fold_slots, top_n, rid=rid)
+                                    freqs, press, pen, fold_slots, top_n,
+                                    adapter_slots, rid=rid)
 
-    def decode_step(self, tokens, positions, tables, active, *, rid=None) -> StepRef:
+    def decode_step(self, tokens, positions, tables, active,
+                    adapter_slots=None, *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "decode_step", "rid": rid,
                     "tokens": _pack_np(tokens), "positions": _pack_np(positions),
-                    "tables": _pack_np(tables), "active": _pack_np(active)})
-        return super().decode_step(tokens, positions, tables, active, rid=rid)
+                    "tables": _pack_np(tables), "active": _pack_np(active),
+                    "aslots": None if adapter_slots is None
+                    else _pack_np(np.asarray(adapter_slots, np.int32))})
+        return super().decode_step(tokens, positions, tables, active,
+                                   adapter_slots, rid=rid)
 
     def spec_verify(self, S1, mode, tokens, positions0, draft_len, tables,
                     active, temps, seeds, steps0, fold_slots=None, top_n=0,
-                    tree=None, masks=None, *, rid=None) -> StepRef:
+                    tree=None, masks=None, adapter_slots=None,
+                    *, rid=None) -> StepRef:
         rid = self._rid
         self._cast({"op": "spec_verify", "rid": rid, "S1": int(S1), "mode": mode,
                     "tokens": _pack_np(tokens), "positions0": _pack_np(positions0),
@@ -516,10 +610,13 @@ class LeaderRunner(LocalRunner):
                     "masks": None if masks is None else _pack_np(
                         np.asarray(masks, np.uint32)
                     ),
+                    "aslots": None if adapter_slots is None
+                    else _pack_np(np.asarray(adapter_slots, np.int32)),
                     "fold": None if fold_slots is None else _pack_np(np.asarray(fold_slots, np.int32))})
         return super().spec_verify(S1, mode, tokens, positions0, draft_len,
                                    tables, active, temps, seeds, steps0,
-                                   fold_slots, top_n, tree, masks, rid=rid)
+                                   fold_slots, top_n, tree, masks,
+                                   adapter_slots, rid=rid)
 
     def sample_rows(self, srcs, temps, tks, tps, pen, freqs, press, seeds,
                     steps, full: bool, fold_slots=None, top_n: int = 0,
@@ -604,19 +701,25 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
         if op == "start":
             runner.start()
         elif op == "prefill_batch":
+            aslots = desc.get("aslots")
             runner.prefill_batch(
                 _unpack_np(desc["toks"]), _unpack_np(desc["tables"]),
                 _unpack_np(desc["starts"]), _unpack_np(desc["tlens"]),
+                None if aslots is None else _unpack_np(aslots),
                 rid=desc["rid"])
         elif op == "prefill_chunk":
             runner.prefill_chunk(
                 _unpack_np(desc["toks"]), _unpack_np(desc["table"]),
-                desc["pos"], desc["tlen"], rid=desc["rid"])
+                desc["pos"], desc["tlen"], desc.get("aslot"), rid=desc["rid"])
+        elif op == "upload_adapter":
+            runner.upload_adapter(
+                desc["slot"], [_unpack_np(p) for p in desc["pages"]])
         elif op == "multi_decode":
             chain = desc["chain"]
             if chain is not None:
                 chain = (chain[0], chain[1])
             fold = desc.get("fold")
+            aslots = desc.get("aslots")
             runner.multi_decode(
                 desc["K"], desc["mode"], _unpack_np(desc["tokens"]), chain,
                 _unpack_np(desc["positions"]), _unpack_np(desc["tables"]),
@@ -626,16 +729,21 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 _unpack_np(desc["freqs"]), _unpack_np(desc["press"]),
                 _unpack_np(desc["pen"]),
                 None if fold is None else _unpack_np(fold),
-                desc.get("top_n", 0), rid=desc["rid"])
+                desc.get("top_n", 0),
+                None if aslots is None else _unpack_np(aslots),
+                rid=desc["rid"])
         elif op == "decode_step":
+            aslots = desc.get("aslots")
             runner.decode_step(
                 _unpack_np(desc["tokens"]), _unpack_np(desc["positions"]),
                 _unpack_np(desc["tables"]), _unpack_np(desc["active"]),
+                None if aslots is None else _unpack_np(aslots),
                 rid=desc["rid"])
         elif op == "spec_verify":
             fold = desc.get("fold")
             tree = desc.get("tree")
             wire_masks = desc.get("masks")
+            aslots = desc.get("aslots")
             runner.spec_verify(
                 desc["S1"], desc["mode"], _unpack_np(desc["tokens"]),
                 _unpack_np(desc["positions0"]), _unpack_np(desc["draft_len"]),
@@ -646,6 +754,7 @@ def follower_loop(args: EngineArgs, leader_addr: str, params=None, seed: int = 0
                 desc.get("top_n", 0),
                 None if tree is None else tuple(_unpack_np(a) for a in tree),
                 None if wire_masks is None else _unpack_np(wire_masks),
+                None if aslots is None else _unpack_np(aslots),
                 rid=desc["rid"])
         elif op == "sample_rows":
             fold = desc.get("fold")

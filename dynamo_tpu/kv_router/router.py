@@ -34,7 +34,7 @@ from dynamo_tpu.runtime.messaging import (
     TruncatedStreamError,
 )
 from dynamo_tpu.runtime.push_router import NoInstancesError, PushRouter
-from dynamo_tpu.tokens import compute_block_hashes
+from dynamo_tpu.tokens import adapter_hash_seed, compute_block_hashes
 
 log = get_logger("kv_router")
 
@@ -171,12 +171,19 @@ class KvPushRouter:
 
     # -- routing ----------------------------------------------------------
 
-    def _place(self, token_ids: list[int], excluded: set[int] = frozenset()):
+    def _place(self, token_ids: list[int], excluded: set[int] = frozenset(),
+               adapter_id: str | None = None):
         """Shared placement recipe: hash → overlap lookup → cost schedule.
         → (Placement, hashes, per-worker overlap scores). Raises
-        NoInstancesError when no candidate."""
+        NoInstancesError when no candidate.
+
+        ``adapter_id`` salts the block hashes (tokens.adapter_hash_seed)
+        exactly as the engines do, so stickiness and overlap scoring are
+        keyed by (model, adapter): a conversation lands where both its KV
+        prefix AND its adapter are warm, and an identical prompt under a
+        different adapter can never ride another identity's cache."""
         bs = self.config.block_size
-        hashes = compute_block_hashes(token_ids, bs)
+        hashes = compute_block_hashes(token_ids, bs, adapter_hash_seed(adapter_id))
         request_blocks = max(1, (len(token_ids) + bs - 1) // bs)
         workers = [w for w in self.discovery.instance_ids() if w not in excluded]
         if not workers:
@@ -214,17 +221,19 @@ class KvPushRouter:
             return None
         return {"instance_id": best_wid, "num_blocks": int(best_overlap)}
 
-    def find_best_match(self, token_ids: list[int]) -> tuple[int, int]:
+    def find_best_match(self, token_ids: list[int],
+                        adapter_id: str | None = None) -> tuple[int, int]:
         """→ (worker_instance_id, overlap_blocks) without routing — the
         reference's `query_instance_id` surface (kv_router.rs:225-264)."""
-        placement, _, _, _ = self._place(token_ids)
+        placement, _, _, _ = self._place(token_ids, adapter_id=adapter_id)
         return placement.worker, placement.overlap_blocks
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
         token_ids = list(request.get("token_ids") or []) if isinstance(request, dict) else []
+        adapter_id = request.get("adapter_id") if isinstance(request, dict) else None
 
         if isinstance(request, dict) and request.get("annotations", {}).get("query_instance_id"):
-            wid, overlap = self.find_best_match(token_ids)
+            wid, overlap = self.find_best_match(token_ids, adapter_id)
             yield {"worker_instance_id": wid, "overlap_blocks": overlap}
             return
 
@@ -238,7 +247,9 @@ class KvPushRouter:
         while attempts < self.config.max_attempts:
             attempts += 1
             try:
-                placement, hashes, scores, eligible = self._place(token_ids, excluded)
+                placement, hashes, scores, eligible = self._place(
+                    token_ids, excluded, adapter_id
+                )
             except NoInstancesError:
                 break
             wid = placement.worker

@@ -354,9 +354,12 @@ class DisaggDecodeHandler:
             hit_blocks = req.get("estimated_prefix_hit_num_blocks") or 0
             # Router hint OR the local engine's own prefix cache — a prompt
             # this worker already holds must not round-trip to prefill.
+            # Probed in the request's (model, adapter) identity domain:
+            # adapter KV is hash-salted, so a base hit never masks an
+            # adapter request's real cache state.
             hit_len = max(
                 hit_blocks * self.engine.args.block_size,
-                self.engine.prefix_hit_length(tokens),
+                self.engine.prefix_hit_length(tokens, req.get("adapter_id")),
             )
             # A peer-fetched prefix (llm/peer_kv.py) already attached as an
             # inject payload counts as cached work too — it covers

@@ -258,7 +258,20 @@ class HttpService:
         return web.json_response({"status": "ok", "cleared": out})
 
     async def handle_models(self, request: web.Request) -> web.Response:
-        return web.json_response(model_list(self.manager.list_names()))
+        """Every served model, LoRA adapters included: an adapter card
+        lists as its own model entry carrying {"lora": {adapter_id, base,
+        rank, resident_tier}} so clients can tell fine-tunes from bases.
+        Unknown adapter names 404 at request time like any unknown model
+        (ModelManager.get returns None) — typed at the frontend, never
+        mid-stream."""
+        meta = {
+            name: {"lora": dict(pipe.card.lora)}
+            for name, pipe in self.manager.items()
+            if pipe.card.lora
+        }
+        return web.json_response(
+            model_list(self.manager.list_names(), metadata=meta)
+        )
 
     # -- debug surface (span recorder views) -------------------------------
 

@@ -43,6 +43,16 @@ class ModelDeploymentCard:
     # Engine capability hints for routers/planners:
     max_batch_size: int | None = None
     total_kv_blocks: int | None = None
+    # Multi-LoRA: set on cards that publish a LoRA fine-tune of a base
+    # model served by the SAME engine/endpoint —
+    # {"adapter_id": str, "base": base model name, "rank": int,
+    #  "resident_tier": "G1"|"G2"|"G3"}. The frontend preprocessor stamps
+    # adapter_id into every request for this card; /v1/models surfaces
+    # the dict so clients can tell adapters from bases. resident_tier is
+    # the tier at REGISTRATION time (adapters start cold in the paged
+    # tiers and page into G1 on first request); live residency is the
+    # engine_lora_resident_adapters gauge.
+    lora: dict[str, Any] | None = None
 
     @property
     def slug(self) -> str:
@@ -62,6 +72,7 @@ class ModelDeploymentCard:
             "endpoint": self.endpoint,
             "max_batch_size": self.max_batch_size,
             "total_kv_blocks": self.total_kv_blocks,
+            "lora": dict(self.lora) if self.lora else None,
         }
 
     @classmethod
@@ -79,6 +90,7 @@ class ModelDeploymentCard:
             endpoint=d.get("endpoint", "generate"),
             max_batch_size=d.get("max_batch_size"),
             total_kv_blocks=d.get("total_kv_blocks"),
+            lora=dict(d["lora"]) if d.get("lora") else None,
         )
 
     def to_bytes(self) -> bytes:

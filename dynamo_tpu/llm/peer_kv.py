@@ -31,7 +31,7 @@ from typing import Any, AsyncIterator
 from dynamo_tpu.engine.kv_transfer import KvPagePayload, concat_page_run
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
-from dynamo_tpu.tokens import compute_block_hashes
+from dynamo_tpu.tokens import adapter_hash_seed, compute_block_hashes
 from dynamo_tpu.transfer.stream import TransferError, read_kv_payload_frames
 
 log = get_logger("peer_kv")
@@ -111,15 +111,20 @@ class PeerPrefixFetcher:
         (local prefill fallback)."""
         try:
             tokens = list(req.get("token_ids") or [])
+            adapter_id = req.get("adapter_id")
             bs = self.engine.args.block_size
             max_hit = (len(tokens) - 1) // bs
             want = min(int(hint.get("num_blocks") or 0), max_hit)
-            hashes = compute_block_hashes(tokens, bs)[:want]
+            # Adapter-salted like every other KV identity consumer: the
+            # peer's tiers key adapter KV under the same salted hashes.
+            hashes = compute_block_hashes(
+                tokens, bs, adapter_hash_seed(adapter_id)
+            )[:want]
             # Local coverage may already match (or beat) what the peer
             # holds — the router's index lags reality by an event
             # round-trip, and HBM-evicted blocks still count: the
             # admission-time tier onboard serves them from host RAM.
-            covered = self.engine.prefix_hit_length(tokens) // bs
+            covered = self.engine.prefix_hit_length(tokens, adapter_id) // bs
             tiers = getattr(self.engine, "tiers", None)
             if tiers is not None and tiers.enabled and covered < want:
                 covered += tiers.peek_run_len(hashes[covered:])

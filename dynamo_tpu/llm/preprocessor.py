@@ -157,6 +157,11 @@ class OpenAIPreprocessor:
             eos_token_ids=self._eos_ids,
             annotations=annotations,
             response_format=response_format,
+            # Multi-LoRA: a card published for a LoRA fine-tune stamps its
+            # adapter identity into every request — the engine resolves it
+            # to a resident bank slot and the router keys KV stickiness by
+            # (model, adapter).
+            adapter_id=(self.card.lora or {}).get("adapter_id"),
         )
 
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:

@@ -129,6 +129,12 @@ class PreprocessedRequest:
     # worker engine compiles it to a token-mask FSM, cached by schema
     # hash, and decodes under the mask (engine/grammar.py).
     response_format: dict[str, Any] | None = None
+    # Multi-LoRA: the adapter identity this request decodes under
+    # (None = base model). Stamped by the preprocessor from the model
+    # card's lora metadata; the worker engine resolves it to a resident
+    # bank slot at admission (engine/lora.py) and the kv_router salts
+    # block hashes with it so KV stickiness is keyed by (model, adapter).
+    adapter_id: str | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -144,6 +150,8 @@ class PreprocessedRequest:
             d["kv_transfer_params"] = self.kv_transfer_params
         if self.response_format is not None:
             d["response_format"] = self.response_format
+        if self.adapter_id is not None:
+            d["adapter_id"] = self.adapter_id
         return d
 
     @classmethod
@@ -158,6 +166,7 @@ class PreprocessedRequest:
             annotations=dict(d.get("annotations") or {}),
             kv_transfer_params=d.get("kv_transfer_params"),
             response_format=d.get("response_format"),
+            adapter_id=d.get("adapter_id"),
         )
 
 
@@ -854,14 +863,22 @@ def completion_response(
     return body
 
 
-def model_list(models: Iterable[str], owned_by: str = "dynamo-tpu") -> dict[str, Any]:
+def model_list(models: Iterable[str], owned_by: str = "dynamo-tpu",
+               metadata: dict[str, dict] | None = None) -> dict[str, Any]:
+    """OpenAI /v1/models body. ``metadata`` adds per-model extra keys —
+    LoRA adapter cards surface {"lora": {base, rank, resident_tier}} so
+    clients can tell an adapter entry from its base model."""
     now = int(time.time())
-    return {
-        "object": "list",
-        "data": [
-            {"id": m, "object": "model", "created": now, "owned_by": owned_by} for m in models
-        ],
-    }
+    data = []
+    for m in models:
+        entry: dict[str, Any] = {
+            "id": m, "object": "model", "created": now, "owned_by": owned_by,
+        }
+        md = (metadata or {}).get(m)
+        if md:
+            entry.update(md)
+        data.append(entry)
+    return {"object": "list", "data": data}
 
 
 # ---------------------------------------------------------------------------

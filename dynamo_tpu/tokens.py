@@ -31,6 +31,25 @@ BlockHash = int
 SequenceHash = int
 
 
+def adapter_hash_seed(adapter_id: str | None, seed: int = HASH_SEED) -> int:
+    """Hash seed for one (base model, LoRA adapter) identity domain.
+
+    A prompt prefilled under a LoRA adapter produces DIFFERENT K/V than
+    the base model (the k/v projections carry the adapter delta), so its
+    cached blocks must never prefix-hit a base or other-adapter request.
+    Salting the chain's seed — rather than prepending sentinel tokens —
+    keeps every block hash, tier key, KV event, router radix entry and
+    fleet sticky-routing decision partitioned by adapter with zero wire
+    or storage format changes. Router and workers derive the same seed
+    from the same adapter id, so cross-component identity still lines up
+    exactly (the compute_block_hashes contract)."""
+    if adapter_id is None:
+        return seed
+    return xxhash.xxh3_64_intdigest(
+        b"adapter:" + adapter_id.encode(), seed=seed
+    )
+
+
 def hash_tokens(tokens: Sequence[int], seed: int = HASH_SEED) -> BlockHash:
     """Block-local hash: xxh3_64 over little-endian u32 token ids."""
     return xxhash.xxh3_64_intdigest(struct.pack(f"<{len(tokens)}I", *tokens), seed=seed)

@@ -108,6 +108,19 @@ def parse_args(argv=None):
                         "from acceptance-EMA-cold rows to hot ones under the "
                         "fixed batch budget (rows x spec-tokens); uniform = "
                         "every row gets spec-tokens (the pre-r11 behavior)")
+    # Multi-LoRA multiplexing (engine/lora.py): serve MANY fine-tunes of
+    # the base model on this one engine. --lora-slots sizes the HBM
+    # adapter bank (0 = off); --lora registers adapters (repeatable,
+    # NAME[:RANK[:SEED]]), each published as its own served model whose
+    # requests decode under the adapter — base and adapter rows share
+    # every batch via the gathered LoRA matmul. More adapters than slots
+    # page through the G2/G3 tier economy on demand.
+    p.add_argument("--lora-slots", type=int, default=0,
+                   help="device-resident LoRA adapter slots (0 = LoRA off)")
+    p.add_argument("--lora-rank", type=int, default=8,
+                   help="static adapter bank rank (max over registered adapters)")
+    p.add_argument("--lora", action="append", default=[], metavar="NAME[:RANK[:SEED]]",
+                   help="register one adapter served as model NAME (repeatable)")
     p.add_argument("--attn-impl", choices=["auto", "xla", "pallas", "pallas_interpret"],
                    default="auto", help="attention backend (ops/paged_attention.py)")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -162,6 +175,16 @@ def parse_args(argv=None):
     args = p.parse_args(argv)
     if args.remote_prefill:
         args.disagg = "on"
+    if args.lora and args.lora_slots <= 0:
+        p.error("--lora requires --lora-slots > 0")
+    if args.lora and args.engine == "mocker":
+        p.error("--lora requires --engine tpu (the mocker has no adapter bank)")
+    try:
+        # Parsed ONCE here (argparse-grade error UX); consumers read
+        # args.lora_specs instead of re-parsing.
+        args.lora_specs = parse_lora_specs(args.lora, args.lora_rank)
+    except ValueError as e:
+        p.error(str(e))
     if args.engine == "mocker" and (args.disagg == "on" or args.is_prefill_worker):
         # The disagg handlers drive the real engine's KV extract/inject
         # surface (prefix_hit_length, kv pages); the mocker has neither.
@@ -176,6 +199,25 @@ def parse_args(argv=None):
     if args.dp_rank is not None and not 0 <= args.dp_rank < args.dp_size:
         p.error("--dp-rank must be in [0, --dp-size)")
     return args
+
+
+def parse_lora_specs(entries: list[str], default_rank: int) -> list[tuple[str, int, int]]:
+    """--lora NAME[:RANK[:SEED]] entries → [(name, rank, seed)]."""
+    out = []
+    for e in entries:
+        parts = e.split(":")
+        name = parts[0]
+        if not name:
+            raise ValueError(f"--lora entry {e!r}: empty adapter name")
+        try:
+            rank = int(parts[1]) if len(parts) > 1 and parts[1] else default_rank
+            seed = int(parts[2]) if len(parts) > 2 and parts[2] else 0
+        except ValueError:
+            raise ValueError(
+                f"--lora entry {e!r}: RANK and SEED must be integers"
+            ) from None
+        out.append((name, rank, seed))
+    return out
 
 
 def dp_rank_ports(base_port: int, dp_rank: int, stride: int = 4) -> dict:
@@ -286,6 +328,13 @@ async def build_engine(args, config=None):
 async def async_main(args) -> None:
     rt = await DistributedRuntime.create(store_url=args.store_url)
     engine, card = await build_engine(args, config=rt.config)
+    # Multi-LoRA: register every --lora adapter on the engine (paged
+    # into the tier economy now; device slots fill on first request).
+    # Prefill workers register them too — a remote prefill carries the
+    # request's adapter_id and must resolve it.
+    lora_specs = args.lora_specs
+    for lname, lrank, lseed in lora_specs:
+        engine.register_adapter(lname, rank=lrank, seed=lseed)
     # Engine-level chaos draws (mocker kill_p) count on this process's
     # /metrics alongside the messaging-layer injector's.
     engine_chaos = getattr(getattr(engine, "args", None), "chaos", None)
@@ -401,6 +450,20 @@ async def async_main(args) -> None:
 
             await comp.endpoint("clear_kv").serve(clear_handler)
         await register_model(rt, args.namespace, card)
+        # One model card per adapter: the frontend lists each fine-tune
+        # as its own served model (/v1/models carries the lora metadata),
+        # the preprocessor stamps adapter_id from the card, and routing
+        # lands on the same component/endpoint this engine serves —
+        # adapters start cold in the tiers (resident_tier G2) and page
+        # into G1 on first request.
+        import dataclasses as _dc
+
+        for lname, lrank, _lseed in lora_specs:
+            await register_model(rt, args.namespace, _dc.replace(
+                card, name=lname,
+                lora={"adapter_id": lname, "base": card.name,
+                      "rank": lrank, "resident_tier": "G2"},
+            ))
         role = "worker"
     rank = "" if args.dp_rank is None else f" [dp rank {args.dp_rank}/{args.dp_size}]"
     print(
@@ -454,6 +517,8 @@ def _engine_args(args, model):
         spec_tree_width=args.spec_tree_width,
         spec_tree_depth=args.spec_tree_depth,
         spec_budget_adaptive=args.spec_budget == "adaptive",
+        lora_slots=args.lora_slots,
+        lora_rank=max([args.lora_rank] + [r for _, r, _ in args.lora_specs]),
         # Grammar token-mask FSMs compile over the SERVING tokenizer's
         # vocabulary (engine/grammar.py) — response_format masks must
         # legalize exactly the ids the detokenizer can render.

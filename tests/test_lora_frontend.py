@@ -1,0 +1,189 @@
+"""Multi-LoRA frontend surface: adapter model cards, /v1/models
+metadata, typed 404s for unknown adapters, and (model, adapter)-keyed
+cross-frontend sticky routing.
+
+Workers publish one card per adapter (same component/endpoint as the
+base — one engine serves them all); the frontend lists each adapter as a
+served model with its lora metadata, stamps adapter_id into every
+request for that card, and the kv_router salts block hashes with the
+adapter id so fleet stickiness is keyed by (model, adapter). Mocker
+engines stand in for the TPU engine here — identity threading and
+routing are frontend-side concerns."""
+
+import asyncio
+import dataclasses
+
+import httpx
+
+from dynamo_tpu.fleet.decisions import RouterDecisionCache
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
+from dynamo_tpu.kv_router.router import KvRouterConfig
+from dynamo_tpu.llm.discovery import ModelManager, ModelWatcher
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard, register_model
+from dynamo_tpu.llm.pipeline import RouterSettings
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.push_router import RouterMode
+
+
+def base_card() -> ModelDeploymentCard:
+    return ModelDeploymentCard(
+        name="mock-model", kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS], context_length=4096,
+    )
+
+
+def adapter_card(name: str, rank: int = 8) -> ModelDeploymentCard:
+    return dataclasses.replace(
+        base_card(), name=name,
+        lora={"adapter_id": name, "base": "mock-model", "rank": rank,
+              "resident_tier": "G2"},
+    )
+
+
+async def start_worker(store_url, namespace="lf", adapters=("tenant-a", "tenant-b")):
+    rt = await DistributedRuntime.create(store_url=store_url)
+    engine = MockerEngine(MockerArgs(block_size=4, num_kv_blocks=256, speedup=1000.0))
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+    comp = rt.namespace(namespace).component("backend")
+
+    # The mocker records each request's adapter_id so the test can
+    # assert the preprocessor stamped identity end to end.
+    seen_adapters: list = []
+
+    async def gen_handler(payload, ctx):
+        seen_adapters.append((payload or {}).get("adapter_id"))
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    await comp.endpoint("generate").serve(gen_handler)
+    await serve_kv_endpoints(comp, broadcaster, engine.metrics)
+    await register_model(rt, namespace, base_card())
+    for a in adapters:
+        await register_model(rt, namespace, adapter_card(a))
+    return rt, engine, seen_adapters
+
+
+async def start_frontend(store_url, namespace="lf", fleet_id="lftest"):
+    rt = await DistributedRuntime.create(store_url=store_url)
+    cache = await RouterDecisionCache(rt.store, fleet_id, ttl=60.0).start()
+    settings = RouterSettings(
+        mode=RouterMode.KV,
+        kv=KvRouterConfig(use_kv_events=False),
+        decisions=cache,
+    )
+    manager = ModelManager(rt, settings)
+    watcher = await ModelWatcher(rt, manager, namespace).start()
+    http = await HttpService(
+        manager, rt.metrics, health=rt.health, host="127.0.0.1", port=0
+    ).start()
+    for _ in range(100):
+        if len(manager.list_names()) >= 3:
+            break
+        await asyncio.sleep(0.05)
+    return rt, manager, watcher, http, cache
+
+
+def test_models_list_and_unknown_adapter_404():
+    async def go():
+        url = "memory://lora_frontend_models"
+        w = await start_worker(url)
+        f = await start_frontend(url)
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                base = f"http://127.0.0.1:{f[3].port}"
+                r = await client.get(f"{base}/v1/models")
+                assert r.status_code == 200
+                entries = {e["id"]: e for e in r.json()["data"]}
+                assert set(entries) == {"mock-model", "tenant-a", "tenant-b"}
+                assert "lora" not in entries["mock-model"]
+                assert entries["tenant-a"]["lora"] == {
+                    "adapter_id": "tenant-a", "base": "mock-model",
+                    "rank": 8, "resident_tier": "G2",
+                }
+                # Unknown adapter name: typed 404 at the frontend, never
+                # a mid-stream worker error.
+                r = await client.post(f"{base}/v1/completions", json={
+                    "model": "tenant-zz", "prompt": "hi", "max_tokens": 4,
+                })
+                assert r.status_code == 404
+                assert r.json()["error"]["type"] == "not_found_error"
+                # A registered adapter serves, and the worker saw its
+                # adapter_id stamped by the preprocessor.
+                r = await client.post(f"{base}/v1/completions", json={
+                    "model": "tenant-a", "prompt": "hello there",
+                    "max_tokens": 4, "ignore_eos": True, "seed": 1,
+                })
+                assert r.status_code == 200, r.text
+                assert "tenant-a" in w[2]
+        finally:
+            await f[3].close()
+            await f[2].close()
+            await f[1].close()
+            await f[0].shutdown()
+            await w[0].shutdown()
+
+    asyncio.run(go())
+
+
+def test_adapter_conversation_sticks_across_frontends():
+    """Two frontends, two engines, event-less KV index: only the shared
+    decision cache (keyed by adapter-salted hashes) can keep an adapter
+    conversation on its warm engine — and a DIFFERENT adapter's identical
+    prompt must not inherit that placement's hash chain."""
+
+    async def go():
+        url = "memory://lora_frontend_sticky"
+        w1 = await start_worker(url)
+        w2 = await start_worker(url)
+        f1 = await start_frontend(url)
+        f2 = await start_frontend(url)
+        bases = [f"http://127.0.0.1:{f[3].port}" for f in (f1, f2)]
+        try:
+            async with httpx.AsyncClient(timeout=20) as client:
+                async def turn(base: str, model: str, prompt: str) -> str:
+                    r = await client.post(f"{base}/v1/completions", json={
+                        "model": model, "prompt": prompt,
+                        "max_tokens": 8, "ignore_eos": True, "seed": 0,
+                    })
+                    assert r.status_code == 200, r.text
+                    return r.json()["choices"][0]["text"]
+
+                e1, e2 = w1[1], w2[1]
+                prompt = "adapter conversation seed " * 4
+                await turn(bases[0], "tenant-a", prompt)
+                warm = e1 if e1.total_generated > 0 else e2
+                cold = e2 if warm is e1 else e1
+                assert warm.total_generated > 0 and cold.total_generated == 0
+                await asyncio.sleep(0.1)  # decision write + mirror echo
+
+                for i in range(6):
+                    prompt = prompt + f" turn {i} extends the history"
+                    await turn(bases[i % 2], "tenant-a", prompt)
+                    await asyncio.sleep(0.05)
+                assert cold.total_generated == 0, (
+                    "adapter conversation leaked to the cold engine"
+                )
+                # The decision cache is keyed by the ADAPTER's salted
+                # hashes: the same token stream under tenant-b finds no
+                # cached placement (its chain is a disjoint identity).
+                from dynamo_tpu.tokens import adapter_hash_seed, compute_block_hashes
+                tok = ByteTokenizer()
+                ids = tok.encode(prompt)
+                scoped = f2[4].scoped("tenant-b")
+                other = scoped.lookup(compute_block_hashes(
+                    ids, 4, adapter_hash_seed("tenant-b")))
+                assert other is None
+        finally:
+            for f in (f1, f2):
+                await f[3].close()
+                await f[2].close()
+                await f[1].close()
+                await f[0].shutdown()
+            await w1[0].shutdown()
+            await w2[0].shutdown()
+
+    asyncio.run(go())

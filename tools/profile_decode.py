@@ -95,6 +95,8 @@ async def engine_hotloop(
     kv_quant: str = "none",
     max_num_seqs: int = 8,
     num_kv_blocks: int = 256,
+    lora_slots: int = 0,
+    lora_adapters: int = 0,
 ) -> dict:
     """Drive the real TpuEngine scheduler through a small concurrent
     workload → {tokens (per-request streams), host_blocked_frac,
@@ -107,7 +109,11 @@ async def engine_hotloop(
     ``structured`` makes every request a grammar-constrained JSON
     extraction (shared schema via response_format — the FSM-masked
     sampling + pruned-draft path), reporting per-request decoded texts
-    as ``texts``."""
+    as ``texts``. ``lora_adapters`` > 0 registers that many adapters on
+    a ``lora_slots``-slot bank and sends every ODD request through an
+    adapter (cycling), so the batch mixes base and adapter rows — base
+    rows must stay byte-identical to a no-LoRA run of the same
+    schedule, and adapters > slots forces page-in/evict traffic."""
     from dynamo_tpu.engine.config import EngineArgs, ModelConfig
     from dynamo_tpu.engine.engine import BLOCKING_PHASES, TpuEngine
     from dynamo_tpu.llm.protocols import PreprocessedRequest
@@ -127,11 +133,13 @@ async def engine_hotloop(
         spec_fused=spec_fused, spec_tree_width=spec_tree_width,
         spec_tree_depth=spec_tree_depth,
         spec_budget_adaptive=spec_budget == "adaptive",
-        kv_quant=kv_quant, **kw,
+        kv_quant=kv_quant, lora_slots=lora_slots, lora_rank=4, **kw,
     )
     tok = ByteTokenizer()
     engine = await TpuEngine(eargs, seed=0).start()
     try:
+        for a in range(lora_adapters):
+            engine.register_adapter(f"lt{a}", rank=4, seed=9)
         rng = np.random.default_rng(seed)
         reqs = []
         for i in range(n_requests):
@@ -158,6 +166,8 @@ async def engine_hotloop(
             else:
                 toks = rng.integers(1, cfg.vocab_size - 1, size=plen).tolist()
             req = PreprocessedRequest(model=cfg.name, token_ids=toks)
+            if lora_adapters and i % 2 == 1:
+                req.adapter_id = f"lt{(i // 2) % lora_adapters}"
             req.sampling.temperature = 0.0
             # Explicit per-request seed: unseeded requests draw from the
             # GLOBAL random module, which would make the depth-0 vs
@@ -208,6 +218,9 @@ async def engine_hotloop(
             ]
             out["grammar_mask_s"] = round(engine.total_grammar_mask_s, 4)
             out["budget_reallocs"] = engine.total_spec_budget_reallocs
+        if lora_adapters:
+            out["lora"] = engine.lora_stats()
+            out["lora_host_s"] = round(engine.total_lora_s, 4)
         if spec_tokens > 0:
             hist = await engine.run_on_engine_thread(
                 lambda: dict(engine._spec_depth_hist)
@@ -331,6 +344,29 @@ def run_kv_quant_sweep(*, quick: bool = False, pipeline_depth: int = 2,
     return out
 
 
+def run_lora_sweep(*, quick: bool = False, pipeline_depth: int = 2,
+                   decode_steps: int = 4) -> dict:
+    """``--lora`` probe: multi-LoRA multiplexing on the real scheduler.
+    A base-only reference run, then adapter-count sweeps where every odd
+    request decodes under an adapter cycling over MORE adapters than
+    device slots — so the run exercises BGMV mixed batches AND the slot
+    economy's page-in/evict path. Reports tok/s, slot-pool stats and
+    host-side LoRA seconds per configuration; base rows must stay
+    byte-identical to the reference (the quick tier asserts it)."""
+    n_requests = QUICK_SPEC_REQUESTS if quick else 8
+    base = asyncio.run(engine_hotloop(
+        pipeline_depth, decode_steps=decode_steps, n_requests=n_requests,
+    ))
+    grid = [(3, 2)] if quick else [(2, 2), (4, 2), (8, 4)]
+    out = {"base": base}
+    for adapters, slots in grid:
+        out[f"a{adapters}s{slots}"] = asyncio.run(engine_hotloop(
+            pipeline_depth, decode_steps=decode_steps, n_requests=n_requests,
+            lora_adapters=adapters, lora_slots=slots,
+        ))
+    return out
+
+
 def run_spec_sweep(*, quick: bool = False, pipeline_depth: int = 2,
                    decode_steps: int = 4) -> dict:
     """``--spec`` probe: sweep draft length S ∈ {0, 2, 4, 8} on the real
@@ -449,6 +485,30 @@ def run_quick() -> int:
     assert any(r.get("spec_rows", 0) > 0 for r in gram.values()), (
         "grammar sweep never dispatched a verify pass"
     )
+    # Multi-LoRA smoke: in the adapter-mixed run every EVEN request is a
+    # base row and must be byte-identical to the no-LoRA reference run
+    # of the identical schedule; odd (adapter) rows must diverge; and
+    # with 3 adapters cycling over 2 slots the slot pool must have
+    # evicted at least once (the page-in/evict economy actually ran).
+    lora = run_lora_sweep(quick=True)
+    mixed = lora["a3s2"]
+    assert mixed["total_tokens"] == QUICK_SPEC_REQUESTS * gen_len, (
+        f"lora mixed: lost tokens — {mixed['total_tokens']}"
+    )
+    for i in range(QUICK_SPEC_REQUESTS):
+        if i % 2 == 0:
+            assert mixed["tokens"][i] == lora["base"]["tokens"][i], (
+                f"lora: base row {i} diverged in the adapter-mixed batch"
+            )
+        else:
+            assert mixed["tokens"][i] != lora["base"]["tokens"][i], (
+                f"lora: adapter row {i} identical to base — delta not applied"
+            )
+    assert mixed["lora"]["evictions"] >= 1, (
+        f"lora: no slot eviction under 3-adapters/2-slots pressure "
+        f"({mixed['lora']})"
+    )
+    assert mixed["lora"]["pageins"] >= 3, "lora: every adapter must page in"
     # int8-KV sweep: every configuration keeps full token accounting
     # (quantization must never lose or duplicate tokens), the 2x-batch
     # pool fits in the f32 pool's byte budget, and the capacity math
@@ -490,8 +550,13 @@ def run_quick() -> int:
         label: {k: v for k, v in r.items() if k not in ("tokens", "texts")}
         for label, r in gram.items()
     }
+    lora_out = {
+        label: {k: v for k, v in r.items() if k != "tokens"}
+        for label, r in lora.items()
+    }
     print(json.dumps({"hotloop": out, "spec": spec_out, "spec_tree": tree_out,
                       "kv_quant": kvq_out, "grammar": gram_out,
+                      "lora": lora_out,
                       "kv_capacity_ratio_8b": round(ratio, 3)}))
     print("QUICK-OK")
     return 0
@@ -528,6 +593,12 @@ def main():
                         "on one seeded JSON-extraction schedule — tok/weight-"
                         "pass, accept-depth histogram, mask-build overhead, "
                         "schema-validity per row")
+    p.add_argument("--lora", action="store_true",
+                   help="multi-LoRA probe: base-only reference vs adapter-"
+                        "count sweeps (adapters > device slots, so the run "
+                        "exercises BGMV mixed batches AND slot page-in/evict) "
+                        "— tok/s, slot-pool stats, host LoRA seconds per "
+                        "configuration")
     p.add_argument("--pipeline-depth", type=int, default=2)
     p.add_argument("--quick", action="store_true",
                    help="tier-1 smoke: CPU tiny shapes + depth-0/2 golden hot-loop probe")
@@ -569,6 +640,14 @@ def main():
         return 0
     if args.kv_quant:
         sweep = run_kv_quant_sweep(
+            pipeline_depth=args.pipeline_depth, decode_steps=args.decode_steps,
+        )
+        for label, r in sweep.items():
+            r.pop("tokens")
+            print(json.dumps({"config": label, **r}))
+        return 0
+    if args.lora:
+        sweep = run_lora_sweep(
             pipeline_depth=args.pipeline_depth, decode_steps=args.decode_steps,
         )
         for label, r in sweep.items():
