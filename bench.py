@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import json
 import os
 import sys
@@ -42,7 +43,8 @@ def parse_args():
     p.add_argument("--fixed-len", action="store_true", help="disable mixed lengths")
     p.add_argument("--workload", default="lognormal-mixed",
                    choices=["lognormal-mixed", "fixed", "repetitive",
-                            "shared-prefix", "structured", "multi-lora"],
+                            "shared-prefix", "structured", "multi-lora",
+                            "multi-tenant"],
                    help="lognormal-mixed = ShareGPT-like regression workload; "
                         "repetitive = agentic/extractive prompts with high "
                         "n-gram overlap (the speculation-friendly shape) — "
@@ -92,6 +94,10 @@ def parse_args():
                         "economy to run during the measurement")
     p.add_argument("--lora-turns", type=int, default=2,
                    help="multi-lora workload: conversation turns per tenant")
+    p.add_argument("--mt-overload", type=float, default=1.5,
+                   help="multi-tenant workload: offered load as a multiple "
+                        "of the measured saturation rate (the overload the "
+                        "QoS-vs-FIFO goodput A/B runs at)")
     p.add_argument("--sp-turns", type=int, default=3,
                    help="shared-prefix workload: conversation turns per user")
     p.add_argument("--sp-system-tokens", type=int, default=0,
@@ -1100,6 +1106,517 @@ async def bench_multi_lora(args) -> dict:
     return result
 
 
+async def bench_multi_tenant(args) -> dict:
+    """Multi-tenant QoS goodput proof (ROADMAP 2, DistServe framing): a
+    seeded many-tenant MIXED trace — interactive one-offs, standard
+    mixed traffic, batch agentic conversations whose growing histories
+    churn a deliberately small G2 — offered at ``--mt-overload``
+    (default 1.5x) the measured saturation rate. The IDENTICAL arrival
+    schedule runs through (a) the QoS stack (WDRR admission + Mooncake
+    early rejection + class-aware engine scheduling) and (b) a plain
+    FIFO gate at the same capacity. Headline: SLO-attaining tokens per
+    second, QoS-on vs FIFO at equal chip count.
+
+    Client model: interactive/standard clients ABANDON a request whose
+    first token misses 3x the class TTFT SLO (cancel mid-stream — the
+    wasted-work failure mode early rejection exists to prevent); batch
+    clients wait. A request's tokens count toward goodput only when it
+    completed AND met its class TTFT SLO (batch: completion alone).
+    """
+    import jax
+
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+    from dynamo_tpu.planner.interpolate import PrefillInterpolator
+    from dynamo_tpu.runtime.admission import AdmissionController, AdmissionRejected
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.runtime.qos import QosClass, QosPolicy, TtftPredictor
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        model = ModelConfig.preset("test-tiny")
+    else:
+        model = ModelConfig.preset(args.model)
+    device = str(jax.devices()[0])
+    rng = np.random.default_rng(14)
+
+    # -- trace: tenants, classes, one-off vs agentic shapes ----------------
+    n_req = max(24, args.num_requests)
+    classes = ("interactive", "standard", "batch")
+    class_frac = {"interactive": 0.4, "standard": 0.3, "batch": 0.3}
+    n_tenants = max(6, n_req // 8)
+    tenant_cls = [classes[i % 3] for i in range(n_tenants)]
+    sfx_med = max(12, args.prompt_len // 8)
+    gen_by_cls = {
+        "interactive": max(6, args.gen_len // 16),
+        "standard": max(10, args.gen_len // 8),
+        "batch": max(16, args.gen_len // 4),
+    }
+
+    reqs = []  # (cls, tenant, turn_index, prompt_tokens, gen_len)
+    histories: dict[int, list[int]] = {}
+    counts = {c: int(n_req * f) for c, f in class_frac.items()}
+    counts["interactive"] += n_req - sum(counts.values())
+    for cls in classes:
+        tenants = [t for t in range(n_tenants) if tenant_cls[t] == cls]
+        for i in range(counts[cls]):
+            t = tenants[i % len(tenants)]
+            glen = int(np.clip(
+                gen_by_cls[cls] * rng.lognormal(0.0, 0.4), 4, gen_by_cls[cls] * 3
+            ))
+            if cls == "batch" or (cls == "standard" and i % 2 == 0):
+                # Agentic turn: the tenant's full history + a new message
+                # (prefix reuse + G2 churn as histories grow and evict).
+                msg = rng.integers(1, model.vocab_size - 1,
+                                   size=int(sfx_med * 2)).tolist()
+                hist = histories.setdefault(
+                    t, rng.integers(1, model.vocab_size - 1,
+                                    size=sfx_med * 2).tolist()
+                )
+                hist.extend(msg)
+                prompt = list(hist)
+            else:
+                prompt = rng.integers(
+                    1, model.vocab_size - 1,
+                    size=int(np.clip(sfx_med * rng.lognormal(0.0, 0.5),
+                                     6, sfx_med * 4)),
+                ).tolist()
+            reqs.append((cls, t, len(reqs), prompt, glen))
+    order = rng.permutation(len(reqs))
+    reqs = [reqs[i] for i in order]
+
+    block_size = args.block_size
+    max_ctx = max(len(p) for _, _, _, p, _ in reqs) + max(
+        g for *_, g in reqs) + (args.pipeline_depth + 1) * args.decode_steps
+    blocks_per_seq = (max_ctx + block_size - 1) // block_size + 1
+    max_num_seqs = max(8, min(args.max_num_seqs, 24))
+    dtype = "float32" if args.cpu else "bfloat16"
+
+    def engine_args(qos_on: bool) -> EngineArgs:
+        return EngineArgs(
+            model=model,
+            block_size=block_size,
+            num_kv_blocks=(max_num_seqs + 4) * blocks_per_seq,
+            max_num_seqs=max_num_seqs,
+            max_model_len=(blocks_per_seq + 1) * block_size,
+            # Chunked prefill: a batch conversation's long history must
+            # not park an interactive arrival behind one monolithic
+            # dispatch — chunks bound the head-of-line unit.
+            max_prefill_tokens=256,
+            dtype=dtype,
+            decode_steps=args.decode_steps,
+            pipeline_depth=args.pipeline_depth,
+            pipeline_windows=args.pipeline_depth > 0,
+            prefill_buckets_spec=args.prefill_buckets,
+            quant=args.quant,
+            kv_quant=args.kv_quant,
+            qos_scheduling=qos_on,
+            # Small G2: the many-tenant churn PR 10 left open — agentic
+            # histories evict and re-onboard through the host tier.
+            host_kv_blocks=max(48, 3 * n_tenants),
+        )
+
+    def make_req(cls, tenant, i, prompt, glen, with_priority=True):
+        req = PreprocessedRequest(
+            model=model.name, token_ids=list(prompt),
+            priority=cls if with_priority else None,
+            tenant=f"tenant-{tenant}" if with_priority else None,
+        )
+        req.sampling.temperature = 0.0
+        req.sampling.seed = 1000 + i
+        req.stop.max_tokens = int(glen)
+        req.stop.ignore_eos = True
+        return req
+
+    async def serve_once(engine, req, ctx):
+        t0 = time.perf_counter()
+        first = None
+        n_tok = 0
+        async for item in engine.generate(req, ctx):
+            if item.get("error"):
+                raise RuntimeError(item["error"])
+            if item.get("token_ids"):
+                if first is None:
+                    first = time.perf_counter() - t0
+                n_tok += len(item["token_ids"])
+        return first, n_tok
+
+    # -- calibration: saturation rate + a measured prefill curve ----------
+    _stage("multi-tenant calibration: saturation + prefill curve")
+    cal_engine = await TpuEngine(engine_args(True), seed=0).start()
+    try:
+        cal = reqs[: min(len(reqs), 3 * max_num_seqs)]
+        # Warmup over the WHOLE calibration set: every prefill-bucket
+        # shape the trace exercises compiles here, so neither the
+        # light-load TTFT samples nor the saturation loop time XLA
+        # compiles as serving work.
+        warm_gate = asyncio.Semaphore(max_num_seqs)
+
+        async def warm_one(r):
+            async with warm_gate:
+                await serve_once(
+                    cal_engine,
+                    make_req(*r[:2], 10_000 + r[2], r[3], r[4]), Context(),
+                )
+
+        await asyncio.gather(*(warm_one(r) for r in cal))
+        cal_engine.clear_kv_blocks()
+        # Light-load TTFT samples (the SLO scale + the predictor's
+        # prefill curve), then a full-pipeline closed loop at the GATE's
+        # concurrency — the honest service-rate ceiling the overload
+        # multiplier applies to.
+        samples = []
+
+        async def cal_one(r):
+            first, _ = await serve_once(
+                cal_engine, make_req(*r[:2], 20_000 + r[2], r[3], r[4]), Context()
+            )
+            if first is not None:
+                samples.append((len(r[3]), first * 1000.0))
+
+        light = asyncio.Semaphore(2)
+
+        async def light_one(r):
+            async with light:
+                await cal_one(r)
+
+        await asyncio.gather(*(light_one(r) for r in cal[:max_num_seqs]))
+        solo_ttft_ms = pctl([s[1] for s in samples], 50)
+        cal_engine.clear_kv_blocks()
+        # Saturation over the FULL trace (short closed loops are ramp/
+        # drain-tail dominated and underestimate capacity, which would
+        # turn the "1.5x overload" offered rate into comfortable load).
+        gate = asyncio.Semaphore(int(1.5 * max_num_seqs))
+
+        async def sat_one(r):
+            async with gate:
+                await serve_once(
+                    cal_engine,
+                    make_req(*r[:2], 25_000 + r[2], r[3], r[4]), Context(),
+                )
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(sat_one(r) for r in reqs))
+        sat_rps = len(reqs) / (time.perf_counter() - t0)
+        # Paced probes: prefix reuse is ORDER-dependent (a tenant's later
+        # turns hit earlier turns' registered blocks when arrivals are
+        # paced, but prefill from scratch when slammed concurrently), so
+        # paced capacity can far exceed the closed-loop estimate. Probe
+        # at escalating rates until the system demonstrably fails to
+        # keep up; the last measured service rate is the ceiling the
+        # overload multiplier applies to.
+        window = int(1.5 * max_num_seqs)
+        loaded_ttfts: list[float] = []
+        for _probe in range(4):
+            loaded_ttfts.clear()
+            probe_rate = 1.6 * sat_rps
+            parr = np.cumsum(
+                rng.exponential(1.0 / probe_rate, size=len(reqs))
+            )
+            cal_engine.clear_kv_blocks()
+            sem = asyncio.Semaphore(window)
+            done_t: list[float] = []
+            t0 = time.perf_counter()
+
+            async def probe_one(idx, r):
+                await asyncio.sleep(
+                    max(0.0, parr[idx] - (time.perf_counter() - t0))
+                )
+                async with sem:
+                    first, _ = await serve_once(
+                        cal_engine,
+                        make_req(*r[:2], 27_000 + r[2], r[3], r[4]), Context(),
+                    )
+                if first is not None:
+                    loaded_ttfts.append(first)
+                done_t.append(time.perf_counter() - t0)
+
+            await asyncio.gather(*(probe_one(i, r) for i, r in enumerate(reqs)))
+            # Steady-state service rate between the ramp and the drain
+            # tail (whole-run averages undercount a short trace badly).
+            done_t.sort()
+            lo, hi = window, max(window + 1, len(done_t) - window)
+            measured = (
+                (hi - lo) / (done_t[hi - 1] - done_t[lo - 1])
+                if done_t[hi - 1] > done_t[lo - 1]
+                else len(reqs) / done_t[-1]
+            )
+            _stage(f"pacing probe at {probe_rate:.1f} rps → steady {measured:.1f}")
+            kept_up = measured >= 0.9 * probe_rate
+            sat_rps = max(sat_rps, measured)
+            if not kept_up:
+                break  # the probe saturated: sat_rps is the real ceiling
+    finally:
+        await cal_engine.stop()
+    offered_rps = args.mt_overload * sat_rps
+    gaps = rng.exponential(1.0 / offered_rps, size=len(reqs))
+    arrivals = np.cumsum(gaps)
+    # SLOs scale with the measured chip under LOAD: the decode-window
+    # cadence at a full batch sets the first-token floor any admitted
+    # request pays (solo latency alone would set an unattainable bar on
+    # dispatch-bound hosts), so interactive = 2.5x the saturated probe's
+    # median TTFT — met when the queue is short, blown when it is not.
+    loaded_p50 = pctl(loaded_ttfts, 50) if loaded_ttfts else solo_ttft_ms / 1000.0
+    loaded_p95 = pctl(loaded_ttfts, 95) if loaded_ttfts else loaded_p50
+    # The saturated probe's tail is the attainability floor: an SLO
+    # below what the loaded engine delivers with NO queue at all would
+    # be unattainable by construction, not a scheduling target — the
+    # interactive SLO budgets the loaded service tail plus a short
+    # fair-share queue wait on top.
+    slo_i = max(3.0 * loaded_p50, 1.5 * loaded_p95,
+                8 * solo_ttft_ms / 1000.0, 0.05)
+    slo = {
+        "interactive": slo_i,
+        "standard": 3.0 * slo_i,
+        "batch": 0.0,  # completion is batch's SLO
+    }
+    prefill_interp = PrefillInterpolator(
+        np.array([s[0] for s in samples], np.float64),
+        np.array([s[1] for s in samples], np.float64),
+        np.array([1000.0] * len(samples), np.float64),
+    )
+    _stage(f"saturation {sat_rps:.1f} rps → offering {offered_rps:.1f} rps; "
+           f"SLOs i={slo['interactive']:.2f}s s={slo['standard']:.2f}s")
+
+    # -- one A/B arm -------------------------------------------------------
+    async def run_arm(qos_on: bool) -> dict:
+        engine = await TpuEngine(engine_args(qos_on), seed=0).start()
+        policy = QosPolicy(classes=[
+            QosClass("interactive", 2, 8, slo["interactive"]),
+            QosClass("standard", 1, 4, slo["standard"]),
+            QosClass("batch", 0, 1, 0.0),
+        ]) if qos_on else None
+        # Gate slots = engine slots: the class-aware gate owns the WHOLE
+        # queue (instant WDRR hand-off per release) instead of parking
+        # part of it in the engine's internal waiting line.
+        gate = AdmissionController(
+            max_inflight=max_num_seqs,
+            max_queue_depth=len(reqs),
+            queue_timeout=120.0,
+            qos=policy,
+            predictor=TtftPredictor(prefill=prefill_interp) if qos_on else None,
+        )
+        stats = {
+            c: {"good_tokens": 0, "tokens": 0, "completed": 0, "offered": 0,
+                "shed_early": 0, "shed_late": 0, "ttfts": []}
+            for c in classes
+        }
+        done_rel: list[float] = []  # completion offsets (pipeline-fill split)
+        try:
+            # Warmup compiles on this engine (the calibration-set shapes
+            # plus the longest prompts cover the trace's prefill-bucket
+            # lattice), then clean caches/counters.
+            warm_set = reqs[: 3 * max_num_seqs] + sorted(
+                reqs, key=lambda r: len(r[3]))[-8:]
+            warm_gate = asyncio.Semaphore(max_num_seqs)
+
+            async def warm_one(r):
+                async with warm_gate:
+                    await serve_once(
+                        engine,
+                        make_req(*r[:2], 30_000 + r[2], r[3], r[4]), Context(),
+                    )
+
+            await asyncio.gather(*(warm_one(r) for r in warm_set))
+            engine.clear_kv_blocks()
+            t_run0 = time.perf_counter()
+
+            async def one(idx, r):
+                cls, tenant, i, prompt, glen = r
+                await asyncio.sleep(max(0.0, arrivals[idx] -
+                                        (time.perf_counter() - t_run0)))
+                # Client clock starts at ARRIVAL: gate queue wait is part
+                # of the TTFT the tenant experiences, and the abandonment
+                # deadline runs from here whether the request is still
+                # queued (gave up waiting — no chips spent) or mid-stream
+                # (chips burned: the waste early rejection prevents).
+                t_arr = time.perf_counter()
+                st = stats[cls]
+                st["offered"] += 1
+                abandon = 3 * slo[cls] if slo[cls] > 0 else None
+                try:
+                    if abandon is not None:
+                        charge = await asyncio.wait_for(
+                            gate.acquire(cls if qos_on else None), abandon
+                        )
+                    else:
+                        charge = await gate.acquire(cls if qos_on else None)
+                except asyncio.TimeoutError:
+                    st["shed_late"] += 1  # abandoned while queued
+                    return
+                except AdmissionRejected:
+                    st["shed_early"] += 1  # at the door: no prefill spent
+                    return
+                ctx = Context()
+                t_adm = time.perf_counter()
+                try:
+                    task = asyncio.ensure_future(
+                        serve_once(engine, make_req(cls, tenant, i, prompt,
+                                                    glen), ctx)
+                    )
+                    if abandon is not None:
+                        left = abandon - (t_adm - t_arr)
+                        done, _ = await asyncio.wait({task}, timeout=max(0.0, left))
+                        if not done:
+                            # Client gave up mid-stream: chips already
+                            # burned on this request are pure waste.
+                            ctx.cancel()
+                            st["shed_late"] += 1
+                            with contextlib.suppress(Exception):
+                                await task
+                            return
+                        first, n_tok = task.result()
+                    else:
+                        first, n_tok = await task
+                    st["tokens"] += n_tok
+                    st["completed"] += 1
+                    done_rel.append(time.perf_counter() - t_run0)
+                    ttft = (
+                        (t_adm - t_arr) + first if first is not None else None
+                    )
+                    if ttft is not None:
+                        st["ttfts"].append((arrivals[idx], ttft))
+                    if n_tok >= 1 and (slo[cls] <= 0 or
+                                       (ttft is not None and ttft <= slo[cls])):
+                        st["good_tokens"] += n_tok
+                finally:
+                    gate.release(charge)
+
+            await asyncio.gather(*(one(i, r) for i, r in enumerate(reqs)))
+            elapsed = time.perf_counter() - t_run0
+            out = {
+                "elapsed_s": round(elapsed, 3),
+                "good_tokens": sum(s["good_tokens"] for s in stats.values()),
+                "tokens": sum(s["tokens"] for s in stats.values()),
+                "goodput_tok_s": round(
+                    sum(s["good_tokens"] for s in stats.values()) / elapsed, 2
+                ),
+                "delivered_tok_s": round(
+                    sum(s["tokens"] for s in stats.values()) / elapsed, 2
+                ),
+                "gate_sheds": {f"{c}/{r}": n for (c, r), n
+                               in gate.shed_counts.items()},
+                "preemptions_by_class": dict(engine.total_preemptions_by),
+                "tier_stats": engine.tiers.stats(),
+                "classes": {},
+            }
+            # Pipeline-fill split: the first max_num_seqs slots of a
+            # COLD system go to whichever classes arrive first — a
+            # bench-start transient, not a scheduling outcome (a real
+            # fleet is already full). Steady-state percentiles cover
+            # arrivals after the first slot-turnover completes.
+            fill_rel = (
+                sorted(done_rel)[min(max_num_seqs, len(done_rel)) - 1]
+                if done_rel else 0.0
+            )
+            out["pipeline_fill_s"] = round(fill_rel, 3)
+            for c in classes:
+                s = stats[c]
+                all_t = [t for _, t in s["ttfts"]]
+                steady = [t for a, t in s["ttfts"] if a >= fill_rel]
+                out["classes"][c] = {
+                    "offered": s["offered"],
+                    "completed": s["completed"],
+                    "shed_early": s["shed_early"],
+                    "shed_late": s["shed_late"],
+                    "good_tokens": s["good_tokens"],
+                    "goodput_tok_s": round(s["good_tokens"] / elapsed, 2),
+                    "ttft_p50_s": round(pctl(all_t, 50), 4),
+                    "ttft_p99_s": round(pctl(all_t, 99), 4),
+                    "ttft_p99_steady_s": round(pctl(steady or all_t, 99), 4),
+                }
+            return out
+        finally:
+            await engine.stop()
+
+    _stage("multi-tenant run: QoS on")
+    qos_run = await run_arm(True)
+    _stage(f"qos-on goodput {qos_run['goodput_tok_s']:.0f} tok/s")
+    _stage("multi-tenant run: FIFO baseline")
+    fifo_run = await run_arm(False)
+    _stage(f"fifo goodput {fifo_run['goodput_tok_s']:.0f} tok/s")
+
+    # -- single-class byte-identity: no-priority traffic through the QoS
+    # engine matches a qos_scheduling=off engine token for token.
+    eng_a = await TpuEngine(engine_args(True), seed=0).start()
+    eng_b = await TpuEngine(engine_args(False), seed=0).start()
+    try:
+        probe = reqs[:6]
+
+        async def streams(engine):
+            outs = await asyncio.gather(*(
+                serve_once(engine,
+                           make_req(r[0], r[1], 40_000 + r[2], r[3], r[4],
+                                    with_priority=False), Context())
+                for r in probe
+            ))
+            return [n for _, n in outs]
+
+        ident = await streams(eng_a) == await streams(eng_b)
+    finally:
+        await eng_a.stop()
+        await eng_b.stop()
+
+    sheds_early = sum(s["shed_early"] for s in
+                      (qos_run["classes"][c] for c in classes))
+    sheds_late = sum(s["shed_late"] for s in
+                     (qos_run["classes"][c] for c in classes))
+    early_frac = (
+        sheds_early / (sheds_early + sheds_late)
+        if sheds_early + sheds_late else 1.0
+    )
+    # Headline: SLO-attaining TOKENS on the identical offered schedule
+    # (both arms drain to completion, so a token ratio compares policy
+    # outcomes directly; per-second rates over a COMMON window ride
+    # along — batch has no deadline, and a policy that rightly defers
+    # it must not be billed for the longer drain tail twice).
+    common_t = max(qos_run["elapsed_s"], fifo_run["elapsed_s"])
+    for arm in (qos_run, fifo_run):
+        arm["goodput_tok_s_common_window"] = round(arm["good_tokens"] / common_t, 2)
+    ratio = qos_run["good_tokens"] / max(1, fifo_run["good_tokens"])
+    batch_done = qos_run["classes"]["batch"]["completed"]
+    batch_offered = qos_run["classes"]["batch"]["offered"]
+    result = {
+        "metric": "qos_goodput_ratio",
+        "value": round(ratio, 3),
+        "unit": "x SLO-attaining tokens vs FIFO at equal chip count",
+        "vs_baseline": round(ratio, 3),
+        "vs_baseline_basis": "identical seeded arrival schedule at "
+                             f"{args.mt_overload}x measured saturation, QoS "
+                             "stack vs plain FIFO gate at equal capacity",
+        "workload": "multi-tenant",
+        "model": model.name,
+        "device": device,
+        "num_requests": len(reqs),
+        "num_tenants": n_tenants,
+        "offered_rps": round(offered_rps, 2),
+        "saturation_rps": round(sat_rps, 2),
+        "overload_x": args.mt_overload,
+        "slo_s": {c: round(v, 3) for c, v in slo.items()},
+        "qos": qos_run,
+        "fifo": fifo_run,
+        "early_shed_frac": round(early_frac, 3),
+        "interactive_ttft_p99_s": qos_run["classes"]["interactive"]["ttft_p99_s"],
+        "interactive_ttft_p99_steady_s":
+            qos_run["classes"]["interactive"]["ttft_p99_steady_s"],
+        # Within-SLO is judged at steady state (post pipeline fill);
+        # the raw p99 incl. the cold-start transient rides alongside.
+        "interactive_ttft_within_slo":
+            qos_run["classes"]["interactive"]["ttft_p99_steady_s"]
+            <= slo["interactive"],
+        "batch_completed": batch_done,
+        "batch_offered": batch_offered,
+        "batch_zero_starvation":
+            batch_done + qos_run["classes"]["batch"]["shed_early"] >= batch_offered,
+        "tier_hit_rate": qos_run["tier_stats"].get("hit_rate"),
+        "single_class_byte_identical": ident,
+    }
+    if not ident:
+        result["error"] = "no-priority traffic diverged between qos on/off engines"
+    return result
+
+
 # The structured workload's shared extraction schema: mostly-forced JSON
 # structure around free value positions — the tool-call/JSON-extraction
 # serving shape. Field types cover string/int/bool/array paths.
@@ -1607,6 +2124,8 @@ def main():
             result = asyncio.run(bench_structured(args))
         elif args.workload == "multi-lora":
             result = asyncio.run(bench_multi_lora(args))
+        elif args.workload == "multi-tenant":
+            result = asyncio.run(bench_multi_tenant(args))
         else:
             result = asyncio.run(bench(args))
     except Exception as e:  # noqa: BLE001 — bench must always print a line

@@ -212,6 +212,15 @@ class EngineArgs:
     # throughput loss on ramp-up); too large starves running decodes.
     # 0 = admit until slots are full.
     admission_budget_tokens: int = 8192
+    # Multi-tenant QoS (runtime/qos.py, docs/qos.md): when True the
+    # scheduler orders admission and preemption by (priority class,
+    # age) — waiting interactive requests admit before batch, and KV-
+    # pressure preemption evicts the lowest class/newest-prefill victim
+    # first. Requests without a priority all land in one class, which
+    # makes the ordering EXACTLY the pre-QoS FIFO/newest-first rules —
+    # byte-identical streams for no-QoS traffic either way. False pins
+    # every request to one class regardless of wire priority.
+    qos_scheduling: bool = True
     # Keep decode windows in flight: window w+1 is dispatched chaining
     # from w's on-device outputs before w is fetched, hiding the
     # host↔device sync roundtrip (~100 ms on tunneled TPUs). Stops are

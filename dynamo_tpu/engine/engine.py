@@ -70,6 +70,7 @@ from dynamo_tpu.llm.protocols import (
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.qos import DEFAULT_CLASS, QOS_CLASSES, qos_rank
 from dynamo_tpu.tokens import (
     TokenBlockSequence,
     adapter_hash_seed,
@@ -151,6 +152,7 @@ class _Seq:
         "export_handle", "export_stream", "export_pub_blocks",
         "grammar", "grammar_state", "grammar_eos_bits",
         "adapter_id", "adapter_slot", "hash_seed",
+        "qos", "qos_rank", "arrival",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -216,6 +218,19 @@ class _Seq:
         self.adapter_id = getattr(req, "adapter_id", None)
         self.adapter_slot = -1
         self.hash_seed = adapter_hash_seed(self.adapter_id)
+        # Multi-tenant QoS: the request's priority class name (metrics
+        # label; unknown wire values fall back to the default class),
+        # its scheduling rank (generate() zeroes it when
+        # args.qos_scheduling is off), and the engine-assigned arrival
+        # number — the (class, age) sort key for admission order and
+        # preemption victim selection.
+        self.qos = (
+            getattr(req, "priority", None)
+            if getattr(req, "priority", None) in QOS_CLASSES
+            else DEFAULT_CLASS
+        )
+        self.qos_rank = qos_rank(getattr(req, "priority", None))
+        self.arrival = 0
         # Disaggregation (engine side of llm/disagg.py):
         ktp = req.kv_transfer_params or {}
         self.export = bool(ktp.get("do_remote_decode"))  # prefill-only + export KV
@@ -428,6 +443,13 @@ def register_engine_metrics(registry):
             "resolving adapter slots at admission, uploading factor "
             "pages, and building per-dispatch adapter_slot operands",
         ),
+        registry.counter(
+            "engine_preemptions_total",
+            "Recompute-preemptions under KV pressure by victim QoS "
+            "class (victims are lowest-class/newest-first; a preempted "
+            "request requeues and re-prefills, so its stream stays "
+            "byte-identical under greedy sampling)",
+        ),
     )
 
 
@@ -587,6 +609,15 @@ class TpuEngine:
         # n_emit tokens. Dense-only traffic sits at exactly 1.0.
         self.total_row_passes = 0
         self.total_row_tokens = 0
+        # Multi-tenant QoS: monotone submission counter (the age half of
+        # the (class, age) scheduling key; assigned under _wakeup at
+        # submission, read by the scheduler thread afterwards) and
+        # recompute-preemption counts by victim class (racy-total
+        # contract like the other total_* counters; _preempt_pushed
+        # tracks what _update_gauges already fed the labeled counter).
+        self._arrival_no = 0
+        self.total_preemptions_by: collections.Counter = collections.Counter()
+        self._preempt_pushed: dict[str, int] = {}
         # Cumulative counters for metrics/bench.
         self.total_generated = 0
         self.total_prefilled = 0
@@ -623,7 +654,7 @@ class TpuEngine:
         (g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp,
          g_kvb, g_kvq, c_tree, g_tree_depth, c_tier_prot, g_tier_hit,
          g_gram_seqs, g_gram_mask, c_budget,
-         g_lora_res, c_lora_swap, g_lora_s) = self._gauges
+         g_lora_res, c_lora_swap, g_lora_s, c_preempt) = self._gauges
         g_kvb.set(self.args.kv_bytes_per_block() * self.args.num_kv_blocks)
         g_kvq.set(1 if self.args.kv_quant == "int8" else 0)
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
@@ -659,6 +690,11 @@ class TpuEngine:
                 c_lora_swap.inc(self._lora_pool.pageins - self._ctr_pushed[5])
                 self._ctr_pushed[5] = self._lora_pool.pageins
         g_lora_s.set(self.total_lora_s)
+        for cls, n in self.total_preemptions_by.items():
+            pushed = self._preempt_pushed.get(cls, 0)
+            if n > pushed:
+                c_preempt.inc(n - pushed, **{"class": cls})
+                self._preempt_pushed[cls] = n
 
     def _phase(self, key: str, t0: float) -> float:
         """Accumulate perf_counter()-t0 into phase `key`; → new t0."""
@@ -959,9 +995,13 @@ class TpuEngine:
                 seq.eos_ids, self.cfg.vocab_size
             )
             self.total_grammar_seqs += 1
+        if not self.args.qos_scheduling:
+            seq.qos_rank = 0  # one class: FIFO admission, newest-first preempt
         with self._wakeup:
             if self._stopping:
                 raise RuntimeError("engine is stopping")
+            self._arrival_no += 1
+            seq.arrival = self._arrival_no
             self._submissions.append(seq)
             self._wakeup.notify()
 
@@ -1150,7 +1190,7 @@ class TpuEngine:
             and len(self._running) + len(allocated) < self.args.max_num_seqs
             and (wave_budget > 0 or not allocated)
         ):
-            seq = self._waiting.popleft()
+            seq = self._pop_next_waiting()
             if seq.cancelled:
                 self._post_done(seq)
                 continue
@@ -1161,7 +1201,7 @@ class TpuEngine:
                 self._waiting.appendleft(seq)  # try again when blocks free up
                 if not self._running and not allocated:
                     # Deadlock: nothing to free. Fail the request.
-                    self._waiting.popleft()
+                    self._waiting.remove(seq)
                     self._finish(seq, FinishReason.ERROR,
                                  error="prompt does not fit in KV cache")
                 break
@@ -1447,6 +1487,21 @@ class TpuEngine:
             self._post_done(seq)
 
     # -- admission / prefill ----------------------------------------------
+
+    def _pop_next_waiting(self) -> _Seq:
+        """(class, age)-ordered admission: the highest-rank class first,
+        oldest arrival within it — a waiting interactive request admits
+        ahead of queued batch work, including into blocks a batch
+        preemption just freed. Uniform-rank traffic (no-QoS, or
+        qos_scheduling off) reduces to EXACT FIFO: _waiting is
+        arrival-ordered (appendleft re-queues — preempted or
+        blocks-starved seqs — are always the oldest arrivals, since
+        admission itself drains oldest-first), so min arrival IS the
+        leftmost element and this selection is byte-identical to the
+        popleft it replaces."""
+        best = max(self._waiting, key=lambda s: (s.qos_rank, -s.arrival))
+        self._waiting.remove(best)
+        return best
 
     def _admit_alloc(self, seq: _Seq) -> int:
         """Phase 1 of admission: allocate KV blocks, resolve prefix hits
@@ -1891,13 +1946,30 @@ class TpuEngine:
                 return False
         return True
 
+    def _preempt_victim(self) -> _Seq:
+        """Class-aware victim selection: evict the LOWEST class first,
+        newest admission within it — the newest victim has the least
+        sunk prefill work, and a preempted batch request's freed blocks
+        admit the waiting interactive request on the next step. Uniform
+        ranks (no-QoS) select exactly ``self._running[-1]``, the
+        pre-QoS newest-first rule."""
+        best = self._running[-1]
+        for s in self._running:  # later index = newer admission event
+            if s.qos_rank <= best.qos_rank:
+                best = s
+        return best
+
     def _preempt(self, seq: _Seq) -> None:
         """Recompute-preemption: free blocks, requeue with all tokens as the
         new prompt (reference behaviour matches vLLM recompute mode)."""
         self._drain_completed(force=True)  # pending tokens must be host-visible
         if seq.dead or seq not in self._running:
             return  # resolution finished it (stop condition on token 1)
-        log.warning("preempting request %s (KV pressure)", seq.request_id)
+        log.warning(
+            "preempting request %s (KV pressure, class=%s)",
+            seq.request_id, seq.qos,
+        )
+        self.total_preemptions_by[seq.qos] += 1
         self._running.remove(seq)
         if seq.slot is not None:
             self._free_slots.append(seq.slot)
@@ -2080,7 +2152,7 @@ class TpuEngine:
             if len(self._running) == 1:
                 self._finish(blocked, FinishReason.LENGTH)
             else:
-                self._preempt(self._running[-1])
+                self._preempt(self._preempt_victim())
         if not self._running:
             self._drain_completed(force=True)
             return

@@ -395,11 +395,28 @@ class FleetSupervisor:
     async def _fleet_status(self, request: web.Request) -> web.Response:
         regs = await self.registrations()
         chunks = await self._store.get_prefix(budget_prefix(self.fleet_id))
+        # Per-class chunk accounting: QoS pools nest one level deeper
+        # (budget/<class>/<k>); legacy single-pool keys have a bare
+        # numeric tail and count under "shared".
+        per_class: dict[str, int] = {}
+        plen = len(budget_prefix(self.fleet_id))
+        for e in chunks:
+            tail = e.key[plen:]
+            cls = tail.split("/", 1)[0] if "/" in tail else "shared"
+            per_class[cls] = per_class.get(cls, 0) + 1
+        # Per-child admission-gate state (per-class queued/inflight,
+        # load-scaled retry_after, shed counts by reason) off each
+        # child's /debug/admission — the QoS half of fleet status.
+        admission = {
+            wid: data for wid, data in await self._scrape("/debug/admission")
+        }
         body = {
             "fleet_id": self.fleet_id,
             "port": self.port,
             "socket_mode": "inherit" if self._inherit_fd is not None else "reuseport",
             "budget_chunks_claimed": len(chunks),
+            "budget_chunks_by_class": per_class,
+            "admission": admission,
             "workers": [
                 {
                     "worker_id": s.worker_id,

@@ -65,6 +65,19 @@ def parse_args(argv=None):
     p.add_argument("--request-timeout", type=float, default=None,
                    help="default end-to-end deadline (s) when the client "
                         "sends no X-Request-Timeout (0 = none)")
+    # Multi-tenant QoS (docs/qos.md): priority classes on the admission
+    # gate (WDRR fair shares, aging, early rejection) and per-class
+    # fleet budget pools.
+    p.add_argument("--qos", action="store_true",
+                   help="enable priority classes (interactive/standard/"
+                        "batch via body 'priority' or x-priority header): "
+                        "weighted fair-share admission, class-aware fleet "
+                        "budget pools, SLO-predictive early rejection "
+                        "(also DYNTPU_QOS_ENABLED)")
+    p.add_argument("--qos-profile", default=None,
+                   help="profiled SLA npz (tools/profile_sweep.py) powering "
+                        "admission-time TTFT prediction; without it early "
+                        "rejection falls back to the observed drain rate")
     # Frontend fleet (docs/frontend-fleet.md). --fleet N supervises N
     # child copies of this CLI sharing one port; the remaining flags
     # configure fleet-wide behaviour and are inherited by children.
@@ -134,11 +147,69 @@ async def async_main(args) -> None:
     watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
 
     acfg = rt.config.admission
+    qcfg = rt.config.qos
+    qos_on = args.qos or qcfg.enabled
+    policy = predictor = None
+    if qos_on:
+        from dynamo_tpu.runtime.qos import QosPolicy, TtftPredictor
+
+        policy = QosPolicy.from_config(qcfg)
+        prefill = decode = None
+        if args.qos_profile:
+            from dynamo_tpu.planner.interpolate import load_profile
+
+            decode, prefill = load_profile(args.qos_profile)
+            log.info("qos: loaded SLA profile %s (prefill=%s decode=%s)",
+                     args.qos_profile, prefill is not None, decode is not None)
+        # Early rejection works from the observed drain rate alone when
+        # no profile is loaded; the profile adds the model-based term.
+        predictor = TtftPredictor(prefill=prefill, decode=decode)
     global_budget = (
         fcfg.global_max_inflight if args.global_max_inflight is None
         else args.global_max_inflight
     )
-    if fleet_child and global_budget > 0:
+    chunk_slots = (
+        fcfg.budget_chunk_slots if args.budget_chunk is None
+        else args.budget_chunk
+    )
+    budget_metrics = {
+        "slots": fleet_metrics["budget_slots"],
+        "chunks": fleet_metrics["budget_chunks"],
+        "claims": fleet_metrics["budget_claims"],
+    } if fleet_metrics else None
+    kw = {"retry_after": acfg.retry_after, "queue_timeout": acfg.queue_timeout}
+    qdepth = acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth
+    if fleet_child and global_budget > 0 and qos_on:
+        from dynamo_tpu.fleet.budget import (
+            ClassBudgetSet,
+            QosBudgetedAdmissionController,
+            split_class_budget,
+        )
+
+        # Per-CLASS chunk pools: the fleet-wide budget splits by the
+        # configured shares, each class leases its own chunk namespace
+        # (≤1-holder-per-chunk ⇒ fleet-wide per-class caps hold by
+        # construction), and lower classes scavenge idle higher-class
+        # chunks until a pressure beacon calls them home.
+        budget = ClassBudgetSet(
+            rt.store, args.fleet_id, await rt.primary_lease(),
+            totals=split_class_budget(global_budget, {
+                "interactive": qcfg.share_interactive,
+                "standard": qcfg.share_standard,
+                "batch": qcfg.share_batch,
+            }),
+            policy=policy,
+            chunk_slots=chunk_slots,
+            worker_id=args.fleet_worker_id,
+            metrics=budget_metrics,
+        )
+        if qdepth > 0:
+            kw["max_queue_depth"] = qdepth
+        admission: AdmissionController = QosBudgetedAdmissionController(
+            budget, predictor=predictor, **kw
+        )
+        await budget.start()
+    elif fleet_child and global_budget > 0:
         from dynamo_tpu.fleet.budget import BudgetedAdmissionController, GlobalBudget
 
         # Per-process gate leasing slot chunks from the fleet-wide
@@ -148,22 +219,13 @@ async def async_main(args) -> None:
         budget = GlobalBudget(
             rt.store, args.fleet_id, await rt.primary_lease(),
             total=global_budget,
-            chunk_slots=(
-                fcfg.budget_chunk_slots if args.budget_chunk is None
-                else args.budget_chunk
-            ),
+            chunk_slots=chunk_slots,
             worker_id=args.fleet_worker_id,
-            metrics={
-                "slots": fleet_metrics["budget_slots"],
-                "chunks": fleet_metrics["budget_chunks"],
-                "claims": fleet_metrics["budget_claims"],
-            },
+            metrics=budget_metrics,
         )
-        kw = {"retry_after": acfg.retry_after, "queue_timeout": acfg.queue_timeout}
-        qdepth = acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth
         if qdepth > 0:  # 0 = keep the controller's budget-aware default
             kw["max_queue_depth"] = qdepth
-        admission: AdmissionController = BudgetedAdmissionController(budget, **kw)
+        admission = BudgetedAdmissionController(budget, **kw)
         await budget.start()
     else:
         max_inflight = acfg.max_inflight if args.max_inflight is None else args.max_inflight
@@ -179,9 +241,11 @@ async def async_main(args) -> None:
             )
         admission = AdmissionController(
             max_inflight=max_inflight,
-            max_queue_depth=acfg.max_queue_depth if args.max_queue_depth is None else args.max_queue_depth,
+            max_queue_depth=qdepth,
             retry_after=acfg.retry_after,
             queue_timeout=acfg.queue_timeout,
+            qos=policy,
+            predictor=predictor,
         )
     default_timeout = (
         rt.config.runtime.default_request_timeout

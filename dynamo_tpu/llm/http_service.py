@@ -98,7 +98,27 @@ class HttpService:
             "admission_wait_seconds", "Time spent waiting at the admission gate"
         )
         self.m_queue_depth = scope.gauge(
-            "admission_queue_depth", "Requests queued at the admission gate"
+            "admission_queue_depth",
+            "Requests queued at the admission gate (total, and per QoS "
+            "class when a policy is installed)",
+        )
+        self.m_rejected = scope.counter(
+            "admission_rejected_total",
+            "Requests shed at the admission gate by QoS class and reason "
+            "(capacity / queue_timeout / slo_predicted / draining)",
+        )
+        self.m_pred_ttft = scope.histogram(
+            "admission_predicted_ttft_seconds",
+            "Admission-time TTFT predictions (queue depth x profiled "
+            "prefill curve) — what early rejection compares to the "
+            "class SLO",
+        )
+        # Route admission-gate predictions into the histogram (the gate
+        # itself stays metrics-free; this is its only metrics seam).
+        self.admission.predict_observer = (
+            lambda cls, seconds: self.m_pred_ttft.observe(
+                seconds, **{"class": cls}
+            )
         )
         self.m_deadline = scope.counter(
             "deadline_expired_total",
@@ -119,6 +139,7 @@ class HttpService:
         app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
         app.router.add_get("/debug/requests", self.handle_debug_requests)
         app.router.add_get("/debug/traces/{trace_id}", self.handle_debug_trace)
+        app.router.add_get("/debug/admission", self.handle_debug_admission)
         return app
 
     async def start(self) -> "HttpService":
@@ -308,6 +329,20 @@ class HttpService:
             return web.json_response({"error": f"unknown trace {trace_id}"}, status=404)
         return web.json_response(tracing.chrome_trace(trace_id, spans))
 
+    async def handle_debug_admission(self, request: web.Request) -> web.Response:
+        """Per-class admission-gate state: queued/inflight, load-scaled
+        Retry-After, and shed counts by reason — the fleet supervisor
+        scrapes this per child into the ``/fleet`` status body."""
+        body = self.admission.stats()
+        pred = getattr(self.admission, "predictor", None)
+        if pred is not None:
+            body["predictor"] = {
+                "prompt_len_ema": round(pred.prompt_len_ema, 1),
+                "drain_interval_s": round(self.admission.drain_interval_s, 4),
+                "profiled": pred.prefill is not None,
+            }
+        return web.json_response(body)
+
     # -- inference surface -------------------------------------------------
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
@@ -344,8 +379,43 @@ class HttpService:
         return timeout
 
     def _retry_after(self, seconds: float | None = None) -> dict[str, str]:
-        secs = seconds if seconds is not None else self.admission.retry_after
+        # Default to the gate's LOAD-SCALED value (base + expected wait
+        # from the measured drain rate), not the static base: 429/503
+        # backoff should track the queue, or clients retry into the
+        # same wall.
+        secs = seconds if seconds is not None else self.admission.retry_after_for()
         return {"Retry-After": str(max(1, math.ceil(secs)))}
+
+    @staticmethod
+    def _qos_headers(request: web.Request) -> tuple[str | None, str | None]:
+        """``x-priority`` / ``x-tenant`` headers → validated (priority,
+        tenant). Junk raises a typed 400 :class:`OpenAIError` — headers
+        are the pre-body QoS signal (admission runs before the body is
+        read), so they must be validated even earlier."""
+        from dynamo_tpu.runtime.qos import parse_priority, parse_tenant
+
+        priority = tenant = None
+        raw_p = request.headers.get("x-priority")
+        if raw_p is not None:
+            try:
+                priority = parse_priority(raw_p)
+            except ValueError as e:
+                raise OpenAIError(f"invalid x-priority header: {e}") from None
+        raw_t = request.headers.get("x-tenant")
+        if raw_t is not None:
+            try:
+                tenant = parse_tenant(raw_t)
+            except ValueError as e:
+                raise OpenAIError(f"invalid x-tenant header: {e}") from None
+        return priority, tenant
+
+    def _set_queue_gauges(self) -> None:
+        self.m_queue_depth.set(self.admission.queued)
+        if self.admission.qos is not None:
+            for c in self.admission.qos.order:
+                self.m_queue_depth.set(
+                    self.admission.queued_in(c), **{"class": c}
+                )
 
     async def _handle_inference(self, request: web.Request, kind: str) -> web.StreamResponse:
         """Tracing shell around the real handler: opens the root span (from
@@ -412,20 +482,31 @@ class HttpService:
     ) -> web.StreamResponse:
         endpoint = self._ENDPOINT_LABEL[kind]
         model = "unknown"
+        try:
+            # Pre-body QoS identity: headers carry the class the gate
+            # admits under (the body is not read yet — shedding must stay
+            # O(1)); body fields refine the stamped identity after parse.
+            hdr_priority, hdr_tenant = self._qos_headers(request)
+        except OpenAIError as e:
+            info["status"] = str(e.status)
+            self.m_requests.inc(model=model, endpoint=endpoint, status=str(e.status))
+            return web.json_response(e.body(), status=e.status)
         adm_span = tracing.start_span(
             "http.admission",
             parent=root.trace_context() if root.recording else None,
         )
         t_adm = time.perf_counter()
         try:
-            await self.admission.acquire()
+            qos_charge = await self.admission.acquire(hdr_priority)
         except AdmissionRejected as e:
             # Shed, don't queue: 503 while draining (instance going away),
-            # 429 under overload — both tell the client when to come back.
+            # 429 under overload — both tell the client when to come back
+            # with a load-scaled Retry-After.
             adm_span.end(status="shed")
             status = 503 if e.draining else 429
             info["status"] = str(status)
             self.m_shed.inc(endpoint=endpoint, status=str(status))
+            self.m_rejected.inc(**{"class": e.qos, "reason": e.reason})
             self.m_requests.inc(model=model, endpoint=endpoint, status=str(status))
             err = OpenAIError(str(e), status=status, err_type="overloaded_error")
             return web.json_response(
@@ -440,15 +521,24 @@ class HttpService:
             adm_span.end()
         finally:
             self.m_admission_wait.observe(time.perf_counter() - t_adm)
-            self.m_queue_depth.set(self.admission.queued)
+            self._set_queue_gauges()
         try:
             try:
                 body = await request.json()
             except (json.JSONDecodeError, UnicodeDecodeError):
                 raise OpenAIError("request body must be valid JSON") from None
             req = self._PARSERS[kind](body)
+            # Merge header-supplied QoS identity (body fields win on
+            # conflict — the body is the canonical OpenAI surface; the
+            # headers exist so proxies can tag without body rewrites).
+            if req.priority is None:
+                req.priority = hdr_priority
+            if req.tenant is None:
+                req.tenant = hdr_tenant
             model = req.model
             info["model"] = model
+            if req.tenant is not None and root.recording:
+                root.set_attrs(tenant=req.tenant, qos=qos_charge)
             pipe = self.manager.get(req.model)
             if pipe is None:
                 raise OpenAIError(f"model {req.model!r} not found", status=404, err_type="not_found_error")
@@ -503,8 +593,14 @@ class HttpService:
             err = OpenAIError("internal error", status=500, err_type="internal_error")
             return web.json_response(err.body(), status=500)
         finally:
-            self.admission.release()
-            self.m_queue_depth.set(self.admission.queued)
+            self.admission.release(qos_charge)
+            self._set_queue_gauges()
+            # Feed the TTFT predictor the observed prompt length: the
+            # gate admits before the body is parsed, so it can only know
+            # TYPICAL prompts — this is where "typical" comes from.
+            pred = getattr(self.admission, "predictor", None)
+            if pred is not None and info.get("prompt_tokens"):
+                pred.observe_prompt_len(info["prompt_tokens"])
 
     async def _stream(
         self, request: web.Request, pipe, req, ctx: Context, model: str, endpoint: str,

@@ -162,6 +162,11 @@ class OpenAIPreprocessor:
             # to a resident bank slot and the router keys KV stickiness by
             # (model, adapter).
             adapter_id=(self.card.lora or {}).get("adapter_id"),
+            # Multi-tenant QoS identity: validated at parse time (body
+            # fields / headers), carried to the engine so admission
+            # ordering and preemption are class-aware end to end.
+            priority=getattr(req, "priority", None),
+            tenant=getattr(req, "tenant", None),
         )
 
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:

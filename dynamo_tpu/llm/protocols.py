@@ -135,6 +135,14 @@ class PreprocessedRequest:
     # bank slot at admission (engine/lora.py) and the kv_router salts
     # block hashes with it so KV stickiness is keyed by (model, adapter).
     adapter_id: str | None = None
+    # Multi-tenant QoS (runtime/qos.py): the request's priority class
+    # ("interactive"/"standard"/"batch") and tenant id, validated at the
+    # HTTP boundary and carried over the wire so the engine's admission
+    # ordering and preemption victim selection are class-aware. Absent
+    # (None) = no QoS — the wire dict omits both keys, byte-identical
+    # to the pre-QoS format.
+    priority: str | None = None
+    tenant: str | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -152,6 +160,10 @@ class PreprocessedRequest:
             d["response_format"] = self.response_format
         if self.adapter_id is not None:
             d["adapter_id"] = self.adapter_id
+        if self.priority is not None:
+            d["priority"] = self.priority
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
         return d
 
     @classmethod
@@ -167,6 +179,8 @@ class PreprocessedRequest:
             kv_transfer_params=d.get("kv_transfer_params"),
             response_format=d.get("response_format"),
             adapter_id=d.get("adapter_id"),
+            priority=d.get("priority"),
+            tenant=d.get("tenant"),
         )
 
 
@@ -349,6 +363,35 @@ def validate_response_format(d: dict) -> dict | None:
     )
 
 
+def parse_qos_fields(d: dict) -> tuple[str | None, str | None]:
+    """Parse + validate the OpenAI-surface QoS extension fields
+    (``priority`` ∈ interactive/standard/batch, ``tenant`` a bounded
+    printable id) → (priority, tenant), both None when absent. Junk
+    raises a typed 400 :class:`OpenAIError` — validation happens at the
+    boundary, never mid-stream (the engine treats unknown wire values
+    as the default class)."""
+    from dynamo_tpu.runtime.qos import parse_priority, parse_tenant
+
+    priority = tenant = None
+    raw_p = d.get("priority")
+    if raw_p is not None:
+        if not isinstance(raw_p, str):
+            raise OpenAIError("'priority' must be a string")
+        try:
+            priority = parse_priority(raw_p)
+        except ValueError as e:
+            raise OpenAIError(str(e)) from None
+    raw_t = d.get("tenant")
+    if raw_t is not None:
+        if not isinstance(raw_t, str):
+            raise OpenAIError("'tenant' must be a string")
+        try:
+            tenant = parse_tenant(raw_t)
+        except ValueError as e:
+            raise OpenAIError(str(e)) from None
+    return priority, tenant
+
+
 def _parse_stop(d: dict) -> list[str]:
     stop = d.get("stop")
     if stop is None:
@@ -389,6 +432,11 @@ class ChatCompletionRequest:
     # {"type": "json_schema", "json_schema": {"schema": ...}} — compiled
     # to a token-mask FSM engine-side (engine/grammar.py).
     response_format: dict[str, Any] | None = None
+    # Multi-tenant QoS extension fields (validated; None = unset). The
+    # x-priority/x-tenant headers fill these when the body omits them
+    # (body wins on conflict) — see HttpService._merge_qos.
+    priority: str | None = None
+    tenant: str | None = None
     annotations: list[str] = field(default_factory=list)  # nvext-style debug annotations
     raw: dict[str, Any] = field(default_factory=dict)
 
@@ -417,6 +465,7 @@ class ChatCompletionRequest:
             if not d.get("logprobs"):
                 raise OpenAIError("'top_logprobs' requires 'logprobs': true")
         ext = d.get("nvext") or d.get("ext") or {}
+        priority, tenant = parse_qos_fields(d)
         return cls(
             model=model,
             messages=[ChatMessage.parse(m) for m in msgs],
@@ -436,6 +485,8 @@ class ChatCompletionRequest:
             min_tokens=d.get("min_tokens"),
             ignore_eos=bool(d.get("ignore_eos", False)),
             response_format=validate_response_format(d),
+            priority=priority,
+            tenant=tenant,
             annotations=list(ext.get("annotations") or []),
             raw=d,
         )
@@ -458,6 +509,8 @@ class CompletionRequest:
     stop: list[str] = field(default_factory=list)
     min_tokens: int | None = None
     ignore_eos: bool = False
+    priority: str | None = None
+    tenant: str | None = None
     annotations: list[str] = field(default_factory=list)
     raw: dict[str, Any] = field(default_factory=dict)
 
@@ -485,6 +538,7 @@ class CompletionRequest:
         elif logprobs is not None and (not isinstance(logprobs, int) or logprobs < 0):
             raise OpenAIError("'logprobs' must be a non-negative integer")
         ext = d.get("nvext") or d.get("ext") or {}
+        priority, tenant = parse_qos_fields(d)
         return cls(
             model=model,
             prompt=prompt,
@@ -499,6 +553,8 @@ class CompletionRequest:
             stop=_parse_stop(d),
             min_tokens=d.get("min_tokens"),
             ignore_eos=bool(d.get("ignore_eos", False)),
+            priority=priority,
+            tenant=tenant,
             annotations=list(ext.get("annotations") or []),
             raw=d,
         )
@@ -530,6 +586,8 @@ class ResponsesRequest:
     # response_format shape (json_object / json_schema — the Responses
     # flavor flattens name/schema/strict into the format object).
     response_format: dict[str, Any] | None = None
+    priority: str | None = None
+    tenant: str | None = None
     raw: dict[str, Any] = field(default_factory=dict)
 
     _UNSUPPORTED = (
@@ -622,6 +680,7 @@ class ResponsesRequest:
         max_out = d.get("max_output_tokens")
         if max_out is not None and (not isinstance(max_out, int) or max_out < 1):
             raise OpenAIError("'max_output_tokens' must be a positive integer")
+        priority, tenant = parse_qos_fields(d)
         return cls(
             model=model,
             messages=messages,
@@ -633,6 +692,8 @@ class ResponsesRequest:
             seed=d.get("seed"),
             instructions=instructions,
             response_format=cls._parse_text_format(d),
+            priority=priority,
+            tenant=tenant,
             raw=d,
         )
 
@@ -688,6 +749,8 @@ class ResponsesRequest:
             top_k=self.top_k,
             seed=self.seed,
             response_format=self.response_format,
+            priority=self.priority,
+            tenant=self.tenant,
             raw=self.raw,
         )
 

@@ -124,6 +124,48 @@ class AdmissionConfig:
 
 
 @dataclass
+class QosConfig:
+    """Multi-tenant QoS (section ``[qos]``, env ``DYNTPU_QOS_*``):
+    priority classes, WDRR fair-share weights, per-class TTFT/ITL SLOs,
+    and the anti-starvation aging bonus (see runtime/qos.py and
+    docs/qos.md). ``enabled`` gates the whole feature — off (the
+    default) keeps every request in ``default_class`` and the admission
+    gate byte-identical to the pre-QoS FIFO path."""
+
+    enabled: bool = False
+    # Class every request without a priority resolves to.
+    default_class: str = "standard"
+    # WDRR weights: the share of freed admission slots each class with
+    # demand receives per replenish round.
+    weight_interactive: int = 8
+    weight_standard: int = 4
+    weight_batch: int = 1
+    # TTFT SLOs (s) the early-rejection predictor enforces per class
+    # (0 = never early-reject this class).
+    ttft_slo_interactive_s: float = 2.0
+    ttft_slo_standard_s: float = 10.0
+    ttft_slo_batch_s: float = 60.0
+    # ITL SLOs (s/token; 0 = none) — goodput accounting inputs.
+    itl_slo_interactive_s: float = 0.2
+    itl_slo_standard_s: float = 1.0
+    itl_slo_batch_s: float = 0.0
+    # A class whose head-of-queue waiter has waited this long earns one
+    # bonus WDRR credit per replenish round (bounds batch's worst-case
+    # wait under sustained interactive overload; 0 disables aging).
+    aging_s: float = 5.0
+    # Fleet-wide per-class budget shares (relative; normalized over the
+    # sum). Drives how --global-max-inflight splits into per-class
+    # chunk pools when QoS is enabled in fleet mode.
+    share_interactive: int = 8
+    share_standard: int = 4
+    share_batch: int = 4
+
+    @classmethod
+    def section(cls) -> str:
+        return "qos"
+
+
+@dataclass
 class ChaosConfig:
     """Deterministic fault injection (section ``[chaos]``, env
     ``DYNTPU_CHAOS_*``). Off by default; when enabled, the messaging layer
@@ -190,6 +232,7 @@ class Config:
     store: StoreConfig = field(default_factory=StoreConfig)
     system: SystemConfig = field(default_factory=SystemConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    qos: QosConfig = field(default_factory=QosConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
 
@@ -209,7 +252,7 @@ class Config:
                 layers = tomllib.load(f)
 
         cfg = cls()
-        for section_obj in (cfg.runtime, cfg.store, cfg.system, cfg.admission, cfg.chaos, cfg.fleet):
+        for section_obj in (cfg.runtime, cfg.store, cfg.system, cfg.admission, cfg.qos, cfg.chaos, cfg.fleet):
             section = section_obj.section()
             toml_section = layers.get(section, {})
             for f_ in dataclasses.fields(section_obj):

@@ -115,6 +115,12 @@ def parse_args(argv=None):
     # requests decode under the adapter — base and adapter rows share
     # every batch via the gathered LoRA matmul. More adapters than slots
     # page through the G2/G3 tier economy on demand.
+    p.add_argument("--qos-sched", choices=["on", "off"], default="on",
+                   help="class-aware engine scheduling: admission and "
+                        "KV-pressure preemption ordered by (priority "
+                        "class, age). No-priority traffic is byte-"
+                        "identical either way; off pins one class "
+                        "(docs/qos.md)")
     p.add_argument("--lora-slots", type=int, default=0,
                    help="device-resident LoRA adapter slots (0 = LoRA off)")
     p.add_argument("--lora-rank", type=int, default=8,
@@ -519,6 +525,7 @@ def _engine_args(args, model):
         spec_budget_adaptive=args.spec_budget == "adaptive",
         lora_slots=args.lora_slots,
         lora_rank=max([args.lora_rank] + [r for _, r, _ in args.lora_specs]),
+        qos_scheduling=args.qos_sched == "on",
         # Grammar token-mask FSMs compile over the SERVING tokenizer's
         # vocabulary (engine/grammar.py) — response_format masks must
         # legalize exactly the ids the detokenizer can render.

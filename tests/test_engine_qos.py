@@ -1,0 +1,206 @@
+"""Engine-side multi-tenant QoS: (class, age)-ordered admission,
+class-aware preemption victim selection, per-class preemption counters,
+and the byte-identity guarantees (no-priority traffic identical with
+qos_scheduling on/off; a preempted-then-readmitted batch request still
+streams byte-identical to a solo run)."""
+
+import asyncio
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+CFG = ModelConfig()  # test-tiny
+
+
+def make_args(**kw) -> EngineArgs:
+    defaults = dict(
+        model=CFG, block_size=4, num_kv_blocks=64, max_num_seqs=4,
+        max_model_len=128, max_prefill_tokens=64, dtype="float32",
+    )
+    defaults.update(kw)
+    return EngineArgs(**defaults)
+
+
+def qos_request(prompt, max_tokens=8, priority=None, seed=0) -> PreprocessedRequest:
+    req = PreprocessedRequest(model="t", token_ids=list(prompt), priority=priority)
+    req.sampling.temperature = 0.0
+    req.sampling.seed = seed  # greedy, but unseeded requests draw global RNG (DT004)
+    req.stop.max_tokens = max_tokens
+    req.stop.ignore_eos = True
+    return req
+
+
+async def run_one(engine, req, ctx=None):
+    outs = []
+    async for item in engine.generate(req, ctx or Context()):
+        outs.append(item)
+    return outs
+
+
+def collect_tokens(outs):
+    return [t for o in outs for t in o.get("token_ids", [])]
+
+
+def test_waiting_interactive_admits_before_earlier_batch():
+    """One decode slot: while a standard request runs, a batch request
+    queues FIRST and an interactive request second — the interactive
+    one must be admitted (and so finish) first. This is also the
+    preemption hand-back property: a preempted batch request re-enters
+    _waiting with its original class, so a waiting interactive request
+    takes the freed capacity ahead of it."""
+
+    async def go():
+        engine = await TpuEngine(make_args(max_num_seqs=1)).start()
+        order: list[str] = []
+        try:
+            async def run(tag, req, delay):
+                await asyncio.sleep(delay)
+                outs = await run_one(engine, req)
+                order.append(tag)
+                return outs
+
+            await asyncio.gather(
+                run("first", qos_request([1, 2, 3], 24), 0.0),
+                run("batch", qos_request([4, 5, 6], 8, priority="batch"), 0.05),
+                run("interactive",
+                    qos_request([7, 8, 9], 8, priority="interactive"), 0.1),
+            )
+            assert order == ["first", "interactive", "batch"]
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_preemption_evicts_lowest_class_and_batch_still_finishes_identical():
+    """KV pressure with a batch + an interactive long generation
+    running: the victim must be the BATCH sequence (lowest class) even
+    though the interactive one was admitted later (the pre-QoS rule
+    would evict newest = interactive). The preempted batch request
+    recomputes and still streams byte-identical to a solo run, and
+    engine_preemptions_total{class="batch"} counts it."""
+
+    async def go():
+        # 12 blocks: a solo 32-token run fits (8 blocks + decode
+        # lookahead ≤ 11) but ANY meaningful overlap of the two
+        # sequences (15 blocks combined at peak) forces preemption even
+        # when host load staggers their admissions by a window or two.
+        engine = await TpuEngine(
+            make_args(num_kv_blocks=12, max_model_len=32, max_num_seqs=2)
+        ).start()
+        registry = MetricsRegistry()
+        engine.bind_metrics(registry)
+        try:
+            pb, pi = [1, 2, 3, 4, 5, 6], [9, 8, 7, 6, 5, 4]
+
+            async def staggered(req, delay):
+                await asyncio.sleep(delay)
+                return await run_one(engine, req)
+
+            # The stagger makes batch the OLDER running sequence (the
+            # legacy newest-first rule would then evict interactive); a
+            # loaded host can stretch the gap until batch finishes solo,
+            # so retry the race a few times — the class assertions hold
+            # on every attempt, the preemption only needs to fire once.
+            rb = ri = None
+            for _attempt in range(4):
+                rb, ri = await asyncio.gather(
+                    staggered(qos_request(pb, 26, priority="batch"), 0.0),
+                    staggered(qos_request(pi, 20, priority="interactive"), 0.002),
+                )
+                assert engine.total_preemptions_by.get("interactive", 0) == 0, (
+                    "interactive was evicted while a batch victim ran"
+                )
+                if engine.total_preemptions_by.get("batch", 0) >= 1:
+                    break
+            assert engine.total_preemptions_by.get("batch", 0) >= 1, (
+                "KV pressure never preempted in 4 attempts (geometry regressed?)"
+            )
+            # Preempted-then-readmitted batch stream is byte-identical.
+            solo_b = await run_one(engine, qos_request(pb, 26, priority="batch"))
+            assert collect_tokens(rb) == collect_tokens(solo_b)
+            assert len(collect_tokens(ri)) == 20
+            exposition = registry.render()
+            assert 'dynamo_tpu_engine_preemptions_total{class="batch"}' in exposition
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_no_priority_traffic_byte_identical_with_qos_on_and_off():
+    """The no-QoS guarantee: requests without a priority produce the
+    SAME streams whether class-aware scheduling is on (default) or
+    off — uniform ranks make the (class, age) order exactly FIFO and
+    the victim rule exactly newest-first, including through a
+    preemption cycle."""
+
+    async def go():
+        geo = dict(num_kv_blocks=14, max_model_len=32, max_num_seqs=2)
+        prompts = ([1, 2, 3, 4, 5, 6], [9, 8, 7, 6, 5, 4])
+        streams = {}
+        for mode in (True, False):
+            engine = await TpuEngine(
+                make_args(qos_scheduling=mode, **geo)
+            ).start()
+            try:
+                r1, r2 = await asyncio.gather(
+                    run_one(engine, qos_request(prompts[0], 20)),
+                    run_one(engine, qos_request(prompts[1], 20)),
+                )
+                streams[mode] = (collect_tokens(r1), collect_tokens(r2))
+            finally:
+                await engine.stop()
+        assert streams[True] == streams[False]
+        assert all(len(s) == 20 for s in streams[True])
+
+    asyncio.run(go())
+
+
+def test_qos_scheduling_off_ignores_wire_priority():
+    """--qos-sched off pins one class: priorities on the wire no longer
+    reorder admission (FIFO by arrival, the pre-QoS contract)."""
+
+    async def go():
+        engine = await TpuEngine(
+            make_args(max_num_seqs=1, qos_scheduling=False)
+        ).start()
+        order: list[str] = []
+        try:
+            async def run(tag, req, delay):
+                await asyncio.sleep(delay)
+                outs = await run_one(engine, req)
+                order.append(tag)
+                return outs
+
+            await asyncio.gather(
+                run("first", qos_request([1, 2, 3], 24), 0.0),
+                run("batch", qos_request([4, 5, 6], 8, priority="batch"), 0.05),
+                run("interactive",
+                    qos_request([7, 8, 9], 8, priority="interactive"), 0.1),
+            )
+            assert order == ["first", "batch", "interactive"]
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())
+
+
+def test_unknown_wire_priority_never_crashes_engine():
+    """A stale/newer frontend may stamp a class this engine doesn't
+    know: it must serve as the default class, not crash."""
+
+    async def go():
+        engine = await TpuEngine(make_args()).start()
+        try:
+            req = qos_request([1, 2, 3], 4)
+            req.priority = "hyperspeed"  # junk straight on the wire
+            outs = await run_one(engine, req)
+            assert len(collect_tokens(outs)) == 4
+        finally:
+            await engine.stop()
+
+    asyncio.run(go())

@@ -11,6 +11,8 @@ lease TTL on crash)."""
 import asyncio
 import time
 
+import pytest
+
 from dynamo_tpu.fleet.budget import (
     BudgetedAdmissionController,
     GlobalBudget,
@@ -299,5 +301,222 @@ def test_stale_delete_echo_does_not_evict_reclaimed_chunk():
         await asyncio.sleep(0.1)
         assert idx not in b.held
         await b.close()
+
+    asyncio.run(go())
+
+
+# -- per-class QoS pools (multi-tenant fair shares) --------------------------
+
+
+from dynamo_tpu.fleet.budget import (  # noqa: E402
+    ClassBudgetSet,
+    QosBudgetedAdmissionController,
+    pressure_prefix,
+    split_class_budget,
+)
+from dynamo_tpu.runtime.qos import QosPolicy  # noqa: E402
+
+
+def test_split_class_budget_partitions_exactly():
+    assert split_class_budget(16, {"interactive": 8, "standard": 4, "batch": 4}) == {
+        "interactive": 8, "standard": 4, "batch": 4,
+    }
+    got = split_class_budget(10, {"interactive": 8, "standard": 4, "batch": 4})
+    assert sum(got.values()) == 10
+    assert all(v >= 1 for v in got.values())  # positive shares never shut out
+    assert got["interactive"] > got["batch"]
+    assert split_class_budget(0, {"interactive": 1}) == {"interactive": 0}
+    assert split_class_budget(5, {"interactive": 1, "batch": 0}) == {
+        "interactive": 5, "batch": 0,
+    }
+    for total in (1, 2, 3, 7, 100):
+        got = split_class_budget(total, {"a": 3, "b": 2, "c": 1})
+        assert sum(got.values()) == total
+
+
+async def _make_qos(store, fleet_id, totals, worker_id, ttl=30.0, borrow=True, **kw):
+    lease = await store.grant_lease(ttl)
+    budgets = ClassBudgetSet(
+        store, fleet_id, lease, totals=totals, policy=QosPolicy(aging_s=0.0),
+        chunk_slots=2, worker_id=worker_id, borrow=borrow,
+    )
+    ctl = QosBudgetedAdmissionController(budgets, **kw)
+    await budgets.start()
+    return budgets, ctl
+
+
+def test_per_class_caps_never_exceeded_across_controllers():
+    """The per-class hammer: 3 controllers (no borrowing) × concurrent
+    acquires of every class — the instantaneous fleet-wide admitted
+    count PER CLASS must never exceed that class's pool, enforced
+    structurally by the per-class chunk namespaces."""
+
+    async def go():
+        store = MemoryStore()
+        totals = {"interactive": 8, "standard": 4, "batch": 4}
+        parts = [
+            await _make_qos(store, "qinv", totals, i, borrow=False,
+                            queue_timeout=6.0, max_queue_depth=200)
+            for i in range(3)
+        ]
+        admitted = {c: 0 for c in totals}
+        peak = {c: 0 for c in totals}
+        served = {c: 0 for c in totals}
+        lock = asyncio.Lock()
+
+        async def one(ctl, cls):
+            try:
+                charge = await ctl.acquire(cls)
+            except AdmissionRejected:
+                return
+            async with lock:
+                admitted[charge] += 1
+                peak[charge] = max(peak[charge], admitted[charge])
+            await asyncio.sleep(0.04)
+            async with lock:
+                admitted[charge] -= 1
+                served[charge] += 1
+            ctl.release(charge)
+
+        jobs = []
+        for _, ctl in parts:
+            for cls, n in (("interactive", 16), ("standard", 10), ("batch", 10)):
+                jobs += [one(ctl, cls) for _ in range(n)]
+        await asyncio.gather(*jobs)
+        for cls, cap in totals.items():
+            assert peak[cls] <= cap, (
+                f"{cls} over its cap: peak {peak[cls]} > {cap}"
+            )
+            # The pool was actually usable under full demand.
+            assert served[cls] >= cap, f"{cls} underused: {served[cls]}"
+        for b, _ in parts:
+            await b.close()
+        assert await store.get_prefix(budget_prefix("qinv")) == []
+
+    asyncio.run(go())
+
+
+def test_batch_borrows_idle_interactive_capacity():
+    """Work conservation downward: with the interactive pool idle, a
+    batch surge past its own pool claims interactive chunks through the
+    scavenger and ALL of it admits."""
+
+    async def go():
+        store = MemoryStore()
+        totals = {"interactive": 8, "standard": 0, "batch": 4}
+        budgets, ctl = await _make_qos(
+            store, "borrow", totals, 0, queue_timeout=6.0, max_queue_depth=50,
+        )
+        charges = await asyncio.gather(*(ctl.acquire("batch") for _ in range(10)))
+        assert all(c == "batch" for c in charges)
+        assert ctl.inflight_in("batch") == 10  # 4 own + 6 borrowed
+        scav_held = sum(b.held_slots for b in budgets.scav["batch"])
+        assert scav_held >= 6, f"scavenger holds only {scav_held}"
+        # Borrowed chunks are REAL leases on the interactive pool.
+        inter = await store.get_prefix(budget_prefix("borrow", "interactive"))
+        assert len(inter) >= 3
+        for c in charges:
+            ctl.release(c)
+        await budgets.close()
+
+    asyncio.run(go())
+
+
+def test_interactive_never_borrows_batch_capacity():
+    """The reverse direction must NOT borrow: interactive past its own
+    pool queues/sheds even while the batch pool sits idle."""
+
+    async def go():
+        store = MemoryStore()
+        totals = {"interactive": 2, "standard": 0, "batch": 8}
+        budgets, ctl = await _make_qos(
+            store, "noup", totals, 0, queue_timeout=0.4, max_queue_depth=10,
+        )
+        a = await ctl.acquire("interactive")
+        b = await ctl.acquire("interactive")
+        with pytest.raises(AdmissionRejected) as ei:
+            await ctl.acquire("interactive")
+        assert ei.value.reason == "queue_timeout"
+        batch_keys = await store.get_prefix(budget_prefix("noup", "batch"))
+        assert batch_keys == []  # nothing ever touched the batch pool
+        ctl.release(a)
+        ctl.release(b)
+        await budgets.close()
+
+    asyncio.run(go())
+
+
+def test_borrowed_capacity_returns_under_donor_pressure():
+    """Never the reverse under pressure: a batch borrower yields its
+    interactive chunks once ANY fleet member beacons interactive
+    demand — the donor class reclaims its pool as borrowed requests
+    finish."""
+
+    async def go():
+        store = MemoryStore()
+        totals = {"interactive": 6, "standard": 0, "batch": 2}
+        b_borrow, ctl_borrow = await _make_qos(
+            store, "press", totals, 0, queue_timeout=8.0, max_queue_depth=50,
+        )
+        b_inter, ctl_inter = await _make_qos(
+            store, "press", totals, 1, queue_timeout=8.0, max_queue_depth=50,
+        )
+        # Worker 0: batch fills its pool and borrows most of interactive's.
+        charges = await asyncio.gather(*(ctl_borrow.acquire("batch") for _ in range(7)))
+        assert sum(b.held_slots for b in b_borrow.scav["batch"]) >= 4
+        # Worker 1: interactive demand arrives → starvation beacons up →
+        # scavenger yields as batch releases → interactive admits fully.
+        async def want_interactive(n):
+            got = await asyncio.gather(
+                *(ctl_inter.acquire("interactive") for _ in range(n))
+            )
+            return got
+
+        task = asyncio.ensure_future(want_interactive(5))
+        await asyncio.sleep(0.3)  # let the beacon propagate
+        beacons = await store.get_prefix(pressure_prefix("press", "interactive"))
+        assert beacons, "starved interactive never published a pressure beacon"
+        for c in charges:  # batch work finishes; borrowed chunks go home
+            ctl_borrow.release(c)
+        got = await task
+        assert len(got) == 5
+        for c in got:
+            ctl_inter.release(c)
+        await b_borrow.close()
+        await b_inter.close()
+
+    asyncio.run(go())
+
+
+def test_scavenger_never_releases_chunks_under_running_borrowed_work():
+    """Review regression: once the borrow spike's QUEUE drains but the
+    admitted borrowed requests still run, the scavenger's desired slots
+    stay floored at their occupancy — releasing an occupied donor chunk
+    would let the donor class admit on top of running borrowed work and
+    transiently break the per-pool cap."""
+
+    async def go():
+        store = MemoryStore()
+        totals = {"interactive": 8, "standard": 0, "batch": 2}
+        budgets, ctl = await _make_qos(
+            store, "floor", totals, 0, queue_timeout=6.0, max_queue_depth=50,
+        )
+        charges = await asyncio.gather(*(ctl.acquire("batch") for _ in range(8)))
+        assert len(charges) == 8
+        scav = budgets.scav["batch"]
+        held0 = sum(b.held_slots for b in scav)
+        assert held0 >= 6
+        # Queue is empty now but all 8 admissions still run: two release
+        # ticks must not shrink the scavenger below its occupancy.
+        await asyncio.sleep(2.2)
+        occupied = max(0, ctl.inflight_in("batch")
+                       - budgets.primary["batch"].held_slots)
+        assert sum(b.held_slots for b in scav) >= occupied
+        assert occupied >= 6  # the floor was actually exercised
+        for c in charges:
+            ctl.release(c)
+        await asyncio.sleep(1.5)  # demand gone: borrowed chunks drain home
+        assert sum(b.held_slots for b in scav) == 0
+        await budgets.close()
 
     asyncio.run(go())

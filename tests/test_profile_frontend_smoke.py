@@ -26,6 +26,21 @@ def test_profile_frontend_quick_smoke():
     assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]
 
 
+def test_profile_frontend_qos_quick_smoke():
+    """QoS mode boots the real --fleet 2 --qos CLI (per-class budget
+    pools + WDRR gates) and asserts in --quick: both classes served
+    (zero errors, batch not starved) and the merged exposition carries
+    the per-class admission + budget series."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_frontend.py"),
+         "--qos", "--quick", "--json"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]
+
+
 def test_profile_frontend_fleet_quick_smoke():
     """Fleet mode boots the REAL --fleet CLI (supervisor + 2 children on
     one SO_REUSEPORT port) and asserts in --quick: zero errors, exact

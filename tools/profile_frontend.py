@@ -106,6 +106,76 @@ def _drive_streams_fleet(base: str, k: int, gen_len: int) -> tuple[int, int]:
     return aio.run(go())
 
 
+def _drive_streams_qos(base: str, k: int, gen_len: int,
+                       priority: str) -> tuple[int, int, int, list[float]]:
+    """Class-tagged load generator: k concurrent raw-socket SSE streams
+    sent with an ``x-priority`` header. → (delivered tokens, errored
+    streams, 429 sheds, per-stream TTFB seconds). TTFB = first response
+    bytes after the request, queue wait included — the client-visible
+    half of the class's TTFT under admission contention."""
+    import asyncio as aio
+    import json as _json
+    import re as _re
+    import time as _time
+
+    host, port = base[len("http://"):].rsplit(":", 1)
+    usage_re = _re.compile(rb'"completion_tokens":\s*(\d+)')
+
+    async def go():
+        async def one(i: int):
+            t0 = _time.perf_counter()
+            try:
+                reader, writer = await aio.open_connection(host, int(port))
+                body = _json.dumps({
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": f"prompt {i} " * 8}],
+                    "max_tokens": gen_len, "stream": True, "ignore_eos": True,
+                }).encode()
+                writer.write(
+                    b"POST /v1/chat/completions HTTP/1.1\r\n"
+                    b"Host: " + host.encode() + b"\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"x-priority: " + priority.encode() + b"\r\n"
+                    b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                    b"Connection: close\r\n\r\n" + body
+                )
+                await writer.drain()
+                head = b""
+                while b"\r\n" not in head:
+                    chunk = await reader.read(65536)
+                    if not chunk:
+                        break
+                    head += chunk
+                status = head.split(b"\r\n", 1)[0].split(b" ")
+                if len(status) < 2 or status[1] != b"200":
+                    writer.close()
+                    shed = len(status) >= 2 and status[1] in (b"429", b"503")
+                    return 0, 0 if shed else 1, 1 if shed else 0, None
+                # First DATA bytes ≈ first token: the status line and the
+                # SSE head arrive in one flush on this stack.
+                ttfb = _time.perf_counter() - t0
+                tail = head[-4096:]
+                while True:
+                    chunk = await reader.read(262144)
+                    if not chunk:
+                        break
+                    tail = (tail + chunk)[-4096:]
+                writer.close()
+            except (OSError, IndexError):
+                return 0, 1, 0, None
+            hits = usage_re.findall(tail)
+            return (int(hits[-1]) if hits else 0), 0, 0, ttfb
+
+        rows = await aio.gather(*(one(i) for i in range(k)))
+        toks = sum(r[0] for r in rows)
+        errs = sum(r[1] for r in rows)
+        sheds = sum(r[2] for r in rows)
+        ttfbs = [r[3] for r in rows if r[3] is not None]
+        return toks, errs, sheds, ttfbs
+
+    return aio.run(go())
+
+
 def _drive_streams(base: str, k: int, gen_len: int) -> tuple[int, int]:
     """Subprocess load generator: k concurrent SSE streams →
     (delivered tokens, errored streams)."""
@@ -573,6 +643,161 @@ async def run_fleet(fleet_sizes: list[int], streams: int, gen_len: int,
     return result
 
 
+async def run_qos(fleet_n: int, streams: int, gen_len: int, n_workers: int,
+                  as_json: bool, quick: bool = False,
+                  out_path: str | None = None,
+                  global_max_inflight: int = 32) -> dict:
+    """Two-class QoS sweep through the REAL ``--fleet N --qos`` CLI:
+    half the offered streams are ``x-priority: interactive``, half
+    ``batch``, driven concurrently through a budget small enough that
+    the WDRR gate actually queues. Reports per-class delivered tok/s,
+    client-side TTFB percentiles, and shed counts; ``--quick`` asserts
+    both classes were served and the merged exposition carries the
+    per-class admission + budget series."""
+    import httpx
+
+    env = dict(os.environ, PYTHONPATH=REPO, DYNTPU_TRACING="0",
+               DYNTPU_STORE_LEASE_TTL="30")
+    procs: list[subprocess.Popen] = []
+    import concurrent.futures as cf
+    import multiprocessing as mp
+
+    per_cls = max(2, streams // 2)
+    result: dict = {}
+    try:
+        url = await _start_store(procs, env)
+        # Real-ish per-request service time so admission queueing (the
+        # thing QoS differentiates) exists: quick keeps it tiny.
+        _spawn_mockers(procs, env, url, n_workers, [
+            "--mocker-delta-tokens", "4",
+            "--max-num-seqs", str(max(64, streams)),
+            "--num-kv-blocks", str(max(4096, streams * 16)),
+            "--max-model-len", "8192",
+        ])
+        fleet = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.frontend",
+             "--store-url", url, "--host", "127.0.0.1", "--port", "0",
+             "--router-mode", "round-robin", "--fleet", str(fleet_n),
+             "--fleet-id", "profqos", "--fleet-admin-port", "0", "--qos",
+             "--global-max-inflight", str(global_max_inflight),
+             "--budget-chunk", "2"],
+            env=env, stdout=subprocess.PIPE, text=True,
+        )
+        procs.append(fleet)
+        reader = _StdoutReader(fleet)
+        m = await reader.wait_for(
+            r"fleet: http://127\.0\.0\.1:(\d+) admin http://127\.0\.0\.1:(\d+)"
+        )
+        base = f"http://127.0.0.1:{m.group(1)}"
+        admin = f"http://127.0.0.1:{m.group(2)}"
+        await reader.wait_for(r"fleet ready")
+        async with httpx.AsyncClient(timeout=60) as client:
+            deadline = time.monotonic() + 30
+            while True:
+                r = await client.get(f"{base}/v1/models")
+                if r.json()["data"]:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("model never discovered")
+                await asyncio.sleep(0.2)
+            for _ in range(4 * fleet_n):
+                r = await client.post(f"{base}/v1/chat/completions", json={
+                    "model": "mock-model",
+                    "messages": [{"role": "user", "content": "warm"}],
+                    "max_tokens": 2,
+                }, headers={"Connection": "close"})
+                r.raise_for_status()
+
+        with cf.ProcessPoolExecutor(
+            max_workers=2, mp_context=mp.get_context("spawn")
+        ) as pool:
+            loop = asyncio.get_running_loop()
+            await asyncio.gather(
+                loop.run_in_executor(pool, _drive_streams_qos, base, 1, 2,
+                                     "interactive"),
+                loop.run_in_executor(pool, _drive_streams_qos, base, 1, 2,
+                                     "batch"),
+            )
+            t0 = time.perf_counter()
+            (i_tok, i_err, i_shed, i_ttfb), (b_tok, b_err, b_shed, b_ttfb) = (
+                await asyncio.gather(
+                    loop.run_in_executor(pool, _drive_streams_qos, base,
+                                         per_cls, gen_len, "interactive"),
+                    loop.run_in_executor(pool, _drive_streams_qos, base,
+                                         per_cls, gen_len, "batch"),
+                )
+            )
+            dur = time.perf_counter() - t0
+
+        async with httpx.AsyncClient(timeout=30) as client:
+            metrics_text = (await client.get(f"{admin}/metrics")).text
+            status = (await client.get(f"{admin}/fleet")).json()
+
+        def pctl(xs, p):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            return round(xs[min(len(xs) - 1, int(p / 100 * len(xs)))], 4)
+
+        result = {
+            "bench": "frontend_qos",
+            "fleet": fleet_n, "streams_per_class": per_cls,
+            "gen_len": gen_len, "workers": n_workers,
+            "global_max_inflight": global_max_inflight,
+            "elapsed_s": round(dur, 3),
+            "classes": {
+                "interactive": {
+                    "tok_s": round(i_tok / dur, 1), "tokens": i_tok,
+                    "errors": i_err, "sheds": i_shed,
+                    "ttfb_p50_s": pctl(i_ttfb, 50), "ttfb_p99_s": pctl(i_ttfb, 99),
+                },
+                "batch": {
+                    "tok_s": round(b_tok / dur, 1), "tokens": b_tok,
+                    "errors": b_err, "sheds": b_shed,
+                    "ttfb_p50_s": pctl(b_ttfb, 50), "ttfb_p99_s": pctl(b_ttfb, 99),
+                },
+            },
+            "budget_chunks_by_class": status.get("budget_chunks_by_class"),
+            "admission": status.get("admission"),
+        }
+        if quick:
+            assert i_err == 0 and b_err == 0, f"errors: {i_err}+{b_err}"
+            assert i_tok > 0, "interactive class served nothing"
+            assert b_tok > 0, "batch class served nothing (starved)"
+            # Per-class series made it through the fleet merge.
+            assert 'class="interactive"' in metrics_text, "no per-class labels"
+            assert 'class="batch"' in metrics_text
+            assert "dynamo_tpu_admission_rejected_total" in metrics_text
+            assert "dynamo_tpu_fleet_budget_slots_held" in metrics_text
+            adm = status.get("admission") or {}
+            assert any("classes" in v for v in adm.values()), "/fleet lacks per-class admission state"
+        if as_json:
+            print(json.dumps(result), flush=True)
+        else:
+            for cls, row in result["classes"].items():
+                print(f"qos {cls:12s}: {row['tok_s']:10.0f} tok/s  "
+                      f"ttfb p50 {row['ttfb_p50_s']} p99 {row['ttfb_p99_s']} "
+                      f"sheds {row['sheds']}", flush=True)
+        fleet.send_signal(signal.SIGTERM)
+        try:
+            fleet.wait(30)
+        except subprocess.TimeoutExpired:
+            fleet.kill()
+    finally:
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {out_path}", flush=True)
+    return result
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--streams", default="32,128,256")
@@ -608,8 +833,28 @@ def main():
     p.add_argument("--out", default=None,
                    help="write the fleet sweep result JSON here "
                         "(e.g. BENCH_FLEET_r09.json)")
+    p.add_argument("--qos", action="store_true",
+                   help="two-class QoS sweep: half the streams x-priority "
+                        "interactive, half batch, through the real --fleet "
+                        "--qos CLI under a small admission budget; reports "
+                        "per-class tok/s + TTFB + sheds")
     p.add_argument("--json", action="store_true")
     args = p.parse_args()
+    if args.qos:
+        if args.quick:
+            streams, gen_len, workers, fleet_n = 16, 8, 1, 2
+        else:
+            streams = [int(s) for s in args.streams.split(",")][0]
+            gen_len, workers = args.gen_len, args.workers
+            fleet_n = args.fleet or 2
+        asyncio.run(run_qos(
+            fleet_n, streams, gen_len, workers, args.json,
+            quick=args.quick, out_path=args.out,
+            global_max_inflight=args.global_max_inflight or (8 if args.quick else 32),
+        ))
+        if args.quick:
+            print("QUICK-OK", flush=True)
+        return
     if args.fleet or args.fleet_sweep:
         sizes = ([int(s) for s in args.fleet_sweep.split(",")]
                  if args.fleet_sweep else [args.fleet])
