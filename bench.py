@@ -44,7 +44,7 @@ def parse_args():
     p.add_argument("--workload", default="lognormal-mixed",
                    choices=["lognormal-mixed", "fixed", "repetitive",
                             "shared-prefix", "structured", "multi-lora",
-                            "multi-tenant"],
+                            "multi-tenant", "diurnal"],
                    help="lognormal-mixed = ShareGPT-like regression workload; "
                         "repetitive = agentic/extractive prompts with high "
                         "n-gram overlap (the speculation-friendly shape) — "
@@ -59,7 +59,11 @@ def parse_args():
                         "traffic — A/Bs grammar-on/off, tree-on/off and "
                         "adaptive-vs-uniform batch tree budgets on identical "
                         "schedules, asserting 100%% schema-valid output and "
-                        "greedy tree≡dense byte identity (BENCH_GRAMMAR_*)")
+                        "greedy tree≡dense byte identity (BENCH_GRAMMAR_*); "
+                        "diurnal = closed-loop SLA autoscaler vs best static "
+                        "prefill:decode split on a seeded diurnal+burst trace "
+                        "at equal chip count, SLO-attaining tok/s "
+                        "(benchmarks/diurnal.py, docs/autoscaler.md)")
     p.add_argument("--spec-budget", choices=["adaptive", "uniform"],
                    default="adaptive",
                    help="per-pass draft-node allocation (engine "
@@ -98,6 +102,16 @@ def parse_args():
                    help="multi-tenant workload: offered load as a multiple "
                         "of the measured saturation rate (the overload the "
                         "QoS-vs-FIFO goodput A/B runs at)")
+    p.add_argument("--diurnal-workers", type=int, default=6,
+                   help="diurnal workload: total engine count shared by the "
+                        "prefill+decode pools (equal chips in both arms)")
+    p.add_argument("--diurnal-scale", type=float, default=1.0,
+                   help="diurnal workload: phase-duration multiplier "
+                        "(1.0 = 600 virtual seconds)")
+    p.add_argument("--diurnal-ttft-slo", type=float, default=1.0,
+                   help="diurnal workload: TTFT SLO seconds (incl. queue wait)")
+    p.add_argument("--diurnal-itl-slo", type=float, default=40.0,
+                   help="diurnal workload: mean-ITL SLO milliseconds")
     p.add_argument("--sp-turns", type=int, default=3,
                    help="shared-prefix workload: conversation turns per user")
     p.add_argument("--sp-system-tokens", type=int, default=0,
@@ -2126,6 +2140,10 @@ def main():
             result = asyncio.run(bench_multi_lora(args))
         elif args.workload == "multi-tenant":
             result = asyncio.run(bench_multi_tenant(args))
+        elif args.workload == "diurnal":
+            from benchmarks.diurnal import bench_diurnal
+
+            result = asyncio.run(bench_diurnal(args))
         else:
             result = asyncio.run(bench(args))
     except Exception as e:  # noqa: BLE001 — bench must always print a line

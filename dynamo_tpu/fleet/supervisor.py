@@ -124,6 +124,9 @@ class FleetSupervisor:
         self._stop = asyncio.Event()
         self._rolling: asyncio.Task | None = None
         self._http: ClientSession | None = None
+        # Serializes resize/rolling-drain admin RPCs: one structural
+        # change to the slot list at a time.
+        self._resize_lock = asyncio.Lock()
 
     # -- shared listen socket ---------------------------------------------
 
@@ -201,6 +204,8 @@ class FleetSupervisor:
         app.router.add_get("/debug/requests", self._agg_requests)
         app.router.add_get("/health", self._agg_health)
         app.router.add_get("/fleet", self._fleet_status)
+        app.router.add_post("/fleet/resize", self._fleet_resize)
+        app.router.add_post("/fleet/roll", self._fleet_roll)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.admin_host, self.admin_port)
@@ -296,8 +301,12 @@ class FleetSupervisor:
         SIGTERM → the child stops admitting, finishes in-flight streams,
         releases budget + decision leases, exits → respawn → wait until
         the replacement registers → next child."""
+        async with self._resize_lock:
+            await self._rolling_restart_locked()
+
+    async def _rolling_restart_locked(self) -> None:
         grace = self.config.runtime.graceful_shutdown_timeout + 10.0
-        for slot in self.slots:
+        for slot in list(self.slots):
             if self._stop.is_set():
                 return
             if slot.proc is None or slot.proc.poll() is not None:
@@ -325,6 +334,65 @@ class FleetSupervisor:
                     break
                 await asyncio.sleep(0.1)
         log.info("rolling drain complete")
+
+    async def resize(self, n: int) -> dict:
+        """Resize the fleet at runtime (admin RPC — the autoscaler's
+        frontend actuation). Growing spawns fresh slots and waits for
+        their registration; shrinking retires the HIGHEST-id slots one
+        at a time through the same zero-failure drain a rolling restart
+        uses (SIGTERM → child leaves the accept group, drains streams,
+        returns budget + decision leases, exits) — siblings absorb
+        traffic throughout, so no stream fails."""
+        if n < 1:
+            raise FleetError("fleet size must be >= 1")
+        async with self._resize_lock:
+            grace = self.config.runtime.graceful_shutdown_timeout + 10.0
+            grew = shrank = 0
+            while len(self.slots) < n:
+                slot = _Slot(max((s.worker_id for s in self.slots), default=-1) + 1)
+                self.slots.append(slot)
+                self.n = len(self.slots)
+                try:
+                    await self._spawn(slot)
+                except Exception as e:  # noqa: BLE001 — a failed Popen (EAGAIN under pressure) must not leave a proc-less zombie slot the monitor can never restart
+                    self.slots.pop()
+                    self.n = len(self.slots)
+                    raise FleetError(
+                        f"resize: spawn of worker {slot.worker_id} failed: {e}"
+                    ) from e
+                grew += 1
+            if grew:
+                deadline = time.monotonic() + grace
+                while time.monotonic() < deadline and not self._stop.is_set():
+                    regs = await self.registrations()
+                    if all(
+                        s.worker_id in regs
+                        for s in self.slots if s.proc is not None
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+            while len(self.slots) > n:
+                slot = self.slots[-1]
+                slot.draining = True
+                if slot.proc is not None and slot.proc.poll() is None:
+                    log.info(
+                        "resize: draining fleet worker %d (pid %d)",
+                        slot.worker_id, slot.proc.pid,
+                    )
+                    slot.proc.terminate()
+                    if not await self._wait_exit(slot, grace):
+                        log.warning(
+                            "resize: worker %d ignored SIGTERM, killing",
+                            slot.worker_id,
+                        )
+                        slot.proc.kill()
+                        await self._wait_exit(slot, 5.0)
+                self.slots.pop()
+                self.n = len(self.slots)
+                shrank += 1
+            self._m["workers_alive"].set(len(self.alive()))
+            log.info("fleet resized to %d (+%d/-%d)", self.n, grew, shrank)
+            return {"fleet_size": self.n, "grew": grew, "shrank": shrank}
 
     async def shutdown(self) -> None:
         """Fleet-wide graceful stop: SIGTERM every child (each drains its
@@ -412,6 +480,7 @@ class FleetSupervisor:
         }
         body = {
             "fleet_id": self.fleet_id,
+            "fleet_size": self.n,
             "port": self.port,
             "socket_mode": "inherit" if self._inherit_fd is not None else "reuseport",
             "budget_chunks_claimed": len(chunks),
@@ -429,6 +498,32 @@ class FleetSupervisor:
             ],
         }
         return web.json_response(body)
+
+    async def _fleet_resize(self, request: web.Request) -> web.Response:
+        """``POST /fleet/resize {"n": N}`` — the autoscaler's (and any
+        operator's) runtime alternative to editing --fleet and
+        restarting. Completes when the fleet has converged."""
+        try:
+            body = await request.json()
+            n = int(body["n"])
+        except (ValueError, KeyError, TypeError):
+            return web.json_response({"error": "body must be {\"n\": int}"}, status=400)
+        try:
+            result = await self.resize(n)
+        except FleetError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(result)
+
+    async def _fleet_roll(self, request: web.Request) -> web.Response:
+        """``POST /fleet/roll`` — trigger the rolling zero-failure drain
+        via RPC instead of SIGHUP only (remote operators have HTTP, not
+        signals). Returns immediately; /fleet shows progress."""
+        if self._rolling is None or self._rolling.done():
+            self._rolling = asyncio.get_running_loop().create_task(
+                self.rolling_restart()
+            )
+            return web.json_response({"rolling": True})
+        return web.json_response({"rolling": True, "already": True})
 
     # -- entry -------------------------------------------------------------
 

@@ -143,9 +143,6 @@ async def async_main(args) -> None:
         ).start()
         settings.decisions = decisions
 
-    manager = ModelManager(rt, settings)
-    watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
-
     acfg = rt.config.admission
     qcfg = rt.config.qos
     qos_on = args.qos or qcfg.enabled
@@ -164,6 +161,28 @@ async def async_main(args) -> None:
         # Early rejection works from the observed drain rate alone when
         # no profile is loaded; the profile adds the model-based term.
         predictor = TtftPredictor(prefill=prefill, decode=decode)
+
+    def on_card(card) -> None:
+        # Card-shipped SLA profile (ROADMAP 2c): a worker that was
+        # profiled publishes its latency curves in its model card, so
+        # the admission-time TTFT predictor self-configures from
+        # discovery — an explicit --qos-profile still wins.
+        if predictor is None or not card.sla_profile:
+            return
+        if predictor.prefill is not None and predictor.decode is not None:
+            return
+        from dynamo_tpu.planner.interpolate import interpolators_from_card_dict
+
+        decode, prefill = interpolators_from_card_dict(card.sla_profile)
+        if predictor.prefill is None and prefill is not None:
+            predictor.prefill = prefill
+        if predictor.decode is None and decode is not None:
+            predictor.decode = decode
+        if prefill is not None or decode is not None:
+            log.info("qos: SLA profile adopted from model card %s", card.name)
+
+    manager = ModelManager(rt, settings, on_card=on_card)
+    watcher = await ModelWatcher(rt, manager, namespace=args.namespace).start()
     global_budget = (
         fcfg.global_max_inflight if args.global_max_inflight is None
         else args.global_max_inflight

@@ -148,6 +148,12 @@ class PrefillHandler:
     async def kv_fetch(self, payload: Any, ctx: Context) -> AsyncIterator[dict]:
         payload = payload or {}
         handle = payload.get("handle", "")
+        if not hasattr(self.engine, "get_stream_export"):
+            # Control-plane-only deployments (role-managed mocker
+            # workers) serve prefill pass-through but have no KV export
+            # surface — answer typed so the decode side falls back.
+            yield {"error": "engine has no KV export surface"}
+            return
         if not payload.get("stream"):
             # Legacy one-shot pull (whole payload after prefill).
             export = self.engine.take_export(handle)
@@ -204,6 +210,7 @@ class PrefillPuller:
         self.instance_id = instance_id
         self.jobs_done = 0
         self._task = None
+        self._busy = False
 
     def start(self) -> "PrefillPuller":
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -218,6 +225,18 @@ class PrefillPuller:
             except BaseException:  # noqa: BLE001 — cancellation path
                 pass
 
+    async def drain(self, timeout_s: float = 30.0) -> None:
+        """Graceful stop for live pool moves: let the CURRENT job finish
+        (its decode-side consumer is mid-pull — cancelling it would turn
+        a clean migration into a fallback) before cancelling the loop.
+        Jobs still queued simply stay queued for the remaining prefill
+        fleet; past ``timeout_s`` the job is cut anyway (typed fallback
+        on the decode side — disagg is never a correctness dependency)."""
+        deadline = time.monotonic() + timeout_s
+        while self._busy and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        await self.stop()
+
     async def _loop(self) -> None:
         while True:
             job = await self.queue.dequeue()
@@ -230,6 +249,7 @@ class PrefillPuller:
                 log.info("dropping expired prefill job")
                 continue
             try:
+                self._busy = True
                 await self._run_job(job)
                 self.jobs_done += 1
             except Exception:  # noqa: BLE001 — keep consuming; an empty
@@ -238,6 +258,8 @@ class PrefillPuller:
                 log.exception("queued prefill job failed")
                 with contextlib.suppress(Exception):
                     await self._reply(job["reply_key"], {"instance_id": self.instance_id})
+            finally:
+                self._busy = False
 
     async def _run_job(self, job: dict) -> None:
         req, reply_key = job["req"], job["reply_key"]

@@ -26,10 +26,16 @@ log = get_logger("model_discovery")
 class ModelManager:
     """Live registry: (namespace, slug) → started ModelPipeline."""
 
-    def __init__(self, runtime, settings: RouterSettings | None = None):
+    def __init__(self, runtime, settings: RouterSettings | None = None,
+                 on_card=None):
         self.runtime = runtime
         self.settings = settings or RouterSettings()
         self._pipelines: dict[tuple[str, str], ModelPipeline] = {}
+        # Called with every newly-discovered ModelDeploymentCard (after
+        # its pipeline starts): the frontend hooks this to pick up
+        # card-shipped config — e.g. the sla_profile the admission
+        # predictor reads — via discovery instead of CLI flags.
+        self._on_card = on_card
 
     def get(self, model_name: str) -> ModelPipeline | None:
         """Resolve a user-facing model name (exact name or slug)."""
@@ -54,6 +60,11 @@ class ModelManager:
         self._pipelines[key] = pipe
         await pipe.start()
         log.info("model added: %s (ns=%s)", card.name, namespace)
+        if self._on_card is not None:
+            try:
+                self._on_card(card)
+            except Exception:  # noqa: BLE001 — a bad hook must not block model discovery
+                log.exception("on_card hook failed for %s", card.name)
 
     async def remove(self, namespace: str, slug: str) -> None:
         pipe = self._pipelines.pop((namespace, slug), None)

@@ -94,6 +94,9 @@ class HttpService:
         # (reference observes ITL from frontend metrics, planner_core.py:189-320).
         self.m_itl = scope.histogram("http_inter_token_latency_seconds", "Mean inter-token latency per request")
         self.m_output_tokens = scope.counter("http_output_tokens_total", "Output tokens")
+        # Prompt-side twin of output tokens: the autoscaler sizes the
+        # PREFILL pool from the observed input-token rate (docs/autoscaler.md).
+        self.m_input_tokens = scope.counter("http_input_tokens_total", "Prompt tokens")
         self.m_admission_wait = scope.histogram(
             "admission_wait_seconds", "Time spent waiting at the admission gate"
         )
@@ -686,6 +689,7 @@ class HttpService:
             info["prompt_tokens"] = last_gen.prompt_tokens
             info["completion_tokens"] = last_gen.completion_tokens
             self.m_output_tokens.inc(last_gen.completion_tokens, model=model)
+            self.m_input_tokens.inc(last_gen.prompt_tokens, model=model)
             if last_gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
                 info["itl_s"] = (t_last_tok - t_first_tok) / (last_gen.completion_tokens - 1)
                 self.m_itl.observe(info["itl_s"], model=model)
@@ -744,6 +748,7 @@ class HttpService:
         info["prompt_tokens"] = gen.prompt_tokens
         info["completion_tokens"] = gen.completion_tokens
         self.m_output_tokens.inc(gen.completion_tokens, model=model)
+        self.m_input_tokens.inc(gen.prompt_tokens, model=model)
         if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
             info["itl_s"] = (t_last_tok - t_first_tok) / (gen.completion_tokens - 1)
             self.m_itl.observe(info["itl_s"], model=model)
@@ -861,6 +866,7 @@ class HttpService:
             info["prompt_tokens"] = gen.prompt_tokens
             info["completion_tokens"] = gen.completion_tokens
             self.m_output_tokens.inc(gen.completion_tokens, model=model)
+            self.m_input_tokens.inc(gen.prompt_tokens, model=model)
             if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
                 info["itl_s"] = (t_last_tok - t_first_tok) / (gen.completion_tokens - 1)
                 self.m_itl.observe(info["itl_s"], model=model)
@@ -915,6 +921,7 @@ class HttpService:
         info["prompt_tokens"] = gen.prompt_tokens
         info["completion_tokens"] = gen.completion_tokens
         self.m_output_tokens.inc(gen.completion_tokens, model=model)
+        self.m_input_tokens.inc(gen.prompt_tokens, model=model)
         if gen.completion_tokens > 1 and t_first_tok is not None and t_last_tok > t_first_tok:
             info["itl_s"] = (t_last_tok - t_first_tok) / (gen.completion_tokens - 1)
             self.m_itl.observe(info["itl_s"], model=model)

@@ -53,6 +53,12 @@ class ModelDeploymentCard:
     # tiers and page into G1 on first request); live residency is the
     # engine_lora_resident_adapters gauge.
     lora: dict[str, Any] | None = None
+    # Profiled SLA latency curves (planner.interpolate.profile_as_card_dict):
+    # the worker that was profiled ships its own prefill-TTFT and
+    # decode-ITL samples, so frontends (admission-time TTFT prediction)
+    # and the autoscaler (capacity model) pick the profile up via
+    # DISCOVERY instead of a --qos-profile CLI path copied to every box.
+    sla_profile: dict[str, Any] | None = None
 
     @property
     def slug(self) -> str:
@@ -73,6 +79,7 @@ class ModelDeploymentCard:
             "max_batch_size": self.max_batch_size,
             "total_kv_blocks": self.total_kv_blocks,
             "lora": dict(self.lora) if self.lora else None,
+            "sla_profile": dict(self.sla_profile) if self.sla_profile else None,
         }
 
     @classmethod
@@ -91,6 +98,7 @@ class ModelDeploymentCard:
             max_batch_size=d.get("max_batch_size"),
             total_kv_blocks=d.get("total_kv_blocks"),
             lora=dict(d["lora"]) if d.get("lora") else None,
+            sla_profile=dict(d["sla_profile"]) if d.get("sla_profile") else None,
         )
 
     def to_bytes(self) -> bytes:

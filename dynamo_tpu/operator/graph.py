@@ -144,7 +144,13 @@ class GraphSpec:
             cmd = ["python", "-m", "dynamo_tpu.worker", "--store-url", url,
                    "--is-prefill-worker"]
         elif s.component_type == "planner":
-            cmd = ["python", "-m", "dynamo_tpu.planner", "--connector", "kubernetes"]
+            # --store-url wires the closed-loop surface too: add
+            # `--operate` (+ SLA flags) via extraArgs and the pod runs
+            # the SlaAutoscaler against the in-graph store — worker
+            # admin RPCs for pool moves, K8s scale patches for replicas
+            # (docs/autoscaler.md).
+            cmd = ["python", "-m", "dynamo_tpu.planner",
+                   "--connector", "kubernetes", "--store-url", url]
         elif s.component_type == "metrics":
             cmd = ["python", "-m", "dynamo_tpu.metrics_exporter", "--store-url", url,
                    "--port", str(s.port or 9091)]

@@ -1,6 +1,16 @@
 """SLA/load planner: predictors, perf interpolators, scaling connectors,
-and the adjustment loop (reference: components/planner/)."""
+the adjustment loop (reference: components/planner/), and the
+closed-loop autoscaler operator (operator.py + actuate.py) that
+actually drives the fleet."""
 
+from dynamo_tpu.planner.actions import (
+    ActionJournal,
+    FleetResize,
+    Hold,
+    PoolMove,
+    ReplicaScale,
+    ScaleActionError,
+)
 from dynamo_tpu.planner.connector import (
     LocalProcessConnector,
     RecordingConnector,
@@ -14,8 +24,16 @@ from dynamo_tpu.planner.core import (
 from dynamo_tpu.planner.interpolate import (
     DecodeInterpolator,
     PrefillInterpolator,
+    interpolators_from_card_dict,
     load_profile,
+    profile_as_card_dict,
     save_profile,
+)
+from dynamo_tpu.planner.operator import (
+    ControlLaw,
+    OperatorConfig,
+    SlaAutoscaler,
+    register_planner_metrics,
 )
 from dynamo_tpu.planner.predictors import make_predictor
 
@@ -30,5 +48,17 @@ __all__ = [
     "PrefillInterpolator",
     "load_profile",
     "save_profile",
+    "profile_as_card_dict",
+    "interpolators_from_card_dict",
     "make_predictor",
+    "ControlLaw",
+    "OperatorConfig",
+    "SlaAutoscaler",
+    "register_planner_metrics",
+    "ActionJournal",
+    "FleetResize",
+    "PoolMove",
+    "ReplicaScale",
+    "Hold",
+    "ScaleActionError",
 ]

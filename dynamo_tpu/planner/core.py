@@ -35,8 +35,45 @@ log = get_logger("planner")
 class PlannerObservation:
     request_rate: float = 0.0        # requests/s over the interval
     output_token_rate: float = 0.0   # generated tokens/s over the interval
+    input_token_rate: float = 0.0    # prompt tokens/s over the interval
     ttft_ms: float | None = None     # mean over the interval
     itl_ms: float | None = None      # mean over the interval
+    # Cold start / no-data marker: True when the source had NO basis for
+    # rates this interval (first scrape after a planner restart). A
+    # restarted planner must not read "rate 0.0" off its first tick and
+    # scale a loaded fleet to min_replicas — empty windows clamp to a
+    # no-op decision (ISSUE 15 satellite audit).
+    empty_window: bool = False
+    # Admission-gate signals (fed by the operator loop's richer source;
+    # zero when unobserved): queued requests and the gate's observed
+    # inter-release EMA — the drain-rate half of the decision inputs.
+    queue_depth: float = 0.0
+    drain_interval_s: float = 0.0
+
+    def sanitize(self) -> "PlannerObservation":
+        """Clamp non-finite/negative inputs so no observation can push
+        NaN into pool-size math: junk rates → 0 (+ empty_window, since a
+        poisoned window carries no information), junk latencies → None."""
+        import math as _m
+
+        out = PlannerObservation(
+            request_rate=self.request_rate, output_token_rate=self.output_token_rate,
+            input_token_rate=self.input_token_rate,
+            ttft_ms=self.ttft_ms, itl_ms=self.itl_ms,
+            empty_window=self.empty_window,
+            queue_depth=self.queue_depth, drain_interval_s=self.drain_interval_s,
+        )
+        for f in ("request_rate", "output_token_rate", "input_token_rate",
+                  "queue_depth", "drain_interval_s"):
+            v = getattr(out, f)
+            if not _m.isfinite(v) or v < 0.0:
+                setattr(out, f, 0.0)
+                out.empty_window = True
+        for f in ("ttft_ms", "itl_ms"):
+            v = getattr(out, f)
+            if v is not None and (not _m.isfinite(v) or v < 0.0):
+                setattr(out, f, None)
+        return out
 
 
 @dataclass
@@ -198,7 +235,16 @@ class Planner:
         return target
 
     async def step(self) -> int:
-        obs = await self.metrics_source()
+        obs = (await self.metrics_source()).sanitize()
+        if obs.empty_window:
+            # No basis for a decision (cold start / poisoned scrape):
+            # hold the current replica count instead of reading the
+            # zeroed rates as "idle" and scaling a loaded fleet down.
+            current = await asyncio.to_thread(
+                self.connector.get_replicas, self.cfg.component
+            )
+            self.state.replicas = max(current, self.cfg.min_replicas)
+            return self.state.replicas
         target = await asyncio.to_thread(self._step_sync, obs)
         self.state.replicas = target
         return target
@@ -233,10 +279,17 @@ class Planner:
 
 class HttpMetricsSource:
     """Scrapes the frontend's /metrics (our own Prometheus text) and
-    differences counters across calls → rates + interval means."""
+    differences counters across calls → rates + interval means.
 
-    def __init__(self, url: str):
+    ``admission_url`` (the frontend's /debug/admission) additionally
+    supplies the gate's live queue depth and observed drain-interval
+    EMA — the overload signals the closed-loop autoscaler's queue term
+    reads (docs/autoscaler.md). Scrape failures there degrade to
+    zeroed signals, never a failed observation."""
+
+    def __init__(self, url: str, admission_url: str | None = None):
         self.url = url
+        self.admission_url = admission_url
         self._last: dict[str, float] | None = None
         self._last_t: float | None = None
 
@@ -266,7 +319,9 @@ class HttpMetricsSource:
             r = await client.get(self.url)
         cur = self._parse(r.text)
         now = time.monotonic()
-        obs = PlannerObservation()
+        # First scrape after (re)start: no previous sample to difference
+        # against — an EMPTY window, not an idle one.
+        obs = PlannerObservation(empty_window=self._last is None)
         if self._last is not None and self._last_t is not None:
             dt = max(now - self._last_t, 1e-6)
 
@@ -276,6 +331,7 @@ class HttpMetricsSource:
             p = "dynamo_tpu_http_"
             obs.request_rate = max(0.0, delta(p + "requests_total") / dt)
             obs.output_token_rate = max(0.0, delta(p + "output_tokens_total") / dt)
+            obs.input_token_rate = max(0.0, delta(p + "input_tokens_total") / dt)
             dttft_n = delta(p + "time_to_first_token_seconds_count")
             if dttft_n > 0:
                 obs.ttft_ms = delta(p + "time_to_first_token_seconds_sum") / dttft_n * 1000
@@ -283,4 +339,14 @@ class HttpMetricsSource:
             if ditl_n > 0:
                 obs.itl_ms = delta(p + "inter_token_latency_seconds_sum") / ditl_n * 1000
         self._last, self._last_t = cur, now
+        if self.admission_url:
+            try:
+                async with httpx.AsyncClient(timeout=10) as client:
+                    a = (await client.get(self.admission_url)).json()
+                obs.drain_interval_s = float(a.get("drain_interval_s") or 0.0)
+                obs.queue_depth = float(sum(
+                    c.get("queued", 0) for c in (a.get("classes") or {}).values()
+                ))
+            except Exception:  # noqa: BLE001 — the admission surface is optional signal; a failed scrape degrades to zeroed overload terms
+                pass
         return obs

@@ -13,14 +13,32 @@ from __future__ import annotations
 import numpy as np
 
 
+def _finite(arrays: dict[str, np.ndarray]) -> None:
+    """Profiles feed the autoscaler's control law — a NaN sample would
+    propagate into pool-size math, so it is rejected at construction
+    (the satellite clamp audit: never NaN/negative pool sizes)."""
+    for name, a in arrays.items():
+        if not np.all(np.isfinite(a)):
+            raise ValueError(f"profile array {name!r} contains non-finite samples")
+
+
 class DecodeInterpolator:
-    """Samples: concurrent batch size → ITL (ms) and per-chip tok/s."""
+    """Samples: concurrent batch size → ITL (ms) and per-chip tok/s.
+
+    Lookups OUTSIDE the profiled sample range clamp to the endpoint
+    values (``np.interp`` semantics) — extrapolation never invents
+    capacity; :meth:`in_range` tells the control law when it is
+    operating beyond the profile so it can act conservatively."""
 
     def __init__(self, batch: np.ndarray, itl_ms: np.ndarray, tok_s: np.ndarray):
         order = np.argsort(batch)
         self.batch = np.asarray(batch, np.float64)[order]
         self.itl_ms = np.asarray(itl_ms, np.float64)[order]
         self.tok_s = np.asarray(tok_s, np.float64)[order]
+        _finite({"batch": self.batch, "itl_ms": self.itl_ms, "tok_s": self.tok_s})
+
+    def in_range(self, batch: float) -> bool:
+        return bool(self.batch[0] <= batch <= self.batch[-1])
 
     def itl_at(self, batch: float) -> float:
         return float(np.interp(batch, self.batch, self.itl_ms))
@@ -48,6 +66,11 @@ class PrefillInterpolator:
         self.prompt_len = np.asarray(prompt_len, np.float64)[order]
         self.ttft_ms = np.asarray(ttft_ms, np.float64)[order]
         self.tok_s = np.asarray(tok_s, np.float64)[order]
+        _finite({"prompt_len": self.prompt_len, "ttft_ms": self.ttft_ms,
+                 "tok_s": self.tok_s})
+
+    def in_range(self, prompt_len: float) -> bool:
+        return bool(self.prompt_len[0] <= prompt_len <= self.prompt_len[-1])
 
     def ttft_at(self, prompt_len: float) -> float:
         return float(np.interp(prompt_len, self.prompt_len, self.ttft_ms))
@@ -108,6 +131,54 @@ def plan_disagg_pools(
     if ttft_sla_ms is not None:
         out["ttft_feasible"] = prefill.ttft_at(prompt_len) <= ttft_sla_ms
     return out
+
+
+def profile_as_card_dict(
+    decode: DecodeInterpolator | None = None,
+    prefill: PrefillInterpolator | None = None,
+) -> dict:
+    """Interpolators → a plain-list dict small enough to ride inside a
+    msgpack ModelDeploymentCard (``sla_profile`` field): the worker that
+    was profiled publishes its own latency curves, and frontends/the
+    planner pick them up via DISCOVERY instead of a ``--qos-profile``
+    CLI path that has to be copied to every box (ROADMAP 2c)."""
+    out: dict = {}
+    if decode is not None:
+        out["d_batch"] = decode.batch.tolist()
+        out["d_itl"] = decode.itl_ms.tolist()
+        out["d_tok"] = decode.tok_s.tolist()
+    if prefill is not None:
+        out["p_len"] = prefill.prompt_len.tolist()
+        out["p_ttft"] = prefill.ttft_ms.tolist()
+        out["p_tok"] = prefill.tok_s.tolist()
+    return out
+
+
+def interpolators_from_card_dict(
+    d: dict | None,
+) -> tuple[DecodeInterpolator | None, PrefillInterpolator | None]:
+    """Inverse of :func:`profile_as_card_dict`. Malformed or non-finite
+    payloads → (None, None): a bad card must degrade the consumer to
+    its no-profile behaviour, never crash discovery."""
+    if not d:
+        return None, None
+    decode = prefill = None
+    try:
+        if d.get("d_batch"):
+            decode = DecodeInterpolator(
+                np.asarray(d["d_batch"], np.float64),
+                np.asarray(d["d_itl"], np.float64),
+                np.asarray(d["d_tok"], np.float64),
+            )
+        if d.get("p_len"):
+            prefill = PrefillInterpolator(
+                np.asarray(d["p_len"], np.float64),
+                np.asarray(d["p_ttft"], np.float64),
+                np.asarray(d["p_tok"], np.float64),
+            )
+    except (ValueError, TypeError, KeyError):
+        return None, None
+    return decode, prefill
 
 
 def save_profile(path: str, *, decode: DecodeInterpolator | None = None,

@@ -228,7 +228,14 @@ class AdmissionController:
         surfaces: queued/inflight/retry_after plus shed counts by
         reason."""
         classes = self._order()
-        out: dict = {"draining": self._draining, "classes": {}}
+        out: dict = {
+            "draining": self._draining,
+            # The observed drain-rate EMA — the autoscaler's queue-term
+            # input (docs/autoscaler.md): the operator scrapes it off
+            # /debug/admission alongside the /metrics deltas.
+            "drain_interval_s": round(self._release_iv_ema, 6),
+            "classes": {},
+        }
         for c in classes:
             sheds = {
                 reason: n

@@ -49,12 +49,13 @@ class ChaosStats:
     kills: int = 0
     transfer_cuts: int = 0
     frontend_kills: int = 0
+    operator_kills: int = 0
     latency_injections: int = 0
 
     def total(self) -> int:
         return (
             self.frames_dropped + self.streams_truncated + self.kills
-            + self.transfer_cuts + self.frontend_kills
+            + self.transfer_cuts + self.frontend_kills + self.operator_kills
         )
 
 
@@ -134,6 +135,20 @@ class ChaosInjector:
             self.stats.transfer_cuts += 1
             self._count("transfer_cut")
             raise ChaosKillError("injected kv-transfer death")
+
+    def maybe_kill_operator(self) -> None:
+        """Consulted once per autoscaler control cycle: on a hit the
+        operator process dies (``ChaosKillError``) BEFORE observing —
+        possibly with a scale action half-applied. Recovery is the
+        successor operator's level-based convergence
+        (tests/test_autoscaler_chaos.py pins it)."""
+        if (
+            self.config.operator_kill_p > 0
+            and self.rng.random() < self.config.operator_kill_p
+        ):
+            self.stats.operator_kills += 1
+            self._count("operator_kill")
+            raise ChaosKillError("injected operator death")
 
     def maybe_kill_frontend(self, candidates: list):
         """Consulted once per fleet-supervisor monitor tick: on a hit,

@@ -189,6 +189,10 @@ class ChaosConfig:
     # child is SIGKILLed — exercises restart backoff + budget-lease
     # reclamation while sibling processes keep streaming.
     frontend_kill_p: float = 0.0
+    # Probability (per autoscaler control cycle) the operator process
+    # dies before its step — exercises level-based convergence: the
+    # successor must finish any half-applied scale from live state.
+    operator_kill_p: float = 0.0
     # Injected per-frame latency: uniform in [0, latency_ms].
     latency_ms: float = 0.0
 

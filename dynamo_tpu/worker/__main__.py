@@ -61,6 +61,23 @@ def parse_args(argv=None):
     p.add_argument("--prefill-dispatch", choices=["queue", "push"], default="queue",
                    help="queue = competing-consumer work queue (reference behaviour); "
                         "push = round-robin RPC to a prefill worker")
+    # Closed-loop autoscaler (docs/autoscaler.md): "on" hands endpoint/
+    # card wiring to the WorkerRoleManager so the operator can MOVE this
+    # engine between the prefill and decode pools at runtime (admin RPC,
+    # drain-ordered) and retire it with zero downtime. "off" (default)
+    # is the exact pre-autoscaler wiring — serving is byte-identical.
+    p.add_argument("--autoscaler", choices=["on", "off"], default="off",
+                   help="register with the closed-loop SLA autoscaler: "
+                        "live pool moves + zero-downtime retirement via "
+                        "the workerctl admin endpoint")
+    p.add_argument("--autoscaler-role", choices=["decode", "prefill"], default=None,
+                   help="initial pool under --autoscaler on (default: decode, "
+                        "or prefill when --is-prefill-worker is set)")
+    p.add_argument("--sla-profile", default=None,
+                   help="profiled SLA npz (tools/profile_sweep.py) shipped "
+                        "inside this worker's model card so frontends and "
+                        "the planner discover the latency curves instead of "
+                        "needing a --qos-profile path")
     # engine shape knobs
     p.add_argument("--block-size", type=int, default=16)
     p.add_argument("--num-kv-blocks", type=int, default=2048)
@@ -226,6 +243,22 @@ def parse_lora_specs(entries: list[str], default_rank: int) -> list[tuple[str, i
     return out
 
 
+def adapter_cards(card, lora_specs) -> list:
+    """One ModelDeploymentCard per --lora adapter, derived from the base
+    card — shared by the plain serving path and the role manager so
+    both publish identical adapter metadata."""
+    import dataclasses as _dc
+
+    return [
+        _dc.replace(
+            card, name=lname,
+            lora={"adapter_id": lname, "base": card.name,
+                  "rank": lrank, "resident_tier": "G2"},
+        )
+        for lname, lrank, _lseed in lora_specs
+    ]
+
+
 def dp_rank_ports(base_port: int, dp_rank: int, stride: int = 4) -> dict:
     """Deterministic per-rank port block (reference analogue: vLLM
     dp_rank port math, components/backends/vllm/src/dynamo/vllm/
@@ -328,6 +361,18 @@ async def build_engine(args, config=None):
         max_batch_size=args.max_num_seqs,
         total_kv_blocks=args.num_kv_blocks,
     )
+    if getattr(args, "sla_profile", None):
+        # Ship the profiled latency curves inside the model card so
+        # frontends (admission-time TTFT prediction) and the planner
+        # pick them up via discovery instead of a --qos-profile CLI
+        # path copied to every box (ROADMAP 2c).
+        from dynamo_tpu.planner.interpolate import load_profile, profile_as_card_dict
+
+        prof_decode, prof_prefill = load_profile(args.sla_profile)
+        card.sla_profile = profile_as_card_dict(
+            decode=prof_decode, prefill=prof_prefill
+        )
+        log.info("sla profile %s embedded in model card", args.sla_profile)
     return engine, card
 
 
@@ -353,6 +398,50 @@ async def async_main(args) -> None:
 
     broadcaster = KvEventBroadcaster(engine.pool)
     engine.pool.set_event_sink(broadcaster.publish)
+
+    manager = None
+    if args.autoscaler == "on":
+        from dynamo_tpu.planner.actions import POOL_DECODE, POOL_PREFILL
+        from dynamo_tpu.runtime.chaos import ChaosInjector
+        from dynamo_tpu.worker.roles import WorkerRoleManager
+
+        cards = [card] + adapter_cards(card, lora_specs)
+        role = (
+            POOL_PREFILL
+            if args.is_prefill_worker or args.autoscaler_role == "prefill"
+            else POOL_DECODE
+        )
+        manager = await WorkerRoleManager(
+            rt, engine, cards, args, broadcaster,
+            chaos=ChaosInjector.from_config(rt.config.chaos),
+        ).start(role)
+        role = f"autoscaled {manager.role} worker"
+        print(
+            f"dynamo_tpu {role}: serving {card.name} in namespace "
+            f"{args.namespace} (workerctl/admin live)",
+            flush=True,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            with contextlib.suppress(NotImplementedError):
+                loop.add_signal_handler(sig, stop.set)
+        stop_task = loop.create_task(stop.wait())
+        retired_task = loop.create_task(manager.retired.wait())
+        await asyncio.wait(
+            (stop_task, retired_task), return_when=asyncio.FIRST_COMPLETED
+        )
+        for t in (stop_task, retired_task):
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
+        log.info("worker shutting down")
+        await manager.close()
+        stop_fn = getattr(engine, "stop", None)
+        if stop_fn is not None:
+            await stop_fn()
+        await rt.shutdown()
+        return
 
     comp = rt.namespace(args.namespace).component(args.component)
 
@@ -462,14 +551,8 @@ async def async_main(args) -> None:
         # lands on the same component/endpoint this engine serves —
         # adapters start cold in the tiers (resident_tier G2) and page
         # into G1 on first request.
-        import dataclasses as _dc
-
-        for lname, lrank, _lseed in lora_specs:
-            await register_model(rt, args.namespace, _dc.replace(
-                card, name=lname,
-                lora={"adapter_id": lname, "base": card.name,
-                      "rank": lrank, "resident_tier": "G2"},
-            ))
+        for acard in adapter_cards(card, lora_specs):
+            await register_model(rt, args.namespace, acard)
         role = "worker"
     rank = "" if args.dp_rank is None else f" [dp rank {args.dp_rank}/{args.dp_size}]"
     print(

@@ -1,0 +1,302 @@
+"""WorkerRoleManager: live prefill↔decode pool membership for one worker.
+
+PR 8 made disaggregated prefill/decode the default serving shape and
+PR 9 taught the fleet zero-failure drains; this module composes them so
+the autoscaler can MOVE an engine between the pools at runtime without
+restarting the process (and without losing its warm KV tiers — the
+engine object survives every transition):
+
+- **decode role** — the worker serves ``<component>/generate`` behind
+  the conditional-disagg decode handler, publishes its model card(s),
+  and answers KV events/load metrics, exactly like a ``--disagg auto``
+  worker today.
+- **prefill role** — the worker serves ``<prefill_component>/generate``
+  + ``kv_fetch`` and pulls queued prefill jobs, exactly like an
+  ``--is-prefill-worker`` today (no model card: frontends must route
+  only to decode workers).
+
+A transition is drain-ordered so no stream can fail: the old role's
+instances DEREGISTER first (the router stops picking this worker
+within one discovery event), in-flight streams then drain to
+completion (``ServeHandle.close``), the prefill puller finishes its
+current job, and only then do the new role's endpoints register. The
+lease-backed registration key ``autoscaler/<ns>/workers/<lease>``
+always names the worker's CURRENT role — the level-converging operator
+reads it as ground truth, and it dies with the process, so a killed
+worker can never leak a stale pool entry.
+
+The manager also serves the ``workerctl/admin`` endpoint (DIRECT
+instance routing): ``{"cmd": "set_role"|"retire"|"status"}`` — the
+autoscaler's actuation RPC surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Any
+
+from dynamo_tpu.kv_router.publisher import serve_kv_endpoints
+from dynamo_tpu.llm.model_card import register_model
+from dynamo_tpu.planner.actions import POOL_DECODE, POOL_PREFILL
+from dynamo_tpu.planner.actuate import worker_key
+from dynamo_tpu.runtime.logging import get_logger
+
+log = get_logger("worker.roles")
+
+ADMIN_COMPONENT = "workerctl"
+ADMIN_ENDPOINT = "admin"
+
+
+class WorkerRoleError(Exception):
+    """Typed failure of a role transition (bad role name, transition
+    already in flight at shutdown, …) — surfaced to the operator as the
+    admin RPC's error frame."""
+
+
+class WorkerRoleManager:
+    """Owns which pool this worker serves and performs the zero-failure
+    transitions between them. ``args`` is the parsed worker CLI
+    namespace (component names + disagg knobs); ``cards`` is the model
+    card list the decode role publishes (base card first)."""
+
+    def __init__(self, rt, engine, cards, args, broadcaster, chaos=None):
+        self.rt = rt
+        self.engine = engine
+        self.cards = list(cards)
+        self.args = args
+        self.broadcaster = broadcaster
+        self.chaos = chaos
+        self.namespace = args.namespace
+        self.role: str | None = None
+        self.retired = asyncio.Event()
+        self._lock = asyncio.Lock()
+        self._handles: list = []          # current role's ServeHandles
+        self._card_keys: list[str] = []   # published model-card store keys
+        self._puller = None
+        self._admin_handle = None
+        self._peer_handle = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self, role: str) -> "WorkerRoleManager":
+        if role not in (POOL_DECODE, POOL_PREFILL):
+            raise WorkerRoleError(f"unknown role {role!r}")
+        comp = self.rt.namespace(self.namespace).component(ADMIN_COMPONENT)
+        self._admin_handle = await comp.endpoint(ADMIN_ENDPOINT).serve(self._admin)
+        # G4 peer prefix serving is role-agnostic (host-tier reads):
+        # registered once, survives every transition.
+        if self.args.engine == "tpu":
+            from dynamo_tpu.llm.peer_kv import KV_PREFIX_ENDPOINT, make_kv_prefix_handler
+
+            wcomp = self.rt.namespace(self.namespace).component(self.args.component)
+            self._peer_handle = await wcomp.endpoint(KV_PREFIX_ENDPOINT).serve(
+                make_kv_prefix_handler(self.engine)
+            )
+        async with self._lock:
+            await self._activate(role)
+        return self
+
+    async def set_role(self, role: str) -> dict:
+        if role not in (POOL_DECODE, POOL_PREFILL):
+            raise WorkerRoleError(f"unknown role {role!r}")
+        async with self._lock:
+            if self.retired.is_set():
+                raise WorkerRoleError("worker is retiring")
+            if role == self.role:
+                return self.status()
+            log.info("pool move: %s → %s", self.role, role)
+            await self._deactivate()
+            await self._activate(role)
+            return self.status()
+
+    async def retire(self) -> None:
+        """Drain + deregister everything and signal the process to
+        exit — the scale-down half of zero-downtime replica scaling.
+        New work stops the moment the instances deregister; in-flight
+        streams complete inside the drain."""
+        async with self._lock:
+            if self.retired.is_set():
+                return
+            log.info("retiring (%s)", self.role)
+            await self._deactivate()
+            try:
+                await self.rt.store.delete(
+                    worker_key(self.namespace, await self.rt.primary_lease())
+                )
+            except Exception:  # noqa: BLE001 — the lease reaps the key anyway; retire must not fail on a flaky store
+                pass
+            self.retired.set()
+
+    async def close(self) -> None:
+        await self.retire()
+        for h in (self._peer_handle, self._admin_handle):
+            if h is not None:
+                await h.close()
+        self._peer_handle = self._admin_handle = None
+
+    # -- role wiring --------------------------------------------------------
+
+    async def _publish_registration(self) -> None:
+        lease = await self.rt.primary_lease()
+        await self.rt.store.put(
+            worker_key(self.namespace, lease),
+            json.dumps({
+                "role": self.role,
+                "pid": os.getpid(),
+                "instance_id": lease,
+                "model": self.cards[0].name if self.cards else "",
+            }).encode(),
+            lease_id=lease,
+        )
+
+    async def _activate(self, role: str) -> None:
+        if role == POOL_DECODE:
+            await self._activate_decode()
+        else:
+            await self._activate_prefill()
+        self.role = role
+        await self._publish_registration()
+
+    async def _deactivate(self) -> None:
+        """Drain-ordered teardown of the current role. Model cards are
+        deleted FIRST (frontends stop listing the model through this
+        instance), then each ServeHandle deregisters its instance and
+        drains its in-flight streams, then the prefill puller finishes
+        its current job."""
+        for key in self._card_keys:
+            try:
+                await self.rt.store.delete(key)
+            except Exception:  # noqa: BLE001 — lease-backed; at worst the card lingers until TTL
+                pass
+        self._card_keys = []
+        if self._puller is not None:
+            await self._puller.drain()
+            self._puller = None
+        for h in self._handles:
+            await h.close()
+        self._handles = []
+        self.role = None
+
+    async def _activate_decode(self) -> None:
+        args = self.args
+        comp = self.rt.namespace(self.namespace).component(args.component)
+        handler: Any = self.engine
+        if args.engine == "tpu" and args.disagg != "off":
+            from dynamo_tpu.llm.disagg import DisaggConfig, DisaggDecodeHandler
+            from dynamo_tpu.llm.peer_kv import KV_PREFIX_ENDPOINT, PeerPrefixFetcher
+            from dynamo_tpu.runtime.push_router import RouterMode
+            from dynamo_tpu.runtime.queue import WorkQueue
+
+            pcomp = self.rt.namespace(self.namespace).component(args.prefill_component)
+            cfg = DisaggConfig(
+                max_local_prefill_length=args.max_local_prefill_length,
+                prefill_component=args.prefill_component,
+                stream=not args.no_disagg_stream,
+            )
+            handler = DisaggDecodeHandler(
+                self.engine,
+                await pcomp.endpoint(cfg.prefill_endpoint).router(RouterMode.ROUND_ROBIN),
+                await pcomp.endpoint(cfg.fetch_endpoint).router(RouterMode.DIRECT),
+                cfg,
+                queue=(
+                    None if args.prefill_dispatch == "push"
+                    else WorkQueue(self.rt.store, cfg.queue_name)
+                ),
+                store=self.rt.store,
+            )
+            handler.bind_metrics(self.rt.metrics)
+            handler = PeerPrefixFetcher(
+                self.engine,
+                await comp.endpoint(KV_PREFIX_ENDPOINT).router(RouterMode.DIRECT),
+                inner=handler,
+            )
+        gen = handler
+
+        async def gen_handler(payload, ctx):
+            async for item in gen.generate(payload, ctx):
+                yield item
+
+        self._handles.append(await comp.endpoint(args.endpoint).serve(gen_handler))
+        self._handles.extend(
+            await serve_kv_endpoints(comp, self.broadcaster, self.engine.metrics)
+        )
+        if hasattr(self.engine, "embed"):
+            engine = self.engine
+
+            async def embed_handler(payload, ctx):
+                try:
+                    vec = await engine.embed((payload or {}).get("token_ids") or [])
+                    yield {"embedding": vec}
+                except Exception as e:  # noqa: BLE001 — per-request failure
+                    yield {"error": str(e)}
+
+            self._handles.append(await comp.endpoint("embed").serve(embed_handler))
+        if hasattr(self.engine, "clear_kv_blocks"):
+            engine = self.engine
+
+            async def clear_handler(payload, ctx):
+                yield {"cleared": engine.clear_kv_blocks()}
+
+            self._handles.append(await comp.endpoint("clear_kv").serve(clear_handler))
+        for card in self.cards:
+            self._card_keys.append(
+                await register_model(self.rt, self.namespace, card)
+            )
+
+    async def _activate_prefill(self) -> None:
+        from dynamo_tpu.llm.disagg import DisaggConfig, PrefillHandler, PrefillPuller
+        from dynamo_tpu.runtime.queue import WorkQueue
+
+        args = self.args
+        comp = self.rt.namespace(self.namespace).component(args.prefill_component)
+        dcfg = DisaggConfig(prefill_component=args.prefill_component)
+        handler = PrefillHandler(
+            self.engine, frame_bytes=dcfg.frame_bytes, chaos=self.chaos
+        )
+        gen_handle = await comp.endpoint(args.endpoint).serve(handler.generate)
+        self._handles.append(gen_handle)
+        self._handles.append(
+            await comp.endpoint(dcfg.fetch_endpoint).serve(handler.kv_fetch)
+        )
+        self._handles.extend(
+            await serve_kv_endpoints(comp, self.broadcaster, self.engine.metrics)
+        )
+        self._puller = PrefillPuller(
+            self.engine,
+            WorkQueue(self.rt.store, dcfg.queue_name),
+            self.rt.store,
+            gen_handle.instance.instance_id,
+        ).start()
+
+    # -- admin RPC ----------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "ok": True,
+            "role": self.role,
+            "pid": os.getpid(),
+            "retiring": self.retired.is_set(),
+        }
+
+    async def _admin(self, payload: Any, ctx):
+        cmd = (payload or {}).get("cmd")
+        try:
+            if cmd == "status":
+                yield self.status()
+            elif cmd == "set_role":
+                yield await self.set_role((payload or {}).get("role", ""))
+            elif cmd == "retire":
+                # Ack first, retire in the background: the drain may
+                # outlive the RPC's own deadline, and the operator
+                # converges on the registration key vanishing anyway.
+                yield {"ok": True, "retiring": True}
+                asyncio.get_running_loop().create_task(self.retire())
+            else:
+                yield {"error": f"unknown admin cmd {cmd!r}"}
+        except WorkerRoleError as e:
+            yield {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 — an admin RPC must answer typed, never hang the operator on an unexpected transition failure
+            log.exception("admin cmd %s failed", cmd)
+            yield {"error": f"{type(e).__name__}: {e}"}

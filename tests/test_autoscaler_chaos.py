@@ -1,0 +1,332 @@
+"""Scale-action chaos: the closed loop must converge from half-applied
+actions with zero failed streams and no leaked keys.
+
+- **operator killed mid-scale** — the first operator dies while a
+  replica scale-up is in flight (the new worker registered, the action
+  never acknowledged). A successor operator converges level-based from
+  live registrations: no duplicate replica, no stuck state, and the
+  dead operator's journal dies with its lease.
+- **worker killed mid-pool-migration** (spawned processes, SIGKILL) —
+  the migration target dies mid-drain. Client streams ride the
+  Migration re-dispatch machinery and all complete; the victim's
+  lease-backed registrations vanish; the operator re-plans with the
+  survivors and converges to the desired split.
+"""
+
+import asyncio
+import json
+import signal
+import time
+
+import pytest
+
+from dynamo_tpu.planner.actions import (
+    POOL_DECODE,
+    POOL_PREFILL,
+    ActionJournal,
+    PoolMove,
+    ScaleActionError,
+)
+from dynamo_tpu.planner.actuate import RuntimeActuator
+from dynamo_tpu.planner.core import PlannerObservation
+from dynamo_tpu.planner.operator import (
+    ControlLaw,
+    OperatorConfig,
+    SlaAutoscaler,
+    register_planner_metrics,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.worker.roles import ADMIN_COMPONENT, ADMIN_ENDPOINT
+
+pytestmark = pytest.mark.chaos
+
+
+def law_cfg(**kw) -> OperatorConfig:
+    defaults = dict(
+        itl_sla_ms=20.0, ttft_sla_ms=300.0, mean_input_tokens=64.0,
+        mean_output_tokens=16.0, predictor="constant", max_engines=4,
+        hysteresis_cycles=1, cooldown_s=0.0, replica_scaling=True,
+        decode_tok_s=100.0, prefill_tok_s=1000.0, interval_s=0.1,
+    )
+    defaults.update(kw)
+    return OperatorConfig(**defaults)
+
+
+def test_operator_killed_mid_scale_successor_converges():
+    from test_worker_roles import NS, make_worker
+
+    async def go():
+        url = "memory://chaos-operator-kill"
+        wrt0, mgr0 = await make_worker(url, POOL_PREFILL)
+        wrt1, mgr1 = await make_worker(url, POOL_DECODE)
+        managers = [(wrt0, mgr0), (wrt1, mgr1)]
+
+        ort = await DistributedRuntime.create(store_url=url)
+        admin = await (
+            ort.namespace(NS).component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+
+        class Launcher:
+            def __init__(self):
+                self.launched = asyncio.Event()
+
+            async def launch(self, pool: str) -> None:
+                rt, mgr = await make_worker(url, pool)
+                managers.append((rt, mgr))
+                self.launched.set()
+
+        launcher = Launcher()
+        base = RuntimeActuator(ort.store, NS, admin, launcher=launcher,
+                               converge_timeout_s=10)
+
+        class StallingActuator:
+            """Completes the real scale, then hangs on the convergence
+            acknowledgement — the window an operator death hits."""
+
+            async def pools(self):
+                return await base.pools()
+
+            async def scale(self, action):
+                await base.scale(action)
+                await asyncio.Event().wait()  # never acknowledges
+
+            async def move(self, action):
+                await base.move(action)
+
+        breach = PlannerObservation(request_rate=2.0, itl_ms=90.0, ttft_ms=20.0)
+
+        async def observe():
+            return breach
+
+        lease_a = await ort.store.grant_lease(30)
+        op_a = SlaAutoscaler(
+            ControlLaw(law_cfg()), observe, pool_actuator=StallingActuator(),
+            journal=ActionJournal(ort.store, "op", lease_a),
+        )
+        step = asyncio.get_running_loop().create_task(op_a.step())
+        await asyncio.wait_for(launcher.launched.wait(), 10)
+        await asyncio.sleep(0.1)
+        step.cancel()  # the operator dies mid-scale
+        with pytest.raises(asyncio.CancelledError):
+            await step
+        # Its journal shows only the un-acknowledged intent, and dies
+        # with its lease — no planner/ keys leak.
+        entries = await ActionJournal(ort.store, "op", 0).entries()
+        assert entries and entries[-1]["phase"] == "started"
+        await ort.store.revoke_lease(lease_a)
+        assert await ort.store.get_prefix("planner/op/") == []
+
+        # Successor: live state already satisfies demand (the replica
+        # registered before the kill) — with observations showing the
+        # SLOs healthy at a load that needs exactly two decode
+        # replicas, it must HOLD: no double-scale, no premature shrink.
+        healthy = PlannerObservation(
+            request_rate=2.0, output_token_rate=150.0, itl_ms=5.0, ttft_ms=20.0,
+        )
+
+        async def observe_b():
+            return healthy
+
+        op_b = SlaAutoscaler(
+            ControlLaw(law_cfg()), observe_b, pool_actuator=base,
+            journal=ActionJournal(ort.store, "op-b", await ort.primary_lease()),
+        )
+        for _ in range(3):
+            await op_b.step()
+        pools = await base.pools()
+        assert len(pools[POOL_DECODE]) == 2, "successor must not double-scale"
+        assert len(pools[POOL_PREFILL]) == 1
+        assert op_b.actions_done == []
+
+        for rt, mgr in managers:
+            await mgr.close()
+            await rt.shutdown()
+        await ort.shutdown()
+
+    asyncio.run(go())
+
+
+def test_chaos_injector_kills_operator_loop():
+    from dynamo_tpu.runtime.chaos import ChaosInjector
+    from test_worker_roles import NS  # noqa: F401 — marker import parity
+
+    async def go():
+        chaos = ChaosInjector(operator_kill_p=1.0, seed=7)
+
+        async def observe():
+            return PlannerObservation(request_rate=1.0)
+
+        auto = SlaAutoscaler(
+            ControlLaw(law_cfg(interval_s=0.01)), observe, chaos=chaos,
+        )
+        task = asyncio.get_running_loop().create_task(auto.run())
+        with pytest.raises(Exception, match="injected operator death"):
+            await asyncio.wait_for(task, 5)
+        return chaos.stats.operator_kills
+
+    assert asyncio.run(go()) == 1
+
+
+@pytest.mark.e2e
+def test_worker_sigkill_mid_pool_migration_fleet_converges():
+    """Spawned mocker workers over a TCP store; the pool-move victim is
+    SIGKILLed mid-migration. Traffic (Migration-wrapped, the frontend's
+    own re-dispatch machinery) must complete every stream; the operator
+    re-plans with the survivors and converges to 2P/1D."""
+    import socket
+
+    from procutil import ManagedProcess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        store_port = s.getsockname()[1]
+    store_url = f"tcp://127.0.0.1:{store_port}"
+    procs: list[ManagedProcess] = []
+
+    def spawn_worker(role: str) -> ManagedProcess:
+        p = ManagedProcess(
+            ["-m", "dynamo_tpu.worker", "--store-url", store_url,
+             "--engine", "mocker", "--autoscaler", "on",
+             "--autoscaler-role", role,
+             "--mocker-ttft-ms", "1", "--mocker-itl-ms", "4",
+             "--max-num-seqs", "64"],
+            name=f"worker-{role}-{len(procs)}",
+        )
+        procs.append(p)
+        p.wait_for(rf"autoscaled {role} worker")
+        return p
+
+    async def go():
+        ort = await DistributedRuntime.create(store_url=store_url)
+        admin = await (
+            ort.namespace("dynamo").component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        act = RuntimeActuator(ort.store, "dynamo", admin, converge_timeout_s=15)
+
+        # Traffic rides the frontend's Migration operator: a stream cut
+        # by the SIGKILL re-dispatches to a surviving decode worker.
+        from dynamo_tpu.llm.migration import Migration
+        from dynamo_tpu.llm.pipeline import _RouterEngine
+
+        gen = await (
+            ort.namespace("dynamo").component("backend").endpoint("generate")
+            .router(RouterMode.ROUND_ROBIN)
+        )
+        eng = Migration(_RouterEngine(gen), migration_limit=3)
+        stats = {"ok": 0, "failed": 0, "errors": []}
+        stop = asyncio.Event()
+
+        async def traffic():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                req = {
+                    "model": "mock-model",
+                    "token_ids": list(range(16)),
+                    "stop": {"max_tokens": 30, "ignore_eos": True},
+                    "sampling": {"seed": i},
+                    "eos_token_ids": [0],
+                }
+                try:
+                    tokens = 0
+                    async for frame in eng.generate(req, Context()):
+                        if isinstance(frame, dict):
+                            tokens += len(frame.get("token_ids") or ())
+                    if tokens >= 30:
+                        stats["ok"] += 1
+                    else:
+                        stats["failed"] += 1
+                        stats["errors"].append(f"short stream: {tokens}")
+                except Exception as e:  # noqa: BLE001 — a failed client stream IS the assertion target
+                    stats["failed"] += 1
+                    stats["errors"].append(f"{type(e).__name__}: {e}")
+                await asyncio.sleep(0.005)
+
+        tasks = [asyncio.get_running_loop().create_task(traffic())
+                 for _ in range(4)]
+
+        pools = await act.pools()
+        assert len(pools[POOL_DECODE]) == 3 and len(pools[POOL_PREFILL]) == 1
+        victim = pools[POOL_DECODE][-1]  # what the actuator would pick
+
+        # Command the move, then SIGKILL the victim mid-migration.
+        move = asyncio.get_running_loop().create_task(
+            act.move(PoolMove(worker=victim.key, instance_id=victim.instance_id,
+                              src=POOL_DECODE, dst=POOL_PREFILL))
+        )
+        await asyncio.sleep(0.05)
+        victim_proc = next(p for p in procs if p.proc.pid == victim.pid)
+        victim_proc.kill(signal.SIGKILL)
+        try:
+            await move
+            move_outcome = "ok"  # the flip won the race with the kill
+        except ScaleActionError:
+            move_outcome = "error"
+
+        # The victim's lease-backed state must vanish (TCP store revokes
+        # on disconnect) — no leaked registration/instance keys.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            pools = await act.pools()
+            regs = await ort.store.get_prefix("autoscaler/dynamo/workers/")
+            if len(regs) == 3 and all(
+                json.loads(e.value)["pid"] != victim.pid for e in regs
+            ):
+                break
+            await asyncio.sleep(0.2)
+        else:
+            raise AssertionError(f"victim registration never reaped: {pools}")
+
+        # Operator convergence: the TTFT breach persists, so the loop
+        # must finish the job with a surviving decode worker → 2P/1D
+        # (unless the victim's flip already won the race).
+        breach = PlannerObservation(request_rate=5.0, ttft_ms=900.0, itl_ms=5.0)
+
+        async def observe():
+            return breach
+
+        reg = register_planner_metrics(ort.metrics)
+        auto = SlaAutoscaler(
+            ControlLaw(law_cfg(replica_scaling=False, max_engines=4)),
+            observe, pool_actuator=act, metrics=reg,
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            await auto.step()
+            pools = await act.pools()
+            if len(pools[POOL_PREFILL]) >= 2 and len(pools[POOL_DECODE]) >= 1:
+                break
+            await asyncio.sleep(0.1)
+        pools = await act.pools()
+        assert len(pools[POOL_PREFILL]) >= 2, f"never converged: {pools} ({move_outcome})"
+        assert len(pools[POOL_DECODE]) >= 1
+
+        # Streams keep flowing a beat past convergence, then the books
+        # must balance: zero failed client streams through kill + moves.
+        await asyncio.sleep(1.0)
+        stop.set()
+        await asyncio.gather(*tasks)
+        assert stats["failed"] == 0, stats["errors"][:5]
+        assert stats["ok"] > 20, stats
+
+        await ort.shutdown()
+
+    try:
+        store = ManagedProcess(
+            ["-m", "dynamo_tpu.runtime.store_server",
+             "--host", "127.0.0.1", "--port", str(store_port)],
+            name="store",
+        )
+        procs.append(store)
+        store.wait_for(r"store server: tcp://")
+        spawn_worker("prefill")
+        for _ in range(3):
+            spawn_worker("decode")
+        asyncio.run(go())
+    finally:
+        for p in reversed(procs):
+            p.terminate()

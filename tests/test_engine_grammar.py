@@ -165,12 +165,25 @@ class TestGreedyByteIdentity:
 
 class TestSampledConstrained:
     def test_sampled_valid_and_deterministic(self):
+        # Sequential submission ON PURPOSE: adaptive spec budgets are
+        # batch-level, so concurrently-submitted rows' sampled bytes
+        # depend on which rows share a pass — reproducible only when
+        # scheduler timing is (it was on the 2-core container; a 1-cpu
+        # host flakes it). One row per batch pins the composition, so
+        # the assertion tests the seeded sampling path itself.
+        async def run_sequential(eargs, rs):
+            engine = await TpuEngine(eargs).start()
+            try:
+                return [await run_stream(engine, r) for r in rs]
+            finally:
+                await engine.stop()
+
         reqs = lambda: [
             request(f"record {i}", temperature=0.9, seed=50 + i, max_tokens=96)
             for i in range(3)
         ]
-        a, _ = asyncio.run(run_workload(engine_args(S=8), reqs()))
-        b, _ = asyncio.run(run_workload(engine_args(S=8), reqs()))
+        a = asyncio.run(run_sequential(engine_args(S=8), reqs()))
+        b = asyncio.run(run_sequential(engine_args(S=8), reqs()))
         assert a == b, "seeded constrained sampling must be reproducible"
         for toks, finish in a:
             assert finish == "stop"

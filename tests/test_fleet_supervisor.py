@@ -9,6 +9,7 @@ requests; SIGTERM drains the whole fleet and leaves no shared state
 behind in the store."""
 
 import asyncio
+import threading
 import json
 import signal
 import socket
@@ -268,3 +269,72 @@ def test_sigterm_drains_fleet_and_clears_shared_state():
                 await store.close()
 
         asyncio.run(probe())
+
+
+def test_fleet_resize_rpc_grows_and_shrinks_without_failures():
+    """POST /fleet/resize — the autoscaler's frontend actuation: grow
+    1 → 2 (new child registers and serves), shrink 2 → 1 through the
+    zero-failure drain, with traffic flowing throughout."""
+    with FleetHarness(n=1) as h:
+        ok = [0]
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    r = h.chat(f"resize {i}")
+                    if r.status_code == 200:
+                        ok[0] += 1
+                    elif r.status_code not in (429, 503):
+                        errors.append(f"status {r.status_code}")
+                except Exception as e:  # noqa: BLE001 — a transport error during resize IS the failure signal
+                    errors.append(f"{type(e).__name__}: {e}")
+                time.sleep(0.02)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            r = httpx.post(f"{h.admin}/fleet/resize", json={"n": 2}, timeout=90)
+            assert r.status_code == 200, r.text
+            assert r.json()["fleet_size"] == 2 and r.json()["grew"] == 1
+            # The operator's actuator reads the size off GET /fleet —
+            # regression: the key must exist there, not only on /health.
+            assert h.status()["fleet_size"] == 2
+
+            async def via_actuator():
+                from dynamo_tpu.planner.actuate import FleetHttpActuator
+
+                return await FleetHttpActuator(h.admin).fleet_size()
+
+            assert asyncio.run(via_actuator()) == 2
+            st = h.status()
+            assert len(st["workers"]) == 2
+            # Both children must end up serving (registration-backed).
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                st = h.status()
+                if all(w["registered"] and w["alive"] for w in st["workers"]):
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError(f"grown fleet never converged: {st}")
+
+            r = httpx.post(f"{h.admin}/fleet/resize", json={"n": 1}, timeout=90)
+            assert r.status_code == 200, r.text
+            assert r.json()["fleet_size"] == 1 and r.json()["shrank"] == 1
+            st = h.status()
+            assert len(st["workers"]) == 1 and st["workers"][0]["alive"]
+            # A few post-shrink requests prove the survivor serves.
+            for i in range(6):
+                assert h.chat(f"after {i}").status_code == 200
+        finally:
+            stop.set()
+            t.join(10)
+        assert not errors, errors[:5]
+        assert ok[0] > 0
+        # Bad bodies are typed 400s, never crashes.
+        assert httpx.post(f"{h.admin}/fleet/resize", json={"n": 0}, timeout=10).status_code == 400
+        assert httpx.post(f"{h.admin}/fleet/resize", json={}, timeout=10).status_code == 400

@@ -1,0 +1,31 @@
+"""Tier-1 guard for tools/profile_planner.py: the closed-loop smoke
+drives ONE REAL SCALE-UP and ONE REAL POOL MOVE through the live
+observe→decide→actuate stack (in-process workers + RuntimeActuator +
+SlaAutoscaler) with traffic streaming throughout, and asserts itself:
+both actions ok, zero failed/short streams, the planner_* metric series
+present, and no leaked autoscaler/model/instance keys after teardown —
+so the actuation path can't bit-rot between perf rounds."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_planner_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_planner.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["ok"], result
+    assert result["scale_up_ok"] and result["pool_move_ok"]
+    assert result["streams_failed"] == 0 and result["streams_ok"] > 0
+    assert result["metrics"]["replica_scale_ok"] >= 1
+    assert result["metrics"]["pool_move_ok"] >= 1
+    assert result["leaked_keys"] == []

@@ -1,0 +1,283 @@
+"""WorkerRoleManager: live prefill↔decode pool moves on a real runtime
+(memory store, mocker engine) — registration truth, drain-ordered
+transitions with an in-flight stream completing across the move,
+retirement leaving zero keys, and the admin RPC surface the autoscaler
+actuates through."""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.planner.actions import POOL_DECODE, POOL_PREFILL, PoolMove
+from dynamo_tpu.planner.actuate import RuntimeActuator, read_pools, worker_key
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.worker.roles import (
+    ADMIN_COMPONENT,
+    ADMIN_ENDPOINT,
+    WorkerRoleManager,
+)
+
+pytestmark = pytest.mark.integration
+
+NS = "roles-test"
+
+
+def wargs() -> SimpleNamespace:
+    return SimpleNamespace(
+        namespace=NS, component="backend", prefill_component="prefill",
+        endpoint="generate", engine="mocker", disagg="auto",
+        max_local_prefill_length=512, no_disagg_stream=False,
+        prefill_dispatch="queue",
+    )
+
+
+async def make_worker(url: str, role: str, itl_ms: float = 0.1):
+    rt = await DistributedRuntime.create(store_url=url)
+    engine = MockerEngine(
+        MockerArgs(block_size=4, num_kv_blocks=128, max_num_seqs=32,
+                   ttft_ms=0.5, itl_ms=itl_ms)
+    )
+    bc = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(bc.publish)
+    card = ModelDeploymentCard(
+        name="roles-model", kv_cache_block_size=4,
+        eos_token_ids=[ByteTokenizer.EOS], context_length=512,
+    )
+    mgr = await WorkerRoleManager(rt, engine, [card], wargs(), bc).start(role)
+    return rt, mgr
+
+
+def req_dict(i: int, max_tokens: int = 8) -> dict:
+    return {
+        "model": "roles-model",
+        "token_ids": list(range(16)),
+        "stop": {"max_tokens": max_tokens, "ignore_eos": True},
+        "sampling": {"seed": i},
+        "eos_token_ids": [ByteTokenizer.EOS],
+    }
+
+
+def test_role_round_trip_registrations_and_cards():
+    async def go():
+        url = "memory://roles-roundtrip"
+        wrt, mgr = await make_worker(url, POOL_DECODE)
+        ort = await DistributedRuntime.create(store_url=url)
+        router = await (
+            ort.namespace(NS).component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        act = RuntimeActuator(ort.store, NS, router, converge_timeout_s=10)
+
+        pools = await act.pools()
+        assert len(pools[POOL_DECODE]) == 1 and not pools[POOL_PREFILL]
+        assert len(await ort.store.get_prefix("models/")) == 1
+
+        # Registration value names the role + instance for the operator.
+        lease = await wrt.primary_lease()
+        entry = await ort.store.get(worker_key(NS, lease))
+        reg = json.loads(entry.value)
+        assert reg["role"] == POOL_DECODE and reg["instance_id"] == lease
+
+        await act.move(PoolMove(worker="", instance_id=0,
+                                src=POOL_DECODE, dst=POOL_PREFILL))
+        pools = await act.pools()
+        assert len(pools[POOL_PREFILL]) == 1 and not pools[POOL_DECODE]
+        # No model card under the prefill role: frontends must route
+        # only to decode workers.
+        assert await ort.store.get_prefix("models/") == []
+        # Prefill endpoints live (generate + kv_fetch).
+        assert any(
+            "/prefill/generate:" in e.key
+            for e in await ort.store.get_prefix(f"instances/{NS}/")
+        )
+
+        await act.move(PoolMove(worker="", instance_id=0,
+                                src=POOL_PREFILL, dst=POOL_DECODE))
+        pools = await act.pools()
+        assert len(pools[POOL_DECODE]) == 1
+        assert len(await ort.store.get_prefix("models/")) == 1
+
+        await mgr.close()
+        await wrt.shutdown()
+        await ort.shutdown()
+
+    asyncio.run(go())
+
+
+def test_in_flight_stream_completes_across_pool_move():
+    """The zero-failure drain contract: a stream running on the worker
+    when the move is commanded finishes with its full token count; the
+    move completes after."""
+
+    async def go():
+        url = "memory://roles-drain"
+        wrt, mgr = await make_worker(url, POOL_DECODE, itl_ms=10.0)
+        ort = await DistributedRuntime.create(store_url=url)
+        admin = await (
+            ort.namespace(NS).component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        act = RuntimeActuator(ort.store, NS, admin, converge_timeout_s=20)
+        gen = await (
+            ort.namespace(NS).component("backend").endpoint("generate")
+            .router(RouterMode.ROUND_ROBIN)
+        )
+
+        async def slow_stream():
+            tokens = 0
+            async for frame in gen.generate(req_dict(1, max_tokens=40), Context()):
+                if isinstance(frame, dict):
+                    tokens += len(frame.get("token_ids") or ())
+            return tokens
+
+        stream = asyncio.get_running_loop().create_task(slow_stream())
+        await asyncio.sleep(0.05)  # stream is mid-flight (~400ms total)
+        assert not stream.done()
+        await act.move(PoolMove(worker="", instance_id=0,
+                                src=POOL_DECODE, dst=POOL_PREFILL))
+        tokens = await stream
+        assert tokens == 40, f"stream lost tokens across the move: {tokens}"
+        pools = await act.pools()
+        assert len(pools[POOL_PREFILL]) == 1
+
+        await mgr.close()
+        await wrt.shutdown()
+        await ort.shutdown()
+
+    asyncio.run(go())
+
+
+def test_retire_drains_and_leaves_zero_keys():
+    async def go():
+        url = "memory://roles-retire"
+        wrt, mgr = await make_worker(url, POOL_DECODE, itl_ms=5.0)
+        ort = await DistributedRuntime.create(store_url=url)
+        admin = await (
+            ort.namespace(NS).component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        gen = await (
+            ort.namespace(NS).component("backend").endpoint("generate")
+            .router(RouterMode.ROUND_ROBIN)
+        )
+
+        async def stream():
+            tokens = 0
+            async for frame in gen.generate(req_dict(2, max_tokens=20), Context()):
+                if isinstance(frame, dict):
+                    tokens += len(frame.get("token_ids") or ())
+            return tokens
+
+        s = asyncio.get_running_loop().create_task(stream())
+        await asyncio.sleep(0.03)
+        lease = await wrt.primary_lease()
+        frames = []
+        async for f in admin.generate({"cmd": "retire"}, Context(),
+                                      instance_id=lease):
+            frames.append(f)
+        assert frames and frames[0].get("ok")
+        assert await s == 20  # in-flight stream drained to completion
+        await mgr.retired.wait()
+        # Everything deregistered: generate/kv endpoints, model card,
+        # autoscaler registration.
+        for prefix in ("autoscaler/", "models/"):
+            assert await ort.store.get_prefix(prefix) == [], prefix
+        gen_keys = [
+            e.key for e in await ort.store.get_prefix(f"instances/{NS}/backend/generate")
+        ]
+        assert gen_keys == []
+
+        await mgr.close()
+        await wrt.shutdown()
+        await ort.shutdown()
+
+    asyncio.run(go())
+
+
+def test_admin_rpc_rejects_unknown_commands_and_roles():
+    async def go():
+        url = "memory://roles-admin"
+        wrt, mgr = await make_worker(url, POOL_DECODE)
+        ort = await DistributedRuntime.create(store_url=url)
+        admin = await (
+            ort.namespace(NS).component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        lease = await wrt.primary_lease()
+
+        async def rpc(payload):
+            frames = []
+            async for f in admin.generate(payload, Context(), instance_id=lease):
+                frames.append(f)
+            return frames[-1]
+
+        assert "error" in await rpc({"cmd": "bogus"})
+        assert "error" in await rpc({"cmd": "set_role", "role": "sideways"})
+        status = await rpc({"cmd": "status"})
+        assert status["role"] == POOL_DECODE and status["ok"]
+        # set_role to the current role is an idempotent no-op.
+        same = await rpc({"cmd": "set_role", "role": POOL_DECODE})
+        assert same["role"] == POOL_DECODE
+
+        await mgr.close()
+        await wrt.shutdown()
+        await ort.shutdown()
+
+    asyncio.run(go())
+
+
+def test_read_pools_tolerates_junk_entries():
+    async def go():
+        from dynamo_tpu.runtime.store import connect_store
+
+        store = await connect_store("memory://roles-junk")
+        await store.put(f"autoscaler/{NS}/workers/zz", b"not json")
+        await store.put(
+            f"autoscaler/{NS}/workers/1f",
+            json.dumps({"role": POOL_DECODE, "instance_id": 31}).encode(),
+        )
+        pools = await read_pools(store, NS)
+        assert [w.instance_id for w in pools[POOL_DECODE]] == [31]
+        return pools
+
+    asyncio.run(go())
+
+
+def test_replica_scale_down_retires_distinct_victims():
+    """Regression: the retire RPC acks before the registration key
+    vanishes (background drain) — a multi-step shrink must not re-pick
+    the same still-registered victim and then stall out."""
+
+    async def go():
+        from dynamo_tpu.planner.actions import ReplicaScale
+
+        url = "memory://roles-shrink"
+        workers = [await make_worker(url, POOL_DECODE) for _ in range(3)]
+        ort = await DistributedRuntime.create(store_url=url)
+        admin = await (
+            ort.namespace(NS).component(ADMIN_COMPONENT)
+            .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        act = RuntimeActuator(ort.store, NS, admin, converge_timeout_s=15)
+        assert len((await act.pools())[POOL_DECODE]) == 3
+
+        await act.scale(ReplicaScale(pool=POOL_DECODE, target=1, current=3))
+        pools = await act.pools()
+        assert len(pools[POOL_DECODE]) == 1, pools
+        retired = [m for _, m in workers if m.retired.is_set()]
+        assert len(retired) == 2, "exactly two distinct workers must retire"
+
+        for rt, mgr in workers:
+            await mgr.close()
+            await rt.shutdown()
+        await ort.shutdown()
+
+    asyncio.run(go())

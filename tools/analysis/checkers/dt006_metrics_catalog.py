@@ -100,11 +100,19 @@ async def build_registries():
     fleet_registry = MetricsRegistry()
     register_fleet_metrics(fleet_registry)
 
+    # Closed-loop autoscaler series (planner/operator.py): registered on
+    # their own registry as the operator CLI does.
+    from dynamo_tpu.planner.operator import register_planner_metrics
+
+    planner_registry = MetricsRegistry()
+    register_planner_metrics(planner_registry)
+
     registries = [
         ("worker", wrt.metrics),
         ("frontend", frt.metrics),
         ("exporter", ert.metrics),
         ("fleet", fleet_registry),
+        ("planner", planner_registry),
     ]
 
     async def cleanup():

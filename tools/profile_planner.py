@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Drive the closed-loop SLA autoscaler LIVE: a real scale-up and a real
+pool move through the full observe→decide→actuate stack, with traffic
+streaming the whole time.
+
+What actually runs (no simulation here):
+
+- in-process workers — each its own DistributedRuntime (own lease,
+  endpoints, registrations) over one shared store — wired through
+  :class:`~dynamo_tpu.worker.roles.WorkerRoleManager`;
+- the operator — :class:`~dynamo_tpu.planner.operator.SlaAutoscaler`
+  with the production :class:`~dynamo_tpu.planner.actuate.
+  RuntimeActuator`: pool state from the store registrations, role moves
+  over the ``workerctl/admin`` RPC, replica scale-up through a launcher
+  (here: builds another in-process worker — process spawn is exercised
+  by ProcessReplicaLauncher in production);
+- continuous client streams against the decode pool's ``generate``
+  endpoint throughout both actions — the zero-failed-streams assertion.
+
+Scripted observations force the decisions (an ITL breach → replica
+scale-up; then a TTFT breach → decode→prefill pool move), because the
+point is the ACTUATION path, not the mocker's latency realism.
+
+``--quick`` (tier-1, tests/test_profile_planner_smoke.py) asserts:
+both action kinds actuated ok, every client stream completed, the
+planner metric series present, and no leaked autoscaler/planner keys
+after teardown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from types import SimpleNamespace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.mocker.engine import MockerArgs, MockerEngine
+from dynamo_tpu.planner.actions import (
+    POOL_DECODE,
+    POOL_PREFILL,
+    ActionJournal,
+)
+from dynamo_tpu.planner.actuate import RuntimeActuator
+from dynamo_tpu.planner.core import PlannerObservation
+from dynamo_tpu.planner.operator import (
+    ControlLaw,
+    OperatorConfig,
+    SlaAutoscaler,
+    register_planner_metrics,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.worker.roles import ADMIN_COMPONENT, ADMIN_ENDPOINT, WorkerRoleManager
+
+NS = "planner-profile"
+
+
+def worker_args() -> SimpleNamespace:
+    return SimpleNamespace(
+        namespace=NS, component="backend", prefill_component="prefill",
+        endpoint="generate", engine="mocker", disagg="auto",
+        max_local_prefill_length=512, no_disagg_stream=False,
+        prefill_dispatch="queue",
+    )
+
+
+class InprocWorker:
+    """One live worker: own runtime + mocker engine + role manager."""
+
+    def __init__(self, store_url: str, role: str):
+        self.store_url = store_url
+        self.role = role
+        self.rt = None
+        self.manager = None
+
+    async def start(self) -> "InprocWorker":
+        self.rt = await DistributedRuntime.create(store_url=self.store_url)
+        engine = MockerEngine(
+            MockerArgs(block_size=4, num_kv_blocks=256, max_num_seqs=64,
+                       speedup=200.0)
+        )
+        bc = KvEventBroadcaster(engine.pool)
+        engine.pool.set_event_sink(bc.publish)
+        card = ModelDeploymentCard(
+            name="profile-model", kv_cache_block_size=4,
+            eos_token_ids=[ByteTokenizer.EOS], context_length=512,
+        )
+        self.manager = await WorkerRoleManager(
+            self.rt, engine, [card], worker_args(), bc
+        ).start(self.role)
+        return self
+
+    async def close(self) -> None:
+        if self.manager is not None:
+            await self.manager.close()
+        if self.rt is not None:
+            await self.rt.shutdown()
+
+
+class InprocLauncher:
+    """Replica launcher building in-process workers (the production
+    ProcessReplicaLauncher spawns `python -m dynamo_tpu.worker`)."""
+
+    def __init__(self, store_url: str):
+        self.store_url = store_url
+        self.workers: list[InprocWorker] = []
+
+    async def launch(self, pool: str) -> None:
+        self.workers.append(await InprocWorker(self.store_url, pool).start())
+
+
+async def drive_traffic(router, stop_evt: asyncio.Event, stats: dict) -> None:
+    """Continuous short streams against the decode pool; every stream
+    must complete with a full token count."""
+    i = 0
+    while not stop_evt.is_set():
+        i += 1
+        req = {
+            "model": "profile-model",
+            "token_ids": list(range(16 + (i % 8))),
+            "stop": {"max_tokens": 8, "ignore_eos": True},
+            "sampling": {"seed": i},
+            "eos_token_ids": [ByteTokenizer.EOS],
+        }
+        try:
+            tokens = 0
+            async for frame in router.generate(req, Context()):
+                if isinstance(frame, dict):
+                    tokens += len(frame.get("token_ids") or ())
+            if tokens >= 8:
+                stats["ok"] += 1
+            else:
+                stats["short"] += 1
+        except Exception as e:  # noqa: BLE001 — a failed stream IS the smoke's failure signal; count it, don't crash the driver
+            stats["failed"] += 1
+            stats.setdefault("errors", []).append(f"{type(e).__name__}: {e}")
+        await asyncio.sleep(0.01)
+
+
+async def run(quick: bool) -> dict:
+    store_url = f"memory://profile-planner-{int(time.time() * 1000)}"
+    launcher = InprocLauncher(store_url)
+    w0 = await InprocWorker(store_url, POOL_PREFILL).start()
+    w1 = await InprocWorker(store_url, POOL_DECODE).start()
+
+    ort = await DistributedRuntime.create(store_url=store_url)
+    admin_router = await (
+        ort.namespace(NS).component(ADMIN_COMPONENT)
+        .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+    )
+    actuator = RuntimeActuator(
+        ort.store, NS, admin_router, launcher=launcher, converge_timeout_s=30.0
+    )
+    cfg = OperatorConfig(
+        operator_id="profile",
+        interval_s=0.2,
+        itl_sla_ms=20.0,
+        ttft_sla_ms=200.0,
+        mean_input_tokens=64.0,
+        mean_output_tokens=16.0,
+        predictor="constant",
+        max_engines=3,
+        hysteresis_cycles=1,
+        cooldown_s=0.0,
+        replica_scaling=True,
+        decode_tok_s=100.0,
+        prefill_tok_s=1000.0,
+    )
+    script: list[PlannerObservation] = []
+
+    async def observe():
+        if script:
+            return script.pop(0)
+        return PlannerObservation(request_rate=1.0, ttft_ms=10.0, itl_ms=5.0)
+
+    metrics = register_planner_metrics(ort.metrics)
+    auto = SlaAutoscaler(
+        ControlLaw(cfg),
+        observe,
+        pool_actuator=actuator,
+        journal=ActionJournal(ort.store, "profile", await ort.primary_lease()),
+        metrics=metrics,
+    )
+
+    gen_router = await (
+        ort.namespace(NS).component("backend").endpoint("generate")
+        .router(RouterMode.ROUND_ROBIN)
+    )
+    stats = {"ok": 0, "short": 0, "failed": 0}
+    stop_evt = asyncio.Event()
+    traffic = asyncio.get_running_loop().create_task(
+        drive_traffic(gen_router, stop_evt, stats)
+    )
+
+    t0 = time.monotonic()
+    # Step 1 — REAL SCALE-UP: sustained ITL breach ⇒ decode pool 1 → 2;
+    # the launcher builds a live worker and the action completes only
+    # once it has REGISTERED (the zero-downtime contract).
+    script.append(PlannerObservation(request_rate=2.0, itl_ms=100.0, ttft_ms=20.0))
+    await auto.step()
+    pools = await actuator.pools()
+    scale_ok = len(pools[POOL_DECODE]) == 2
+    # Step 2 — REAL POOL MOVE: sustained TTFT breach with decode
+    # headroom ⇒ one decode worker drains, deregisters, re-registers as
+    # prefill (WorkerRoleManager.set_role over the admin RPC).
+    script.append(PlannerObservation(request_rate=2.0, itl_ms=5.0, ttft_ms=900.0))
+    await auto.step()
+    pools = await actuator.pools()
+    move_ok = len(pools[POOL_PREFILL]) == 2 and len(pools[POOL_DECODE]) == 1
+    actions_s = time.monotonic() - t0
+
+    # Traffic keeps flowing a beat longer so streams straddle the moves.
+    await asyncio.sleep(0.5 if quick else 2.0)
+    stop_evt.set()
+    await traffic
+
+    journal = ActionJournal(ort.store, "profile", 0)
+    entries = await journal.entries()
+    kinds = sorted({(e["kind"], e["phase"]) for e in entries})
+    actions_metric = {
+        "replica_scale_ok": metrics["actions"].value(kind="replica_scale", outcome="ok"),
+        "pool_move_ok": metrics["actions"].value(kind="pool_move", outcome="ok"),
+    }
+    exposition = ort.metrics.render()
+    series_present = all(
+        name in exposition
+        for name in ("planner_scale_actions_total", "planner_pool_size",
+                     "planner_decision_lag_seconds")
+    )
+
+    # Teardown, then assert nothing leaked.
+    await auto.stop()
+    for w in (w0, w1, *launcher.workers):
+        await w.close()
+    leaked = [
+        e.key for prefix in ("autoscaler/", "models/", "instances/")
+        for e in await ort.store.get_prefix(prefix)
+    ]
+    await ort.shutdown()
+
+    result = {
+        "traffic_errors": stats.get("errors", [])[:5],
+        "scale_up_ok": scale_ok,
+        "pool_move_ok": move_ok,
+        "actions_wall_s": round(actions_s, 3),
+        "streams_ok": stats["ok"],
+        "streams_short": stats["short"],
+        "streams_failed": stats["failed"],
+        "journal": kinds,
+        "metrics": actions_metric,
+        "metric_series_present": series_present,
+        "leaked_keys": leaked,
+        "quick": quick,
+    }
+    ok = (
+        scale_ok and move_ok and stats["failed"] == 0 and stats["short"] == 0
+        and stats["ok"] > 0 and series_present
+        and actions_metric["replica_scale_ok"] >= 1
+        and actions_metric["pool_move_ok"] >= 1
+        and not leaked
+    )
+    result["ok"] = ok
+    return result
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tools/profile_planner.py")
+    p.add_argument("--quick", action="store_true",
+                   help="tier-1 smoke: one scale-up + one pool move, "
+                        "minimal traffic")
+    args = p.parse_args(argv)
+    result = asyncio.run(run(args.quick))
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
