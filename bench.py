@@ -44,7 +44,7 @@ def parse_args():
     p.add_argument("--workload", default="lognormal-mixed",
                    choices=["lognormal-mixed", "fixed", "repetitive",
                             "shared-prefix", "structured", "multi-lora",
-                            "multi-tenant", "diurnal"],
+                            "multi-tenant", "diurnal", "migrate"],
                    help="lognormal-mixed = ShareGPT-like regression workload; "
                         "repetitive = agentic/extractive prompts with high "
                         "n-gram overlap (the speculation-friendly shape) — "
@@ -63,7 +63,12 @@ def parse_args():
                         "diurnal = closed-loop SLA autoscaler vs best static "
                         "prefill:decode split on a seeded diurnal+burst trace "
                         "at equal chip count, SLO-attaining tok/s "
-                        "(benchmarks/diurnal.py, docs/autoscaler.md)")
+                        "(benchmarks/diurnal.py, docs/autoscaler.md); "
+                        "migrate = live-migration robustness bench: every "
+                        "request force-relocated mid-decode between two "
+                        "engines — cutover gap p50/p99, KV bytes moved, "
+                        "chaos fallback rate, byte-identity pinned "
+                        "(benchmarks/migrate.py, docs/robustness.md)")
     p.add_argument("--spec-budget", choices=["adaptive", "uniform"],
                    default="adaptive",
                    help="per-pass draft-node allocation (engine "
@@ -112,6 +117,9 @@ def parse_args():
                    help="diurnal workload: TTFT SLO seconds (incl. queue wait)")
     p.add_argument("--diurnal-itl-slo", type=float, default=40.0,
                    help="diurnal workload: mean-ITL SLO milliseconds")
+    p.add_argument("--migrate-cut-p", type=float, default=0.5,
+                   help="migrate workload: per-phase-boundary chaos cut "
+                        "probability for the fallback-rate arm")
     p.add_argument("--sp-turns", type=int, default=3,
                    help="shared-prefix workload: conversation turns per user")
     p.add_argument("--sp-system-tokens", type=int, default=0,
@@ -2144,6 +2152,10 @@ def main():
             from benchmarks.diurnal import bench_diurnal
 
             result = asyncio.run(bench_diurnal(args))
+        elif args.workload == "migrate":
+            from benchmarks.migrate import bench_migrate
+
+            result = asyncio.run(bench_migrate(args))
         else:
             result = asyncio.run(bench(args))
     except Exception as e:  # noqa: BLE001 — bench must always print a line

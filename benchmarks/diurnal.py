@@ -182,13 +182,23 @@ class DiurnalSim:
     from a shared FIFO; decode workers hold concurrent sequences whose
     per-token latency follows ITL(active batch). A draining worker
     takes no new work, finishes what it holds, and flips role after
-    ``switch_delay_s`` — the zero-failure move contract."""
+    ``switch_delay_s`` — the zero-failure move contract.
+
+    With ``relocate`` on, a decode worker asked to move LIVE-MIGRATES
+    its in-flight sequences to the least-loaded peers instead of
+    draining (worker/migrate.py semantics): each migrated stream pays
+    one ``migrate_gap_s`` cutover stall on its next token, and the
+    worker flips after just ``switch_delay_s`` — the relocate-vs-drain
+    trade the ``--workload diurnal`` fleet comparison scores."""
 
     def __init__(self, decode_interp, prefill_interp, n_workers: int,
-                 prefill_n: int, switch_delay_s: float = 0.5):
+                 prefill_n: int, switch_delay_s: float = 0.5,
+                 relocate: bool = False, migrate_gap_s: float = 0.25):
         self.dec = decode_interp
         self.pre = prefill_interp
         self.switch_delay_s = switch_delay_s
+        self.relocate = relocate
+        self.migrate_gap_s = migrate_gap_s
         self.workers = [
             _Worker(i, POOL_PREFILL if i < prefill_n else POOL_DECODE)
             for i in range(n_workers)
@@ -200,6 +210,12 @@ class DiurnalSim:
         self.decode_q: deque = deque()
         self.completed: list[_Req] = []
         self.moves_applied = 0
+        self.migrations = 0
+        self.migration_stall_s = 0.0
+        # rid → current decode home (live migration retargets in-flight
+        # token events at fire time) and rid → stall-until cutover gap.
+        self._home: dict[int, _Worker] = {}
+        self._stall: dict[int, float] = {}
         # per-observation-window accumulators
         self.win_arrivals = 0
         self.win_in_tokens = 0
@@ -268,6 +284,7 @@ class DiurnalSim:
             return
         w = min(cands, key=lambda w: len(w.active))
         w.active.add(req.rid)
+        self._home[req.rid] = w
         if req.tokens >= req.glen:
             self._finish(w, req)
         else:
@@ -277,6 +294,15 @@ class DiurnalSim:
         return self.dec.itl_at(max(len(w.active), 1)) / 1000.0
 
     def _token(self, w: _Worker, req: _Req) -> None:
+        w = self._home.get(req.rid, w)
+        stall = self._stall.pop(req.rid, 0.0)
+        if stall > self.now:
+            # Cutover gap: the migrated stream's next token waits out
+            # the freeze→commit window, visible as one long ITL.
+            req.itl_sum += stall - self.now
+            self.migration_stall_s += stall - self.now
+            self.schedule(stall, self._token, w, req)
+            return
         req.tokens += 1
         req.itl_sum += self._itl(w)
         if req.tokens >= req.glen:
@@ -285,6 +311,8 @@ class DiurnalSim:
             self.schedule(self.now + self._itl(w), self._token, w, req)
 
     def _finish(self, w: _Worker, req: _Req) -> None:
+        w = self._home.pop(req.rid, w)
+        self._stall.pop(req.rid, None)
         w.active.discard(req.rid)
         req.t_done = self.now
         self.completed.append(req)
@@ -302,7 +330,24 @@ class DiurnalSim:
         w = max(cands, key=lambda w: w.wid)
         w.draining = True
         w.pending_role = dst
+        if self.relocate and src == POOL_DECODE and w.active:
+            self._relocate(w)
         self._maybe_flip(w)
+
+    def _relocate(self, w: _Worker) -> None:
+        """Live-migrate every in-flight decode off ``w`` to its least-
+        loaded peers; no peer left = fall back to the drain contract
+        (exactly the worker's relocate-with-drain-fallback behavior)."""
+        peers = [p for p in self._available(POOL_DECODE) if p is not w]
+        if not peers:
+            return
+        for rid in list(w.active):
+            dest = min(peers, key=lambda p: len(p.active))
+            w.active.discard(rid)
+            dest.active.add(rid)
+            self._home[rid] = dest
+            self._stall[rid] = self.now + self.migrate_gap_s
+            self.migrations += 1
 
     def _maybe_flip(self, w: _Worker) -> None:
         if w.draining and w.busy is None and not w.active and w.pending_role:
@@ -410,13 +455,16 @@ async def run_static_arm(trace, interps, n_workers: int, prefill_n: int,
 
 async def run_closed_loop_arm(trace, interps, n_workers: int, prefill_n: int,
                               day_s: float, ttft_slo_s: float, itl_slo_ms: float,
-                              interval_s: float = 5.0, seed: int = 0) -> dict:
+                              interval_s: float = 5.0, seed: int = 0,
+                              relocate: bool = False,
+                              migrate_gap_s: float = 0.25) -> dict:
     from dynamo_tpu.planner.actions import ActionJournal
     from dynamo_tpu.runtime.metrics import MetricsRegistry
     from dynamo_tpu.runtime.store import connect_store
 
     dec, pre = interps
-    sim = DiurnalSim(dec, pre, n_workers, prefill_n)
+    sim = DiurnalSim(dec, pre, n_workers, prefill_n,
+                     relocate=relocate, migrate_gap_s=migrate_gap_s)
     for i, (t, plen, glen) in enumerate(trace):
         sim.schedule(t, sim.arrive, _Req(i, t, plen, glen))
 
@@ -468,6 +516,8 @@ async def run_closed_loop_arm(trace, interps, n_workers: int, prefill_n: int,
     out["actions_ok"] = sum(1 for _, o in auto.actions_done if o == "ok")
     out["actions_error"] = sum(1 for _, o in auto.actions_done if o != "ok")
     out["moves_applied"] = sim.moves_applied
+    out["migrations"] = sim.migrations
+    out["migration_stall_s"] = round(sim.migration_stall_s, 3)
     out["pool_timeline"] = sim.pool_timeline
     out["journal_entries"] = len(await auto.journal.entries())
     out["metrics_sample"] = {
@@ -514,6 +564,42 @@ async def bench_diurnal(args) -> dict:
         seed=seed,
     )
 
+    # Relocate-vs-drain at fleet scale: the same diurnal day (duration
+    # compressed 4x to bound DES cost), arrival rates scaled so per-
+    # worker load matches at 100+ engines. At this scale a pool move
+    # strands real concurrency on the draining decode worker for the
+    # whole tail of its longest sequence; live migration
+    # (worker/migrate.py) frees the worker after one cutover gap per
+    # stream instead.
+    fleet_n = max(120, n_workers)
+    fleet_factor = fleet_n / n_workers
+    fleet_phases = [
+        Phase(p.name, p.dur_s * 0.25, p.rate_rps * fleet_factor,
+              p.prompt_mean, p.gen_mean, p.burst_x, p.burst_every_s,
+              p.burst_dur_s)
+        for p in phases
+    ]
+    fleet_day_s = sum(p.dur_s for p in fleet_phases)
+    fleet_trace = gen_trace(fleet_phases, seed)
+    fleet_start_p = max(1, round(start_p * fleet_factor))
+    fleet_arms = {}
+    for arm_seed, (mode, reloc) in enumerate(
+        (("drain", False), ("relocate", True)), start=100
+    ):
+        arm = await run_closed_loop_arm(
+            fleet_trace, interps, fleet_n, fleet_start_p, fleet_day_s,
+            ttft_slo_s, itl_slo_ms, seed=arm_seed, relocate=reloc,
+        )
+        # 120-worker timelines/action logs are bulk, not signal.
+        arm.pop("pool_timeline", None)
+        arm.pop("scale_actions", None)
+        fleet_arms[mode] = arm
+    fleet_ratio = (
+        fleet_arms["relocate"]["slo_goodput_tok_s"]
+        / fleet_arms["drain"]["slo_goodput_tok_s"]
+        if fleet_arms["drain"]["slo_goodput_tok_s"] > 0 else float("inf")
+    )
+
     ratio = (
         closed["slo_goodput_tok_s"] / best_static["slo_goodput_tok_s"]
         if best_static["slo_goodput_tok_s"] > 0 else float("inf")
@@ -539,8 +625,18 @@ async def bench_diurnal(args) -> dict:
             k: v["slo_goodput_tok_s"] for k, v in statics.items()
         },
         "closed_loop": closed,
+        "fleet": {
+            "workers": fleet_n,
+            "offered_requests": len(fleet_trace),
+            "day_s": fleet_day_s,
+            "migrate_gap_s": 0.25,
+            "drain": fleet_arms["drain"],
+            "relocate": fleet_arms["relocate"],
+            "relocate_vs_drain_goodput": round(fleet_ratio, 4),
+        },
         "zero_failed_requests": all(
-            a["failed"] == 0 for a in [closed, *statics.values()]
+            a["failed"] == 0
+            for a in [closed, *statics.values(), *fleet_arms.values()]
         ),
         "note": (
             "Discrete-event cluster executing the REAL planner control "
@@ -553,7 +649,9 @@ async def bench_diurnal(args) -> dict:
             "and tests/test_autoscaler_chaos.py."
         ),
     }
-    if closed["failed"] or best_static["failed"]:
+    if closed["failed"] or best_static["failed"] or any(
+        a["failed"] for a in fleet_arms.values()
+    ):
         result["error"] = "requests failed in a sim arm — drain contract broken"
     elif ratio < 1.15:
         result["error"] = f"closed-loop ratio {ratio:.3f} < 1.15 acceptance bar"

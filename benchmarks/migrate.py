@@ -1,0 +1,310 @@
+"""``bench.py --workload migrate`` — live-migration robustness bench.
+
+Measures the control plane of worker/migrate.py on a real two-engine
+cluster (memory runtime, CPU engines — migration cost is control-plane
+and transfer-plane work, not matmul throughput): every request is
+force-relocated mid-decode between two live engines and the run reports
+
+- **cutover gap p50/p99** — source freeze → destination commit-ack wall
+  time, the only window where the client's token flow can stall;
+- **KV bytes moved** per migration over the credit-flow stream plane;
+- **fallback rate under chaos** — a second arm re-runs the schedule
+  with seeded ``migration_cut_p`` faults killing source/dest/store at
+  phase boundaries, counting how many attempts degrade to in-place
+  decode (the answer must be "all the failed ones, with zero client
+  errors").
+
+Both arms pin migrated output byte-identical to an unmigrated
+aggregated-engine reference (``parity``); ``--quick`` runs tiny smoke
+shapes for the tier-1 guard (tests/test_bench_migrate.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.llm.disagg import PrefillHandler
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.runtime.chaos import ChaosInjector
+from dynamo_tpu.runtime.config import ChaosConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.worker.migrate import MigrationCoordinator, MigrationReceiver
+
+CFG = ModelConfig()  # control-plane bench: tiny model, real protocol
+
+
+def _args(**kw) -> EngineArgs:
+    defaults = dict(
+        model=CFG, block_size=4, num_kv_blocks=256, max_num_seqs=8,
+        max_model_len=256, max_prefill_tokens=128, dtype="float32",
+        decode_steps=4,
+    )
+    defaults.update(kw)
+    return EngineArgs(**defaults)
+
+
+def _request(prompt, max_tokens) -> PreprocessedRequest:
+    req = PreprocessedRequest(model="t", token_ids=list(prompt))
+    req.sampling.temperature = 0.0
+    req.sampling.seed = 0
+    req.stop.max_tokens = max_tokens
+    req.stop.ignore_eos = True
+    return req
+
+
+class _Worker:
+    def __init__(self, rt, engine, receiver, coordinator, instance_id):
+        self.rt = rt
+        self.engine = engine
+        self.receiver = receiver
+        self.coordinator = coordinator
+        self.instance_id = instance_id
+
+    async def stop(self):
+        await self.receiver.close()
+        await self.engine.stop()
+        await self.rt.shutdown()
+
+
+async def _make_worker(url: str, chaos=None) -> _Worker:
+    rt = await DistributedRuntime.create(store_url=url)
+    engine = await TpuEngine(_args(), seed=0).start()
+    comp = rt.namespace("migbench").component("backend")
+    receiver = MigrationReceiver(rt, "migbench", chaos=chaos)
+
+    async def gen_handler(payload, ctx):
+        if isinstance(payload, dict):
+            mr = (payload.get("kv_transfer_params") or {}).get("migration_resume")
+            if isinstance(mr, dict) and mr.get("handle"):
+                staged = receiver.take(mr["handle"])
+                if staged is not None:
+                    payload = dict(payload)
+                    ktp = dict(payload.get("kv_transfer_params") or {})
+                    ktp["inject"] = staged
+                    payload["kv_transfer_params"] = ktp
+        async for item in engine.generate(payload, ctx):
+            yield item
+
+    gh = await comp.endpoint("generate").serve(gen_handler)
+    await comp.endpoint("kv_fetch").serve(PrefillHandler(engine, chaos=chaos).kv_fetch)
+
+    acomp = rt.namespace("migbench").component("workerctl")
+    coordinator = MigrationCoordinator(
+        engine,
+        await acomp.endpoint("admin").router(RouterMode.DIRECT),
+        "backend", gh.instance.instance_id, chaos=chaos,
+    )
+
+    async def admin(payload, ctx):
+        payload = payload or {}
+        cmd = payload.get("cmd")
+        try:
+            if cmd == "migrate_out":
+                yield await coordinator.migrate_out(
+                    payload.get("request_id", ""),
+                    int(payload.get("dest_instance") or 0))
+            elif cmd == "migrate_in_start":
+                yield await receiver.start_pull(
+                    payload.get("handle", ""),
+                    payload.get("source_component", ""),
+                    int(payload.get("source_instance") or 0))
+            elif cmd == "migrate_in_commit":
+                yield await receiver.commit(
+                    payload.get("handle", ""), int(payload.get("kv_blocks") or 0))
+            elif cmd == "migrate_in_abort":
+                yield await receiver.abort(payload.get("handle", ""))
+            else:
+                yield {"error": f"unknown admin cmd {cmd!r}"}
+        except Exception as e:  # noqa: BLE001 — admin answers typed, never tears the endpoint down
+            yield {"error": f"{type(e).__name__}: {e}"}
+
+    await acomp.endpoint("admin").serve(admin)
+    return _Worker(rt, engine, receiver, coordinator, gh.instance.instance_id)
+
+
+class _Cluster:
+    def __init__(self, url):
+        self.url = url
+
+    async def start(self, chaos=None):
+        self.a = await _make_worker(self.url, chaos=chaos)
+        self.b = await _make_worker(self.url, chaos=chaos)
+        self.frt = await DistributedRuntime.create(store_url=self.url)
+        ns = self.frt.namespace("migbench")
+        push = await ns.component("backend").endpoint("generate").router(
+            RouterMode.DIRECT)
+        self.router = await KvPushRouter(
+            push, KvRouterConfig(block_size=4, use_kv_events=False)).start()
+        self.operator = Migration(self.router, migration_limit=3)
+        self.admin = await ns.component("workerctl").endpoint("admin").router(
+            RouterMode.DIRECT)
+        return self
+
+    def source(self):
+        for w, other in ((self.a, self.b), (self.b, self.a)):
+            if w.engine.list_running():
+                return w, other
+        return None, None
+
+    async def stop(self):
+        await self.router.close()
+        await self.frt.shutdown()
+        await self.a.stop()
+        await self.b.stop()
+
+
+async def _run_one(cluster: _Cluster, prompt, n, trigger_at):
+    """One client stream + one forced mid-decode migrate_out. Returns
+    (tokens, migrate_out reply | None)."""
+    got = []
+
+    async def run():
+        async for item in cluster.operator.generate(
+            _request(prompt, n).to_dict(), Context()
+        ):
+            got.extend(item.get("token_ids") or [])
+
+    task = asyncio.get_running_loop().create_task(run())
+    reply = None
+    try:
+        for _ in range(4000):
+            if len(got) >= trigger_at or task.done():
+                break
+            await asyncio.sleep(0.002)
+        src, dst = cluster.source()
+        if src is not None:
+            running = src.engine.list_running()
+            if running:
+                async for frame in cluster.admin.generate(
+                    {"cmd": "migrate_out", "request_id": running[0],
+                     "dest_instance": dst.instance_id},
+                    Context(), instance_id=src.instance_id,
+                ):
+                    if isinstance(frame, dict):
+                        reply = frame
+        await asyncio.wait_for(task, 180)
+    finally:
+        if not task.done():
+            task.cancel()
+    return got, reply
+
+
+async def _arm(url, prompts, refs, gen_len, trigger_at, chaos=None):
+    """Run the schedule once: each request streams through the Migration
+    operator and gets one forced relocation attempt. Sequential on
+    purpose — the cutover-gap histogram must not include co-scheduled
+    batch jitter."""
+    cluster = await _Cluster(url).start(chaos=chaos)
+    gaps, kv_bytes, ok, fallback, noop, mismatches = [], 0, 0, 0, 0, 0
+    try:
+        for prompt, ref in zip(prompts, refs):
+            got, reply = await _run_one(cluster, prompt, gen_len, trigger_at)
+            if got != ref:
+                mismatches += 1
+            if reply is None:
+                noop += 1
+            elif reply.get("ok"):
+                ok += 1
+                gaps.append(float(reply.get("cutover_gap_s", 0.0)))
+                kv_bytes += int(reply.get("kv_bytes", 0))
+            elif reply.get("reason") in ("finished", "self", "not_running"):
+                noop += 1
+            else:
+                fallback += 1
+        fallback_reasons = {
+            **cluster.a.coordinator.fallback_reasons,
+            **cluster.b.coordinator.fallback_reasons,
+        }
+    finally:
+        await cluster.stop()
+    return {
+        "gaps_s": gaps, "kv_bytes": kv_bytes, "ok": ok,
+        "fallback": fallback, "noop": noop, "mismatches": mismatches,
+        "fallback_reasons": fallback_reasons,
+    }
+
+
+async def bench_migrate(args) -> dict:
+    quick = bool(getattr(args, "quick", False))
+    n_requests = 4 if quick else min(24, max(8, args.num_requests // 8))
+    gen_len = 32 if quick else 64
+    prompt_len = 24 if quick else 48
+    trigger_at = max(4, gen_len // 8)
+
+    rng = np.random.default_rng(16)
+    prompts = [
+        rng.integers(1, CFG.vocab_size - 1, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+
+    # Unmigrated reference: the same greedy schedule on one engine.
+    agg = await TpuEngine(_args(), seed=0).start()
+    refs = []
+    for prompt in prompts:
+        toks = []
+        async for item in agg.generate(
+            _request(prompt, gen_len).to_dict(), Context()
+        ):
+            toks.extend(item.get("token_ids") or [])
+        refs.append(toks)
+    await agg.stop()
+
+    # Arm 1: clean relocations.
+    clean = await _arm("memory://migbench-clean", prompts, refs, gen_len,
+                       trigger_at)
+    # Arm 2: the same schedule under seeded phase-boundary chaos.
+    chaos = ChaosInjector(ChaosConfig(
+        enabled=True, seed=16,
+        migration_cut_p=float(getattr(args, "migrate_cut_p", 0.5)),
+    ))
+    chaotic = await _arm("memory://migbench-chaos", prompts, refs, gen_len,
+                         trigger_at, chaos=chaos)
+
+    gaps = np.asarray(clean["gaps_s"], dtype=np.float64)
+    attempts_chaos = chaotic["ok"] + chaotic["fallback"]
+    result = {
+        "metric": "migration_cutover_gap_p50_ms",
+        "value": round(float(np.percentile(gaps, 50)) * 1e3, 2) if gaps.size else 0.0,
+        "unit": "ms",
+        "vs_baseline": 0.0,  # no reference figure: robustness bench
+        "workload": "migrate",
+        "num_requests": n_requests,
+        "gen_len": gen_len,
+        "prompt_len": prompt_len,
+        "migrations_ok": clean["ok"],
+        "migrations_noop": clean["noop"],
+        "migrations_fallback": clean["fallback"],
+        "cutover_gap_p50_ms": round(float(np.percentile(gaps, 50)) * 1e3, 2) if gaps.size else 0.0,
+        "cutover_gap_p99_ms": round(float(np.percentile(gaps, 99)) * 1e3, 2) if gaps.size else 0.0,
+        "kv_bytes_moved": int(clean["kv_bytes"]),
+        "kv_bytes_per_migration": int(clean["kv_bytes"] / clean["ok"]) if clean["ok"] else 0,
+        "chaos_cut_p": float(getattr(args, "migrate_cut_p", 0.5)),
+        "chaos_injected_cuts": int(chaos.stats.migration_cuts),
+        "chaos_attempts": attempts_chaos,
+        "chaos_ok": chaotic["ok"],
+        "chaos_fallback": chaotic["fallback"],
+        "chaos_fallback_rate": round(
+            chaotic["fallback"] / attempts_chaos, 4) if attempts_chaos else 0.0,
+        "chaos_fallback_reasons": chaotic["fallback_reasons"],
+        # THE robustness claim: byte-identical greedy output on every
+        # stream, migrated or fallen back, clean or chaotic.
+        "parity": clean["mismatches"] == 0 and chaotic["mismatches"] == 0,
+        "quick": quick,
+    }
+    if clean["mismatches"] or chaotic["mismatches"]:
+        result["error"] = (
+            f"stream parity FAILED: {clean['mismatches']} clean + "
+            f"{chaotic['mismatches']} chaos streams diverged from the "
+            "unmigrated reference"
+        )
+    elif clean["ok"] == 0:
+        result["error"] = "no migration completed — the bench measured nothing"
+    return result

@@ -153,6 +153,7 @@ class _Seq:
         "grammar", "grammar_state", "grammar_eos_bits",
         "adapter_id", "adapter_slot", "hash_seed",
         "qos", "qos_rank", "arrival",
+        "step_base", "mig", "offer_deadline",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -243,6 +244,30 @@ class _Seq:
         self.export_handle = ktp.get("stream_handle") if self.export else None
         self.export_stream: KvStreamExport | None = None
         self.export_pub_blocks = 0
+        # Live migration: sampler step offset (a resumed sequence keeps
+        # drawing the SOURCE's gumbel index sequence: same seed, steps
+        # continue at step_base + emitted) and the outbound migration
+        # state while this sequence is being relocated (engine-thread
+        # owned, via _migrations).
+        self.step_base = 0
+        self.mig = None
+        # Preemption-offer grace: when a migration offer hook fires for
+        # this sequence as a preemption victim, the kill waits until
+        # this deadline for the relocation to free the blocks instead.
+        self.offer_deadline = 0.0
+        # Resume identity (live migration / re-dispatch): the original
+        # prompt boundary survives worker changes — penalties and grammar
+        # replay key off it — and seed/step/EMA continue the source's.
+        resume = ktp.get("resume")
+        if isinstance(resume, dict):
+            pl = resume.get("prompt_len")
+            if isinstance(pl, int) and 1 <= pl <= len(self.tokens):
+                self.prompt_len = pl
+            if resume.get("sample_seed") is not None:
+                self.sample_seed = int(resume["sample_seed"]) & 0x7FFFFFFF
+            self.step_base = int(resume.get("sample_step") or 0)
+            if resume.get("spec_ema") is not None:
+                self.spec_ema = float(resume["spec_ema"])
 
     @property
     def next_write_pos(self) -> int:
@@ -268,6 +293,27 @@ class _Window:
         if self.top_n:
             a += [self.ref.arrs[2], self.ref.arrs[3]]
         return a
+
+
+class _MigSt:
+    """Engine-thread state of one outbound live migration: the sequence
+    keeps decoding while its sealed KV blocks publish as stream chunks
+    (``pump``), until the coordinator freezes it for the bounded cutover
+    window. ``fetches`` are this migration's in-flight page extracts
+    (lo, hi, device arrays, bucket n), harvested strictly in dispatch
+    order so the consumer's chunk coverage stays contiguous."""
+
+    __slots__ = ("seq", "handle", "stream", "pub_blocks", "frozen",
+                 "freeze_deadline", "fetches")
+
+    def __init__(self, seq: "_Seq", handle: str, stream: KvStreamExport):
+        self.seq = seq
+        self.handle = handle
+        self.stream = stream
+        self.pub_blocks = 0
+        self.frozen = False
+        self.freeze_deadline = 0.0
+        self.fetches: list = []
 
 
 class _Spec:
@@ -478,6 +524,7 @@ class TpuEngine:
         "_embed_jobs", "_host_jobs", "_offload_pending", "_exports",
         "_export_fetches", "_drafter", "_step_no", "_spec_ticked",
         "phase_s", "phase_n", "_ctr_pushed", "_spec_depth_hist",
+        "_migrations",
     })
 
     def __init__(
@@ -537,6 +584,20 @@ class TpuEngine:
         # after export_ttl_s (unsealed streams abort at reap time).
         self._exports: dict[str, tuple[Any, float]] = {}
         self.export_ttl_s = 60.0
+        # Outbound live migrations: request_id → _MigSt. The scheduler
+        # pumps each unfrozen migration's KV delta once per step; frozen
+        # ones are auto-unfrozen (and the migration aborted) when the
+        # coordinator misses the cutover deadline — a dead coordinator
+        # can never wedge a stream.
+        self._migrations: dict[str, _MigSt] = {}
+        self.migration_freeze_ttl_s = 10.0
+        # QoS defrag: when set (worker/roles.py wires it to the
+        # migration coordinator), preemption under KV pressure OFFERS
+        # the victim a relocation first — called from the scheduler
+        # thread with the victim's request id, must be thread-safe —
+        # and the kill waits a bounded grace for the offer to land.
+        self.migration_offer = None
+        self.preempt_offer_grace_s = 0.75
         # Streaming-export page fetches in flight: (seq, lo, hi, device
         # arrays, bucket n). Dispatched per prefill chunk with async D2H
         # (start_host_fetch); harvested opportunistically between chunk
@@ -995,6 +1056,22 @@ class TpuEngine:
                 seq.eos_ids, self.cfg.vocab_size
             )
             self.total_grammar_seqs += 1
+            if seq.prompt_len < len(seq.tokens):
+                # Resumed (migrated/re-dispatched) constrained request:
+                # the carried tokens past the original prompt boundary
+                # were GENERATED under this grammar on the previous leg —
+                # replay the FSM over them so masking continues from the
+                # exact state the source reached (deterministic: the FSM
+                # is a pure function of the emitted tokens).
+                st = grammar.start
+                for t in seq.tokens[seq.prompt_len:]:
+                    if t in seq.eos_ids:
+                        break
+                    ns = grammar.advance(st, t)
+                    if ns is None:
+                        break  # desync-defensive, same stance as _emit_tokens
+                    st = ns
+                seq.grammar_state = st
         if not self.args.qos_scheduling:
             seq.qos_rank = 0  # one class: FIFO admission, newest-first preempt
         with self._wakeup:
@@ -1115,6 +1192,12 @@ class TpuEngine:
                         and not self._embed_jobs
                         and not self._host_jobs
                     ):
+                        if self._migrations:
+                            # A frozen cutover must still observe its
+                            # deadline even on an otherwise-idle engine:
+                            # bounded sleep, then run a (cheap) step.
+                            self._wakeup.wait(timeout=0.02)
+                            break
                         self._wakeup.wait()
                     if self._stopping:
                         break
@@ -1138,6 +1221,16 @@ class TpuEngine:
             with self._wakeup:
                 self._stopping = True
                 leftovers = list(self._running) + list(self._waiting) + list(self._submissions)
+                # Frozen mid-cutover sequences live in no queue; without a
+                # terminal post their client streams would hang forever.
+                leftovers += [
+                    m.seq for m in self._migrations.values()
+                    if m.frozen and not m.seq.dead
+                ]
+                for m in self._migrations.values():
+                    m.stream.abort("engine_stopped")
+                    m.seq.mig = None
+                self._migrations.clear()
                 self._running.clear()
                 self._waiting.clear()
                 self._submissions.clear()
@@ -1173,6 +1266,8 @@ class TpuEngine:
             self._serve_host_job(*self._host_jobs.popleft())
         if self._exports:
             self._reap_exports()
+        if self._migrations:
+            self._service_migrations()
         # Prefill-priority admission, two phases: (1) allocate KV for the
         # whole wave, (2) dispatch prefills PACKED by suffix bucket
         # (model.prefill_batch) — one-at-a-time prefill was the r3 TTFT
@@ -1185,9 +1280,13 @@ class TpuEngine:
         t0 = time.perf_counter()
         allocated: list[tuple[_Seq, int]] = []  # (seq, suffix start)
         wave_budget = self.args.admission_budget_tokens or (1 << 62)
+        # Frozen mid-cutover sequences are out of _running but still hold
+        # their chain slot (and KV) until the handoff resolves — admission
+        # must not oversubscribe the slot pool past them.
+        frozen = sum(1 for m in self._migrations.values() if m.frozen)
         while (
             self._waiting
-            and len(self._running) + len(allocated) < self.args.max_num_seqs
+            and len(self._running) + len(allocated) + frozen < self.args.max_num_seqs
             and (wave_budget > 0 or not allocated)
         ):
             seq = self._pop_next_waiting()
@@ -1199,8 +1298,10 @@ class TpuEngine:
                 start = self._admit_alloc(seq)
             except NoFreeBlocksError:
                 self._waiting.appendleft(seq)  # try again when blocks free up
-                if not self._running and not allocated:
+                if not self._running and not allocated and not self._migrations:
                     # Deadlock: nothing to free. Fail the request.
+                    # (A frozen migration is NOT a deadlock — its blocks
+                    # free within the bounded cutover window either way.)
                     self._waiting.remove(seq)
                     self._finish(seq, FinishReason.ERROR,
                                  error="prompt does not fit in KV cache")
@@ -1908,6 +2009,239 @@ class TpuEngine:
                 item.abort("expired")
                 item.ack(item.chunk_count())
 
+    # -- live migration (engine side) --------------------------------------
+    #
+    # Protocol (worker/migrate.py drives it; every entry point below runs
+    # on the scheduler thread via run_on_engine_thread):
+    #   begin    — register a KvStreamExport for a RUNNING decode; the
+    #              sequence keeps decoding while each step's newly-sealed
+    #              full blocks publish as chunks (the PR 8 credit-flow
+    #              plane serves them to the destination, int8 scales
+    #              riding along).
+    #   cutover  — force-drain pending device tokens, FREEZE the sequence
+    #              (out of _running; slot/KV retained), publish the delta
+    #              blocks since the stream cursor, seal, and return the
+    #              full resume identity (tokens, seed, sampler step,
+    #              spec EMA, grammar state, adapter, next_write_pos).
+    #   finish   — the destination committed: release resources and post
+    #              a {"migration": marker} frame; the Migration operator
+    #              consumes it and re-dispatches the SAME client stream
+    #              pinned to the destination. Byte-identity: after the
+    #              force-drain, kv_written == len(tokens)-1, so the sealed
+    #              full blocks equal the destination's admission hit
+    #              ceiling exactly — it recomputes only the <block_size
+    #              suffix and continues sampling at (seed, step_base).
+    #   abort    — any failure (or the freeze deadline passing with no
+    #              coordinator): unfreeze, re-enter _running, keep
+    #              decoding locally. The client never notices.
+
+    def migration_begin(self, request_id: str) -> dict:
+        """Start streaming a running decode's KV. → {"ok", "handle",
+        "published"} or {"error"}. Scheduler thread only."""
+        seq = next(
+            (s for s in self._running if s.request_id == request_id), None
+        )
+        if seq is None or seq.dead or seq.cancelled:
+            return {"error": "not_running"}
+        if seq.mig is not None:
+            return {"error": "already_migrating"}
+        if seq.export or seq.export_stream is not None:
+            return {"error": "exporting"}  # disagg export seqs finish at token 1
+        handle = f"mig-{request_id}-{self._step_no}"
+        stream = KvStreamExport(handle)
+        with self._mutex:
+            self._exports[handle] = (stream, time.monotonic() + self.export_ttl_s)
+        mig = _MigSt(seq, handle, stream)
+        seq.mig = mig
+        self._migrations[request_id] = mig
+        self._pump_migration(mig)
+        return {"ok": True, "handle": handle, "published": mig.pub_blocks}
+
+    def migration_status(self, request_id: str) -> dict:
+        """Cutover-lag probe: how far the stream cursor trails the KV
+        actually written. Scheduler thread only."""
+        mig = self._migrations.get(request_id)
+        if mig is None:
+            return {"error": "no_migration"}
+        return {
+            "ok": True,
+            "published": mig.pub_blocks,
+            "written": mig.seq.kv_written // self.args.block_size,
+            "frozen": mig.frozen,
+            "sealed": mig.stream.sealed,
+            "aborted": mig.stream.abort_reason,
+        }
+
+    def migration_cutover(self, request_id: str) -> dict:
+        """Freeze the sequence, ship the delta, seal the stream, and
+        return the resume identity. Scheduler thread only."""
+        mig = self._migrations.get(request_id)
+        if mig is None:
+            return {"error": "no_migration"}
+        seq = mig.seq
+        if mig.stream.abort_reason is not None:
+            reason = mig.stream.abort_reason
+            self._abort_migration(mig, reason)
+            return {"error": f"stream_aborted:{reason}"}
+        # Every device-pending token must be host-visible before the
+        # identity snapshots: the handoff carries exactly the tokens the
+        # client will have seen. The drain may FINISH the sequence (stop
+        # condition in flight) or a preemption may have raced us — both
+        # tear the migration down via the _finish/_preempt hooks.
+        self._drain_completed(force=True)
+        if self._migrations.get(request_id) is not mig:
+            return {"error": "done" if seq.dead else "preempted"}
+        if seq.dead or seq not in self._running:
+            self._abort_migration(mig, "finished")
+            return {"error": "done"}
+        self._running.remove(seq)
+        mig.frozen = True
+        mig.freeze_deadline = time.monotonic() + self.migration_freeze_ttl_s
+        self._pump_migration(mig, force=True)
+        if mig.stream.abort_reason is not None:
+            reason = mig.stream.abort_reason
+            self._abort_migration(mig, reason)
+            return {"error": f"stream_aborted:{reason}"}
+        bs = self.args.block_size
+        mig.stream.seal(
+            num_blocks=mig.pub_blocks, num_tokens=mig.pub_blocks * bs
+        )
+        return {
+            "ok": True,
+            "handle": mig.handle,
+            "kv_blocks": mig.pub_blocks,
+            "emitted": seq.emitted,
+            "adapter_id": seq.adapter_id,
+            "request": {
+                "token_ids": list(seq.tokens),
+                "resume": {
+                    "prompt_len": seq.prompt_len,
+                    "sample_seed": seq.sample_seed,
+                    "sample_step": seq.step_base + seq.emitted,
+                    "spec_ema": seq.spec_ema,
+                    "grammar_state": seq.grammar_state,
+                    "next_write_pos": seq.next_write_pos,
+                },
+            },
+        }
+
+    def migration_finish(self, request_id: str, marker: dict) -> dict:
+        """Destination committed: hand the client stream off by posting
+        the migration marker, then release this side's resources. The KV
+        already lives in the sealed stream's host pages (and the
+        destination's staged inject), so freeing device blocks is safe.
+        Scheduler thread only."""
+        mig = self._migrations.get(request_id)
+        if mig is None or not mig.frozen:
+            return {"error": "not_frozen"}
+        seq = mig.seq
+        self._migrations.pop(request_id, None)
+        seq.mig = None
+        seq.dead = True
+        if seq.slot is not None:
+            self._free_slots.append(seq.slot)
+            seq.slot = None
+        self._release_adapter(seq)
+        if self._offload_pending:
+            freed = set(seq.block_ids)
+            self._offload_pending = [
+                (b, h) for b, h in self._offload_pending if b not in freed
+            ]
+        self.pool.free_sequence(seq.block_ids)
+        seq.block_ids = []
+        self._post(seq, {"token_ids": [], "migration": marker})
+        self._post_done(seq)
+        return {"ok": True}
+
+    def migration_abort(self, request_id: str, reason: str) -> dict:
+        """Coordinator-initiated teardown: the sequence resumes decoding
+        locally (if frozen) and the stream aborts. Scheduler thread only."""
+        mig = self._migrations.get(request_id)
+        if mig is None:
+            return {"error": "no_migration"}
+        self._abort_migration(mig, reason)
+        return {"ok": True}
+
+    def _abort_migration(self, mig: _MigSt, reason: str) -> None:
+        seq = mig.seq
+        self._migrations.pop(seq.request_id, None)
+        seq.mig = None
+        mig.fetches = []  # drop in-flight extracts (device arrays released)
+        mig.stream.abort(reason)  # no-op when sealed
+        mig.stream.ack(mig.stream.chunk_count())  # free buffered host pages
+        with self._mutex:
+            self._exports.pop(mig.handle, None)
+        if mig.frozen and not seq.dead:
+            # Unfreeze: re-enter the running batch exactly where it left
+            # off (slot and KV were retained) — zero client impact.
+            mig.frozen = False
+            self._running.append(seq)
+
+    def _service_migrations(self) -> None:
+        """Once per step: pump streaming migrations, reap finished ones,
+        and enforce the cutover freeze deadline (a dead coordinator must
+        never wedge a frozen stream)."""
+        now = time.monotonic()
+        for rid in list(self._migrations):
+            mig = self._migrations.get(rid)
+            if mig is None:
+                continue
+            seq = mig.seq
+            if seq.dead or seq.cancelled:
+                self._abort_migration(mig, "finished")
+                continue
+            if mig.stream.abort_reason is not None:
+                # Overrun (slow consumer) or TTL reap ("expired" — the
+                # consumer/store died). Either way the source just keeps
+                # the stream: unfreeze if needed and decode on.
+                self._abort_migration(mig, mig.stream.abort_reason)
+                continue
+            if mig.frozen:
+                if now >= mig.freeze_deadline:
+                    log.warning(
+                        "migration %s cutover deadline exceeded; resuming locally",
+                        rid,
+                    )
+                    self._abort_migration(mig, "cutover_deadline")
+                continue
+            self._pump_migration(mig)
+
+    def _pump_migration(self, mig: _MigSt, force: bool = False) -> None:
+        """Publish the KV block delta written since the stream cursor.
+        Extract dispatch is async (start_host_fetch) and harvested
+        strictly in dispatch order; ``force`` block-drains everything
+        (cutover's final delta)."""
+        if mig.stream.abort_reason is not None:
+            return
+        seq = mig.seq
+        bs = self.args.block_size
+        lo, hi = kv_transfer.delta_blocks(
+            seq.kv_written, bs, mig.pub_blocks, len(seq.block_ids)
+        )
+        if hi > lo:
+            arrs, n = self._runner.start_extract_pages(seq.block_ids[lo:hi])
+            start_host_fetch(arrs)
+            mig.fetches.append((lo, hi, arrs, n))
+            mig.pub_blocks = hi
+        keep: list = []
+        for item in mig.fetches:
+            flo, fhi, arrs, n = item
+            if keep or (not force and not host_ready(arrs)):
+                keep.append(item)
+                continue
+            pages = self._runner.finish_extract_pages(arrs, n)
+            if not mig.stream.publish(KvChunk(
+                block_offset=flo, pages=pages, num_tokens=(fhi - flo) * bs,
+            )):
+                break  # overrun — stream aborted; _service tears it down
+        mig.fetches = keep
+
+    def list_running(self) -> list[str]:
+        """Request ids currently in the running batch — the relocation
+        candidate set for pool moves/retirement. Thread-safe snapshot."""
+        with self._wakeup:
+            return [s.request_id for s in self._running if not s.dead]
+
     def _register_written_blocks(self, seq: _Seq) -> None:
         """Register sealed blocks whose KV is fully written. A block sealed
         by a just-sampled token must wait: that token's KV lands on the next
@@ -1946,6 +2280,26 @@ class TpuEngine:
                 return False
         return True
 
+    def _offer_migration_grace(self, victim: _Seq) -> bool:
+        """QoS preemption offers migration before killing: when an offer
+        hook is wired, fire it once for the chosen victim and grant a
+        bounded grace window for the relocation to free its blocks.
+        False (kill now) when unwired, the hook fails, or the victim's
+        grace already expired. Scheduler thread only."""
+        cb = self.migration_offer
+        if cb is None:
+            return False
+        now = time.monotonic()
+        if victim.offer_deadline == 0.0:
+            victim.offer_deadline = now + self.preempt_offer_grace_s
+            try:
+                cb(victim.request_id)
+            except Exception:  # noqa: BLE001 — a broken offer hook must never block the preemption fallback
+                log.exception("migration offer hook failed")
+                return False
+            return True
+        return now < victim.offer_deadline
+
     def _preempt_victim(self) -> _Seq:
         """Class-aware victim selection: evict the LOWEST class first,
         newest admission within it — the newest victim has the least
@@ -1965,11 +2319,18 @@ class TpuEngine:
         self._drain_completed(force=True)  # pending tokens must be host-visible
         if seq.dead or seq not in self._running:
             return  # resolution finished it (stop condition on token 1)
+        # An outbound migration of the victim tears down first: its KV is
+        # about to be freed, so the stream can never complete. (Frozen
+        # sequences are not in _running, so they are immune to victim
+        # selection — the bounded cutover window is never preempted.)
+        if seq.mig is not None:
+            self._abort_migration(seq.mig, "preempted")
         log.warning(
             "preempting request %s (KV pressure, class=%s)",
             seq.request_id, seq.qos,
         )
         self.total_preemptions_by[seq.qos] += 1
+        seq.offer_deadline = 0.0  # a later re-admission can be offered again
         self._running.remove(seq)
         if seq.slot is not None:
             self._free_slots.append(seq.slot)
@@ -2152,7 +2513,16 @@ class TpuEngine:
             if len(self._running) == 1:
                 self._finish(blocked, FinishReason.LENGTH)
             else:
-                self._preempt(self._preempt_victim())
+                victim = self._preempt_victim()
+                if self._offer_migration_grace(victim):
+                    # Bounded grace: skip planning this step — either
+                    # the offered relocation frees the victim's blocks
+                    # (migration_finish) or the deadline expires and the
+                    # next pass preempts for real.
+                    self._drain_completed(force=True)
+                    time.sleep(0.002)
+                    return
+                self._preempt(victim)
         if not self._running:
             self._drain_completed(force=True)
             return
@@ -2218,7 +2588,7 @@ class TpuEngine:
         for i, s in enumerate(batch):
             temps[i] = s.sampling.temperature
             seeds[i] = s.sample_seed
-            steps0[i] = s.emitted + self._pend(s)
+            steps0[i] = s.step_base + s.emitted + self._pend(s)
             tks[i] = s.sampling.top_k or 0
             tps[i] = s.sampling.top_p if s.sampling.top_p is not None else 1.0
             freqs[i] = s.sampling.frequency_penalty
@@ -2478,7 +2848,7 @@ class TpuEngine:
             fold_slots[i] = seq.slot
             temps[i] = seq.sampling.temperature
             seeds[i] = seq.sample_seed
-            steps0[i] = seq.emitted
+            steps0[i] = seq.step_base + seq.emitted
         mode = "greedy" if all(t < 1e-5 for t in temps[: len(batch)]) else "simple"
         top_n = (
             self.args.top_logprobs_max
@@ -2714,7 +3084,10 @@ class TpuEngine:
     def _penalty_window(seqs: list[_Seq], B: int) -> np.ndarray:
         """[B, L] generated-so-far ids (-1 pad), L bucketed pow2 so the
         shape set stays small."""
-        max_gen = max((s.emitted for s in seqs), default=0)
+        # Generated = everything past the prompt boundary — a resumed
+        # (migrated) sequence's carried tokens count even though its
+        # this-leg emitted does not include them.
+        max_gen = max((len(s.tokens) - s.prompt_len for s in seqs), default=0)
         L = 16
         while L < max_gen:
             L *= 2
@@ -2750,7 +3123,7 @@ class TpuEngine:
             freqs[i] = s.sampling.frequency_penalty
             press[i] = s.sampling.presence_penalty
             seeds[i] = s.sample_seed
-            steps[i] = s.emitted
+            steps[i] = s.step_base + s.emitted
         full = needs_full(tks.tolist(), tps.tolist(), freqs.tolist(), press.tolist())
         pen = (
             self._penalty_window(seqs, B) if full
@@ -2832,6 +3205,11 @@ class TpuEngine:
         error: str | None = None,
         already_posted: bool = False,
     ) -> None:
+        if seq.mig is not None:
+            # Finished (stop/cancel/error) while migrating out: the
+            # destination's pull sees the abort and the coordinator's
+            # cutover gets a typed "done" — the stream completed in place.
+            self._abort_migration(seq.mig, "finished")
         seq.dead = True
         if seq.export_stream is not None and not seq.export_stream.sealed:
             # Error/cancel before the prefill sealed the stream: the

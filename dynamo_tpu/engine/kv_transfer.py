@@ -143,6 +143,18 @@ def inject_pages(cache: KVCache, block_ids: list[int], *pages) -> KVCache:
     return KVCache(out[0], out[1])
 
 
+def delta_blocks(kv_written: int, block_size: int, cursor: int, n_blocks: int) -> tuple[int, int]:
+    """→ ``(lo, hi)`` — the full-block delta a live migration still has to
+    ship: blocks ``[cursor, hi)`` where ``hi`` counts only positions whose
+    KV is actually written (``kv_written``), clamped to the allocated
+    block list. Shared by the engine's migration pump and its cutover
+    delta pass so the cursor arithmetic is single-sourced: the source
+    keeps decoding while chunks stream, and each pump call extracts
+    exactly the blocks sealed since the previous cursor."""
+    hi = min(kv_written // block_size, n_blocks)
+    return cursor, max(hi, cursor)
+
+
 def quantize_pages_np(k: np.ndarray, v: np.ndarray, num_kv_heads: int):
     """Host-side int8 quantization of float pages [L, n, bs, KVH*hd] →
     (k int8, v int8, k_scale f32 [L, n, bs, KVH], v_scale f32). Same

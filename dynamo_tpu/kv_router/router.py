@@ -244,6 +244,17 @@ class KvPushRouter:
         # is preserved verbatim; our own peer hint is recomputed per
         # attempt so a retry never carries a stale/failed peer.
         user_ktp = request.get("kv_transfer_params") if isinstance(request, dict) else None
+        # Live-migration resume leg: pin the FIRST attempt to the
+        # destination that holds the staged KV. A pre-stream failure
+        # (destination died after committing) falls through to normal
+        # placement — the resume identity rides the request, so any
+        # worker serves the leg by re-prefilling, still byte-identical.
+        # ``rebind: False`` (dead decision store) skips the stickiness
+        # rewrite; otherwise the first frame from the destination
+        # rebinds the decision cache atomically below.
+        mig_pin = (user_ktp or {}).get("migration_resume") if isinstance(user_ktp, dict) else None
+        pin_wid = mig_pin.get("instance") if isinstance(mig_pin, dict) else None
+        no_rebind = isinstance(mig_pin, dict) and mig_pin.get("rebind") is False
         while attempts < self.config.max_attempts:
             attempts += 1
             try:
@@ -253,6 +264,10 @@ class KvPushRouter:
             except NoInstancesError:
                 break
             wid = placement.worker
+            if pin_wid is not None:
+                if pin_wid in eligible:
+                    wid = pin_wid
+                pin_wid = None  # the pin governs the first attempt only
             if self.event_sink is not None:
                 try:
                     self.event_sink(KVHitRateEvent(
@@ -264,7 +279,10 @@ class KvPushRouter:
                     log.exception("hit-rate event sink failed")
             if isinstance(request, dict):
                 request = dict(request)
-                request["estimated_prefix_hit_num_blocks"] = placement.overlap_blocks
+                request["estimated_prefix_hit_num_blocks"] = (
+                    placement.overlap_blocks if wid == placement.worker
+                    else int(scores.get(wid, 0))
+                )
                 if user_ktp:
                     request["kv_transfer_params"] = user_ktp
                 else:
@@ -284,10 +302,12 @@ class KvPushRouter:
                     if first:
                         first = False
                         self.active.mark_prefill_complete(context.id)
-                        if self.decisions is not None:
+                        if self.decisions is not None and not no_rebind:
                             # Publish only once the stream started: the
                             # worker demonstrably accepted the request,
-                            # so its cache really holds this prefix.
+                            # so its cache really holds this prefix. For
+                            # a migration resume leg this IS the atomic
+                            # stickiness rebind to the destination.
                             self.decisions.record(hashes, wid)
                     yield item
                 return

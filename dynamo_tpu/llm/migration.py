@@ -1,13 +1,33 @@
-"""Migration operator: mid-stream fault tolerance by re-dispatch.
+"""Migration operator: mid-stream relocation and fault tolerance.
 
 Reference analogue: ``Migration`` (reference: lib/llm/src/migration.rs:
 38-60, docs/architecture/request_migration.md:46-90): sit between the
 Backend and the router, accumulate the tokens a worker has emitted, and
-when the stream dies mid-flight (worker crash → TruncatedStreamError),
-re-issue the request to another worker with the accumulated tokens
-appended to the prompt — the new worker prefills prompt+generated (prefix
-cache makes this cheap if blocks were shared) and generation continues
-seamlessly. Bounded by the model card's ``migration_limit``.
+keep the client stream alive across worker changes. Two paths share the
+loop:
+
+- **re-dispatch fallback** — the stream dies mid-flight (worker crash →
+  ``TruncatedStreamError``): re-issue the request to another worker with
+  the accumulated tokens appended to the prompt — the new worker prefills
+  prompt+generated (prefix cache makes this cheap if blocks were shared)
+  and generation continues seamlessly. Bounded by the model card's
+  ``migration_limit``.
+- **live-migration resume** — the source worker hands the sequence off
+  deliberately (planner pool move, retirement, QoS defrag): the engine
+  posts a ``{"migration": ...}`` marker frame carrying the full resume
+  identity (tokens, sampler seed/step, prompt boundary, adapter, KV
+  handle) instead of a finish. The marker is consumed HERE — never
+  client-visible — and the next leg is dispatched pinned to the
+  destination, which resumes the SAME stream byte-identically. A clean
+  handoff does not count against ``migration_limit``.
+
+Token accounting is exactly-once across legs: ``delivered`` accumulates
+every token yielded to the client and is NEVER reset, so re-dispatch
+budgets always derive from the ORIGINAL request. A leg that dies after
+delivering the full ``max_tokens`` budget is semantically complete — the
+operator synthesizes the ``length`` finish locally instead of
+re-dispatching for ≥1 more token (the old ``max(1, ...)`` floor
+over-delivered and double-counted usage).
 
 Pre-stream failures are NOT handled here — the routers already retry
 those; this operator owns only the post-first-token window the routers
@@ -30,6 +50,24 @@ class Migration(Operator):
     def __init__(self, inner: AsyncEngine, migration_limit: int = 0):
         super().__init__(inner)
         self.migration_limit = migration_limit
+        # Client-side event ledger: resume (clean handoffs followed),
+        # redispatch (truncation fallbacks), budget_exhausted (finish
+        # synthesized after a full-budget leg died pre-finish-frame).
+        self.counts: dict[str, int] = {}
+        self._m_events = None
+
+    def bind_metrics(self, registry) -> "Migration":
+        """Expose the event ledger as ``migration_client_total{kind}``."""
+        self._m_events = registry.counter(
+            "migration_client_total",
+            "Migration operator client-side events by kind",
+        )
+        return self
+
+    def _count(self, kind: str) -> None:
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        if self._m_events is not None:
+            self._m_events.inc(kind=kind)
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         if not isinstance(request, dict):
@@ -41,19 +79,54 @@ class Migration(Operator):
             finally:
                 await stream.aclose()
 
-        request = dict(request)
+        orig = dict(request)
+        orig_prompt = list(orig.get("token_ids") or [])
+        orig_stop = dict(orig.get("stop") or {})
+        orig_max = orig_stop.get("max_tokens")
+        orig_min = orig_stop.get("min_tokens") or 0
         migrations = 0
-        emitted: list[int] = []
+        delivered: list[int] = []  # every token the CLIENT saw, all legs
         finished = False
+        request = orig
         while True:
             stream = self.inner.generate(request, context.child())
+            marker: dict | None = None
             try:
                 async for raw in stream:
+                    if (
+                        isinstance(raw, dict)
+                        and raw.get("migration") is not None
+                        and not raw.get("finish_reason")
+                    ):
+                        # Live-migration handoff frame: the stream resumes
+                        # elsewhere. Consumed here — the client never sees it.
+                        marker = raw["migration"]
+                        continue
                     if isinstance(raw, dict) and raw.get("token_ids"):
-                        emitted.extend(raw["token_ids"])
+                        delivered.extend(raw["token_ids"])
                     if isinstance(raw, dict) and raw.get("finish_reason"):
                         finished = True
                     yield raw
+                if marker is not None and not finished:
+                    if orig_max is not None and len(delivered) >= orig_max:
+                        # Handoff raced the budget edge: nothing left to
+                        # generate — complete locally instead of resuming.
+                        self._count("budget_exhausted")
+                        yield {"token_ids": [], "finish_reason": "length"}
+                        return
+                    migrated_to = marker.get("dest_instance")
+                    tracing.start_span_if(
+                        context.trace, "migration.resume",
+                        dest=str(migrated_to), carried_tokens=len(delivered),
+                    ).end()
+                    log.info(
+                        "live handoff for %s → instance %s (%d tokens carried)",
+                        context.id, migrated_to, len(delivered),
+                    )
+                    request = self._resume_request(orig, marker, orig_prompt,
+                                                   orig_stop, delivered)
+                    self._count("resume")
+                    continue
                 return
             except TruncatedStreamError:
                 if finished:
@@ -61,6 +134,14 @@ class Migration(Operator):
                     # a finish_reason) and the final bookkeeping frame: the
                     # generation is semantically complete. Re-dispatching
                     # would append tokens past the client's budget.
+                    return
+                if orig_max is not None and len(delivered) >= orig_max:
+                    # The leg delivered its entire budget, then died before
+                    # the finish frame. Exactly-once accounting: synthesize
+                    # the finish instead of re-dispatching — a retry leg
+                    # would emit (and the ledger would bill) extra tokens.
+                    self._count("budget_exhausted")
+                    yield {"token_ids": [], "finish_reason": "length"}
                     return
                 if migrations >= self.migration_limit or context.cancelled:
                     raise
@@ -75,34 +156,92 @@ class Migration(Operator):
                 tracing.start_span_if(
                     context.trace, "migration.redispatch",
                     migration=migrations, limit=self.migration_limit,
-                    carried_tokens=len(emitted),
+                    carried_tokens=len(delivered),
                 ).end()
                 log.warning(
                     "stream died mid-flight for %s; migrating (%d/%d, %d tokens carried)",
-                    context.id, migrations, self.migration_limit, len(emitted),
+                    context.id, migrations, self.migration_limit, len(delivered),
                 )
-                # Re-dispatch: generated tokens become part of the prompt;
-                # the generation budget (max AND min) shrinks by what was
-                # already emitted so the client-requested lengths hold.
-                request = dict(request)
-                request["token_ids"] = list(request.get("token_ids") or []) + emitted
-                stop = dict(request.get("stop") or {})
-                if stop.get("max_tokens") is not None:
-                    stop["max_tokens"] = max(1, stop["max_tokens"] - len(emitted))
-                if stop.get("min_tokens"):
-                    stop["min_tokens"] = max(0, stop["min_tokens"] - len(emitted))
-                request["stop"] = stop
-                # Seeded sampling: the new worker's emission index restarts
-                # at 0, so fold the carried-token count into the seed — the
-                # continuation draws fresh noise instead of replaying the
-                # gumbel indices the dead worker already consumed. (A
-                # migrated seeded stream is a fresh draw, not a bitwise
-                # continuation — same stance as engine restart.)
-                sampling = dict(request.get("sampling") or {})
-                if sampling.get("seed") is not None:
-                    sampling["seed"] = (int(sampling["seed"]) + 0x9E3779B1 * len(emitted)) & 0x7FFFFFFF
-                    request["sampling"] = sampling
-                emitted = []
+                request = self._redispatch_request(orig, orig_prompt, orig_stop,
+                                                   delivered)
+                self._count("redispatch")
                 continue
             finally:
                 await stream.aclose()
+
+    # -- next-leg request builders ------------------------------------------
+    #
+    # Both derive budgets from the ORIGINAL stop conditions minus the
+    # cross-leg delivered count — never from the previous leg's (already
+    # shrunk) budget — so token accounting is exact however many legs run.
+
+    @staticmethod
+    def _resume_request(orig: dict, marker: dict, orig_prompt: list[int],
+                        orig_stop: dict, delivered: list[int]) -> dict:
+        """Leg request following a clean handoff marker: full identity
+        (seed/step/prompt boundary/adapter) rides ``kv_transfer_params``
+        and the router pins the first attempt to the destination."""
+        mreq = (marker.get("request") or {})
+        resume = dict(mreq.get("resume") or {})
+        # Our own ledger is the source of truth for what the client saw;
+        # the prompt boundary stays the ORIGINAL prompt however many legs
+        # ran (penalty window + grammar replay both key off it).
+        resume["prompt_len"] = len(orig_prompt)
+        req = dict(orig)
+        req["token_ids"] = orig_prompt + delivered
+        stop = dict(orig_stop)
+        if orig_stop.get("max_tokens") is not None:
+            stop["max_tokens"] = max(1, orig_stop["max_tokens"] - len(delivered))
+        if orig_stop.get("min_tokens"):
+            stop["min_tokens"] = max(0, orig_stop["min_tokens"] - len(delivered))
+        req["stop"] = stop
+        ktp = dict(orig.get("kv_transfer_params") or {})
+        ktp["resume"] = resume
+        pin = {
+            "handle": marker.get("handle"),
+            "instance": marker.get("dest_instance"),
+        }
+        if marker.get("rebind") is False:
+            pin["rebind"] = False
+        ktp["migration_resume"] = pin
+        req["kv_transfer_params"] = ktp
+        return req
+
+    @staticmethod
+    def _redispatch_request(orig: dict, orig_prompt: list[int],
+                            orig_stop: dict, delivered: list[int]) -> dict:
+        """Leg request after a truncation: generated tokens become part of
+        the prompt; the generation budget (max AND min) shrinks by what
+        was already delivered so the client-requested lengths hold."""
+        req = dict(orig)
+        req["token_ids"] = orig_prompt + delivered
+        stop = dict(orig_stop)
+        if orig_stop.get("max_tokens") is not None:
+            stop["max_tokens"] = max(1, orig_stop["max_tokens"] - len(delivered))
+        if orig_stop.get("min_tokens"):
+            stop["min_tokens"] = max(0, orig_stop["min_tokens"] - len(delivered))
+        req["stop"] = stop
+        # Seeded sampling: the new worker's emission index restarts at 0,
+        # so fold the carried-token count into the seed — the continuation
+        # draws fresh noise instead of replaying the gumbel indices the
+        # dead worker already consumed. (A truncation-migrated seeded
+        # stream is a fresh draw, not a bitwise continuation — same stance
+        # as engine restart. Clean handoffs, by contrast, continue the
+        # exact seed/step in _resume_request.)
+        sampling = dict(orig.get("sampling") or {})
+        if sampling.get("seed") is not None:
+            sampling["seed"] = (
+                int(sampling["seed"]) + 0x9E3779B1 * len(delivered)
+            ) & 0x7FFFFFFF
+            req["sampling"] = sampling
+        # Strip any previous handoff's pin/identity; keep only the prompt
+        # boundary so penalties and grammar replay still see carried
+        # tokens as GENERATED on the retry worker.
+        ktp = dict(orig.get("kv_transfer_params") or {})
+        ktp.pop("resume", None)
+        ktp.pop("migration_resume", None)
+        if delivered:
+            ktp["resume"] = {"prompt_len": len(orig_prompt)}
+        if ktp:
+            req["kv_transfer_params"] = ktp
+        return req

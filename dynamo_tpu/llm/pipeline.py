@@ -44,13 +44,52 @@ class RouterSettings:
 
 class _RouterEngine:
     """Adapts PushRouter (positional instance_id API) to the AsyncEngine
-    shape used by pipeline operators."""
+    shape used by pipeline operators. A migration resume leg pins its
+    first dispatch to the destination instance; a pre-stream failure
+    there falls back to normal placement (the resume identity rides the
+    request, so any worker serves the leg byte-identically)."""
 
     def __init__(self, push: PushRouter):
         self.push = push
 
     def generate(self, request: Any, context: Context):
+        pin = None
+        if isinstance(request, dict):
+            mr = (request.get("kv_transfer_params") or {}).get("migration_resume")
+            if isinstance(mr, dict):
+                pin = mr.get("instance")
+        if pin is not None:
+            return self._pinned(request, context, int(pin))
         return self.push.generate(request, context)
+
+    async def _pinned(self, request: Any, context: Context, wid: int):
+        from dynamo_tpu.runtime.messaging import (
+            NoHandlerError,
+            OverloadedError,
+            TruncatedStreamError,
+        )
+        from dynamo_tpu.runtime.push_router import NoInstancesError
+
+        stream = self.push.generate(request, context, instance_id=wid)
+        first = True
+        try:
+            async for item in stream:
+                first = False
+                yield item
+            return
+        except (NoInstancesError, TruncatedStreamError, NoHandlerError,
+                OverloadedError, ConnectionError, OSError):
+            if not first:
+                raise  # mid-stream death: Migration's responsibility
+            log.warning("migration pin to %x failed pre-stream; re-placing", wid)
+        finally:
+            await stream.aclose()
+        fallback = self.push.generate(request, context)
+        try:
+            async for item in fallback:
+                yield item
+        finally:
+            await fallback.aclose()
 
 
 class ModelPipeline:

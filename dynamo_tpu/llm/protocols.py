@@ -958,6 +958,11 @@ def coalesce_delta(head: dict, tail: dict) -> dict | None:
     present on one side only, so alignment with token_ids never breaks."""
     if head.get("finish_reason") or head.get("error") or tail.get("error"):
         return None
+    # A migration handoff marker must reach the Migration operator as its
+    # own frame: merging it into a token delta would silently drop the
+    # resume payload (only the whitelisted keys below survive a merge).
+    if head.get("migration") is not None or tail.get("migration") is not None:
+        return None
     h_ids, t_ids = head.get("token_ids") or [], tail.get("token_ids") or []
     for key in ("log_probs", "top_log_probs"):
         h, t = head.get(key), tail.get(key)

@@ -159,7 +159,13 @@ class RuntimeActuator:
                 )
         else:
             info = self._pick(pools, action.src)
-        await self._rpc(info.instance_id, {"cmd": "set_role", "role": action.dst})
+        # Relocate-not-drain: the worker live-migrates its running
+        # decodes to pool peers before the drain; any sequence that
+        # fails to relocate falls back to the drain as before.
+        await self._rpc(
+            info.instance_id,
+            {"cmd": "set_role", "role": action.dst, "relocate": True},
+        )
         await self._wait(
             lambda pools: any(
                 w.key == info.key for w in pools.get(action.dst, ())
@@ -207,7 +213,9 @@ class RuntimeActuator:
 
     async def _retire(self, victim: WorkerInfo) -> None:
         try:
-            await self._rpc(victim.instance_id, {"cmd": "retire"})
+            # Retirement relocates running decodes to the surviving pool
+            # first (drain remains the per-sequence fallback).
+            await self._rpc(victim.instance_id, {"cmd": "retire", "relocate": True})
         except ScaleActionError:
             # A worker that died mid-drain (or whose stream was cut by
             # its own exit) converges the same way: its lease-backed

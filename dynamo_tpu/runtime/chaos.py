@@ -50,12 +50,14 @@ class ChaosStats:
     transfer_cuts: int = 0
     frontend_kills: int = 0
     operator_kills: int = 0
+    migration_cuts: int = 0
     latency_injections: int = 0
 
     def total(self) -> int:
         return (
             self.frames_dropped + self.streams_truncated + self.kills
             + self.transfer_cuts + self.frontend_kills + self.operator_kills
+            + self.migration_cuts
         )
 
 
@@ -149,6 +151,33 @@ class ChaosInjector:
             self.stats.operator_kills += 1
             self._count("operator_kill")
             raise ChaosKillError("injected operator death")
+
+    MIGRATION_VICTIMS = ("source", "dest", "store")
+
+    def maybe_cut_migration(self, phase: str) -> str | None:
+        """Consulted by the migration coordinator (worker/migrate.py) at
+        each phase boundary (``streaming``/``cutover``/``rebind``): on a
+        hit, → a (seeded-)random victim among source/dest/store whose
+        death the coordinator must then simulate at that phase. The
+        client stream must still complete byte-identically via fallback
+        (tests/test_migration_live.py pins every phase × victim cell).
+        ``migration_cut_plan = "<phase>:<victim>"`` deterministically
+        forces one cell. → None on no fault."""
+        plan = self.config.migration_cut_plan
+        if plan:
+            want_phase, _, want_victim = plan.partition(":")
+            if want_phase == phase and want_victim in self.MIGRATION_VICTIMS:
+                self.stats.migration_cuts += 1
+                self._count("migration_cut")
+                return want_victim
+        if (
+            self.config.migration_cut_p > 0
+            and self.rng.random() < self.config.migration_cut_p
+        ):
+            self.stats.migration_cuts += 1
+            self._count("migration_cut")
+            return self.rng.choice(self.MIGRATION_VICTIMS)
+        return None
 
     def maybe_kill_frontend(self, candidates: list):
         """Consulted once per fleet-supervisor monitor tick: on a hit,

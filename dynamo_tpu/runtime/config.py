@@ -195,6 +195,15 @@ class ChaosConfig:
     operator_kill_p: float = 0.0
     # Injected per-frame latency: uniform in [0, latency_ms].
     latency_ms: float = 0.0
+    # Probability a live-migration phase boundary (worker/migrate.py:
+    # streaming, cutover, rebind) is cut, killing a seeded-random victim
+    # among source/dest/store. The stream must still complete via the
+    # re-dispatch fallback — never a client-visible error.
+    migration_cut_p: float = 0.0
+    # Deterministic pin for the migration chaos grid: "<phase>:<victim>"
+    # (e.g. "cutover:dest") forces exactly that cut on every matching
+    # phase consult, independent of migration_cut_p. Empty = off.
+    migration_cut_plan: str = ""
 
     @classmethod
     def section(cls) -> str:

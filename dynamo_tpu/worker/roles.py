@@ -77,6 +77,12 @@ class WorkerRoleManager:
         self._puller = None
         self._admin_handle = None
         self._peer_handle = None
+        # Live migration (worker/migrate.py): outbound coordinator +
+        # inbound receiver, wired in start() when the engine has the
+        # migration surface. None on control-plane-only engines.
+        self.migrator = None
+        self.receiver = None
+        self._peer_rr = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -85,6 +91,33 @@ class WorkerRoleManager:
             raise WorkerRoleError(f"unknown role {role!r}")
         comp = self.rt.namespace(self.namespace).component(ADMIN_COMPONENT)
         self._admin_handle = await comp.endpoint(ADMIN_ENDPOINT).serve(self._admin)
+        if hasattr(self.engine, "migration_begin"):
+            from dynamo_tpu.runtime.push_router import RouterMode
+            from dynamo_tpu.worker.migrate import (
+                MigrationCoordinator,
+                MigrationReceiver,
+                register_migration_metrics,
+            )
+
+            metrics = register_migration_metrics(self.rt.metrics)
+            self.receiver = MigrationReceiver(
+                self.rt, self.namespace, chaos=self.chaos, metrics=metrics
+            )
+            self.migrator = MigrationCoordinator(
+                self.engine,
+                await comp.endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT),
+                self.args.component,
+                await self.rt.primary_lease(),
+                chaos=self.chaos,
+                metrics=metrics,
+            )
+            # QoS defrag: the engine offers a relocation before killing
+            # a preemption victim. Called from the scheduler thread →
+            # bounce onto the event loop.
+            loop = asyncio.get_running_loop()
+            self.engine.migration_offer = lambda rid: loop.call_soon_threadsafe(
+                lambda: loop.create_task(self._offer_migration(rid))
+            )
         # G4 peer prefix serving is role-agnostic (host-tier reads):
         # registered once, survives every transition.
         if self.args.engine == "tpu":
@@ -98,7 +131,7 @@ class WorkerRoleManager:
             await self._activate(role)
         return self
 
-    async def set_role(self, role: str) -> dict:
+    async def set_role(self, role: str, relocate: bool = True) -> dict:
         if role not in (POOL_DECODE, POOL_PREFILL):
             raise WorkerRoleError(f"unknown role {role!r}")
         async with self._lock:
@@ -107,19 +140,25 @@ class WorkerRoleManager:
             if role == self.role:
                 return self.status()
             log.info("pool move: %s → %s", self.role, role)
+            if relocate:
+                await self._relocate_running()
             await self._deactivate()
             await self._activate(role)
             return self.status()
 
-    async def retire(self) -> None:
+    async def retire(self, relocate: bool = True) -> None:
         """Drain + deregister everything and signal the process to
         exit — the scale-down half of zero-downtime replica scaling.
         New work stops the moment the instances deregister; in-flight
-        streams complete inside the drain."""
+        streams complete inside the drain (running decodes RELOCATE to
+        peers first when possible, so retirement usually drains an
+        already-empty batch)."""
         async with self._lock:
             if self.retired.is_set():
                 return
             log.info("retiring (%s)", self.role)
+            if relocate:
+                await self._relocate_running()
             await self._deactivate()
             try:
                 await self.rt.store.delete(
@@ -131,10 +170,70 @@ class WorkerRoleManager:
 
     async def close(self) -> None:
         await self.retire()
+        if self.receiver is not None:
+            await self.receiver.close()
         for h in (self._peer_handle, self._admin_handle):
             if h is not None:
                 await h.close()
         self._peer_handle = self._admin_handle = None
+
+    # -- live migration -----------------------------------------------------
+
+    async def _peers(self) -> list[int]:
+        """Live decode-pool peer instance ids (relocation targets),
+        excluding this worker."""
+        from dynamo_tpu.planner.actuate import read_pools
+
+        me = await self.rt.primary_lease()
+        pools = await read_pools(self.rt.store, self.namespace)
+        return [
+            w.instance_id for w in pools.get(POOL_DECODE, [])
+            if w.instance_id != me
+        ]
+
+    async def _relocate_running(self) -> dict:
+        """Best-effort relocation of every running decode to peer decode
+        workers — pool moves and retirement RELOCATE instead of drain.
+        Any failure just leaves that sequence to the drain (the
+        fallback); this must never raise."""
+        if self.migrator is None or self.role != POOL_DECODE:
+            return {}
+        if not hasattr(self.engine, "list_running"):
+            return {}
+        try:
+            peers = await self._peers()
+        except Exception as e:  # noqa: BLE001 — a degraded store only disables relocation; the drain still runs
+            log.warning("relocation peer discovery failed (%s); draining", e)
+            return {}
+        if not peers:
+            return {}
+        moved = kept = 0
+        for i, rid in enumerate(self.engine.list_running()):
+            res = await self.migrator.migrate_out(rid, peers[i % len(peers)])
+            if res.get("ok"):
+                moved += 1
+            else:
+                kept += 1
+        if moved or kept:
+            log.info("relocation: %d moved, %d left to drain", moved, kept)
+        return {"relocated": moved, "kept": kept}
+
+    async def _offer_migration(self, request_id: str) -> None:
+        """Engine preemption-offer hook target: try to relocate the
+        would-be preemption victim to a peer. Failure is fine — the
+        engine's grace deadline expires and it preempts as before."""
+        if self.migrator is None:
+            return
+        try:
+            peers = await self._peers()
+            if not peers:
+                return
+            self._peer_rr += 1
+            await self.migrator.migrate_out(
+                request_id, peers[self._peer_rr % len(peers)]
+            )
+        except Exception:  # noqa: BLE001 — the offer is advisory; the engine's preemption fallback owns correctness
+            log.exception("preemption-relief migration of %s failed", request_id)
 
     # -- role wiring --------------------------------------------------------
 
@@ -213,12 +312,41 @@ class WorkerRoleManager:
                 inner=handler,
             )
         gen = handler
+        receiver = self.receiver
 
         async def gen_handler(payload, ctx):
+            if receiver is not None and isinstance(payload, dict):
+                # Migration resume leg: claim the staged KV inject for
+                # this handle, if we are the destination that pulled it.
+                # A miss (wrong worker after a pin fallback, expired
+                # stage) is fine — the identity rides the request and
+                # admission just re-prefills from the carried tokens.
+                mr = (payload.get("kv_transfer_params") or {}).get("migration_resume")
+                if isinstance(mr, dict) and mr.get("handle"):
+                    staged = receiver.take(mr["handle"])
+                    if staged is not None:
+                        payload = dict(payload)
+                        ktp = dict(payload.get("kv_transfer_params") or {})
+                        ktp["inject"] = staged
+                        payload["kv_transfer_params"] = ktp
             async for item in gen.generate(payload, ctx):
                 yield item
 
         self._handles.append(await comp.endpoint(args.endpoint).serve(gen_handler))
+        if hasattr(self.engine, "get_stream_export"):
+            # Decode workers serve the same windowed kv_fetch surface as
+            # prefill workers: a migration DESTINATION pulls the source's
+            # chunk stream from here (PrefillHandler.kv_fetch is
+            # handle-generic — any registered KvStreamExport serves).
+            from dynamo_tpu.llm.disagg import DisaggConfig, PrefillHandler
+
+            dcfg = DisaggConfig()
+            fetch = PrefillHandler(
+                self.engine, frame_bytes=dcfg.frame_bytes, chaos=self.chaos
+            )
+            self._handles.append(
+                await comp.endpoint(dcfg.fetch_endpoint).serve(fetch.kv_fetch)
+            )
         self._handles.extend(
             await serve_kv_endpoints(comp, self.broadcaster, self.engine.metrics)
         )
@@ -280,19 +408,61 @@ class WorkerRoleManager:
             "retiring": self.retired.is_set(),
         }
 
+    async def _migrate_out_cmd(self, payload: dict) -> dict:
+        """``{"cmd": "migrate_out", "request_id", "dest_instance"?}`` —
+        the planner/operator verb. Without a destination, round-robins
+        the live decode peers."""
+        if self.migrator is None:
+            return {"error": "migration unsupported on this engine"}
+        request_id = payload.get("request_id", "")
+        dest = payload.get("dest_instance")
+        if dest is None:
+            peers = await self._peers()
+            if not peers:
+                return {"ok": False, "reason": "no_peer"}
+            self._peer_rr += 1
+            dest = peers[self._peer_rr % len(peers)]
+        return await self.migrator.migrate_out(request_id, int(dest))
+
     async def _admin(self, payload: Any, ctx):
-        cmd = (payload or {}).get("cmd")
+        payload = payload or {}
+        cmd = payload.get("cmd")
+        relocate = payload.get("relocate") is not False
         try:
             if cmd == "status":
                 yield self.status()
             elif cmd == "set_role":
-                yield await self.set_role((payload or {}).get("role", ""))
+                yield await self.set_role(payload.get("role", ""), relocate=relocate)
             elif cmd == "retire":
                 # Ack first, retire in the background: the drain may
                 # outlive the RPC's own deadline, and the operator
                 # converges on the registration key vanishing anyway.
                 yield {"ok": True, "retiring": True}
-                asyncio.get_running_loop().create_task(self.retire())
+                asyncio.get_running_loop().create_task(self.retire(relocate=relocate))
+            elif cmd == "migrate_out":
+                yield await self._migrate_out_cmd(payload)
+            elif cmd == "migrate_in_start":
+                if self.receiver is None:
+                    yield {"error": "no migration receiver"}
+                else:
+                    yield await self.receiver.start_pull(
+                        payload.get("handle", ""),
+                        payload.get("source_component", ""),
+                        int(payload.get("source_instance") or 0),
+                    )
+            elif cmd == "migrate_in_commit":
+                if self.receiver is None:
+                    yield {"error": "no migration receiver"}
+                else:
+                    yield await self.receiver.commit(
+                        payload.get("handle", ""),
+                        int(payload.get("kv_blocks") or 0),
+                    )
+            elif cmd == "migrate_in_abort":
+                if self.receiver is None:
+                    yield {"error": "no migration receiver"}
+                else:
+                    yield await self.receiver.abort(payload.get("handle", ""))
             else:
                 yield {"error": f"unknown admin cmd {cmd!r}"}
         except WorkerRoleError as e:

@@ -1,0 +1,41 @@
+"""Tier-1 guard for ``bench.py --workload migrate``: the live-migration
+robustness bench must run end to end at smoke shapes, complete forced
+relocations, keep migrated output byte-identical to the unmigrated
+reference in BOTH arms (clean + chaos), and report the accounting keys
+the BENCH_MIGRATE_* trajectory depends on.
+
+No timing assertions: --quick makes no gap-latency claims.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_migrate_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--workload", "migrate", "--quick"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout + proc.stderr[-2000:]
+    result = json.loads(lines[-1])
+    assert "error" not in result, result
+    # Migrated ≡ unmigrated greedy bytes on every stream, both arms.
+    assert result["parity"] is True
+    # The clean arm actually relocated sequences, with KV on the wire.
+    assert result["migrations_ok"] > 0
+    assert result["kv_bytes_moved"] > 0
+    # The chaos arm injected cuts and every cut degraded to a completed
+    # stream (fallback), never a client error (parity covers output).
+    assert result["chaos_injected_cuts"] > 0
+    # The trajectory keys bench rounds compare.
+    for key in ("cutover_gap_p50_ms", "cutover_gap_p99_ms",
+                "chaos_fallback_rate", "kv_bytes_per_migration"):
+        assert key in result, key

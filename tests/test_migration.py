@@ -100,25 +100,177 @@ def test_redispatch_shrinks_budgets_and_extends_prompt():
     asyncio.run(go())
 
 
-def test_redispatch_budget_floors():
-    """max_tokens never drops below 1, min_tokens never below 0, and the
-    seed folds per-migration on the carried count of THAT leg."""
+def test_redispatch_budgets_derive_from_original():
+    """Across multiple legs, budgets always derive from the ORIGINAL stop
+    conditions minus the cross-leg delivered total (never the previous
+    leg's shrunk budget), min_tokens floors at 0, and the seed folds on
+    the cumulative delivered count."""
 
     async def go():
-        inner = FlakyInner([12, 4, 40])
+        inner = FlakyInner([12, 4, 44])
         mig = Migration(inner, migration_limit=3)
-        [_ async for _ in mig.generate(mig_request(max_tokens=14, min_tokens=3), Context())]
+        tokens = [
+            t async for item in mig.generate(mig_request(max_tokens=60, min_tokens=3), Context())
+            for t in (item.get("token_ids") or [])
+        ]
+        assert len(tokens) == 60
         second, third = inner.requests[1], inner.requests[2]
-        assert second["stop"]["max_tokens"] == 2   # 14 - 12
+        assert second["stop"]["max_tokens"] == 48  # 60 - 12
         assert second["stop"]["min_tokens"] == 0   # max(0, 3 - 12)
-        assert third["stop"]["max_tokens"] == 1    # floor: max(1, 2 - 4)
+        assert third["stop"]["max_tokens"] == 44   # 60 - (12 + 4)
         assert len(third["token_ids"]) == 5 + 12 + 4
         seed1 = (123 + 0x9E3779B1 * 12) & 0x7FFFFFFF
-        seed2 = (seed1 + 0x9E3779B1 * 4) & 0x7FFFFFFF
+        seed2 = (123 + 0x9E3779B1 * 16) & 0x7FFFFFFF
         assert second["sampling"]["seed"] == seed1
         assert third["sampling"]["seed"] == seed2
+        # Re-dispatch restores the original prompt boundary so penalties /
+        # grammar replay treat carried tokens as generated, not prompt.
+        assert third["kv_transfer_params"]["resume"] == {"prompt_len": 5}
 
     asyncio.run(go())
+
+
+def test_exactly_once_after_full_budget_leg_dies():
+    """Regression: a leg that delivered its entire max_tokens budget and
+    THEN died (before the finish frame) is complete — the operator must
+    synthesize the length finish, not re-dispatch for ≥1 extra token.
+    The old ``max(1, ...)`` floor over-delivered and double-billed."""
+
+    async def go():
+        inner = FlakyInner([14, 99])
+        mig = Migration(inner, migration_limit=3)
+        out = [item async for item in mig.generate(
+            mig_request(max_tokens=14, min_tokens=0), Context())]
+        tokens = [t for item in out for t in (item.get("token_ids") or [])]
+        assert len(tokens) == 14           # exactly the budget, never 15
+        assert out[-1].get("finish_reason") == "length"
+        assert len(inner.requests) == 1    # no over-delivering retry leg
+        assert mig.counts.get("budget_exhausted") == 1
+
+    asyncio.run(go())
+
+
+class HandoffInner:
+    """AsyncEngine scripting a live-migration handoff: the first call
+    emits a few tokens then posts a ``migration`` marker frame (the
+    engine's cutover handoff shape) and ends WITHOUT a finish; later
+    calls run a scripted FlakyInner-style schedule."""
+
+    def __init__(self, pre_tokens: int, emits_after: list[int],
+                 marker_extra: dict | None = None):
+        self.pre_tokens = pre_tokens
+        self.emits_after = emits_after
+        self.marker_extra = marker_extra or {}
+        self.requests: list[dict] = []
+
+    async def generate(self, request, context):
+        call = len(self.requests)
+        self.requests.append(request)
+        if call == 0:
+            for i in range(self.pre_tokens):
+                yield {"token_ids": [100 + i]}
+            marker = {
+                "handle": "mig-test",
+                "dest_instance": 42,
+                "request": {
+                    "token_ids": list(request["token_ids"]) + list(range(100, 100 + self.pre_tokens)),
+                    "resume": {"sample_seed": 123, "sample_step": self.pre_tokens},
+                },
+                **self.marker_extra,
+            }
+            yield {"token_ids": [], "migration": marker}
+            return
+        leg = call - 1
+        n = self.emits_after[leg]
+        start = 100 + self.pre_tokens + sum(self.emits_after[:leg])
+        for i in range(n):
+            yield {"token_ids": [start + i]}
+        if leg < len(self.emits_after) - 1:
+            raise TruncatedStreamError("scripted death")
+        yield {"token_ids": [], "finish_reason": "length"}
+
+
+def test_handoff_marker_resumes_pinned_with_identity():
+    """A clean handoff marker is consumed (never client-visible), does not
+    count against migration_limit, and the next leg carries the full
+    resume identity pinned to the destination instance."""
+
+    async def go():
+        inner = HandoffInner(3, [37])
+        mig = Migration(inner, migration_limit=0)  # limit 0: handoff ≠ failure
+        out = [item async for item in mig.generate(mig_request(), Context())]
+        tokens = [t for item in out for t in (item.get("token_ids") or [])]
+        assert len(tokens) == 40
+        assert all("migration" not in item for item in out)
+        assert len(inner.requests) == 2
+        leg2 = inner.requests[1]
+        assert leg2["token_ids"] == [1, 2, 3, 4, 5] + [100, 101, 102]
+        assert leg2["stop"]["max_tokens"] == 37   # 40 - 3
+        assert leg2["stop"]["min_tokens"] == 7    # 10 - 3
+        ktp = leg2["kv_transfer_params"]
+        # Identity: exact seed/step continuation + original prompt boundary.
+        assert ktp["resume"]["sample_seed"] == 123
+        assert ktp["resume"]["sample_step"] == 3
+        assert ktp["resume"]["prompt_len"] == 5
+        assert ktp["migration_resume"]["handle"] == "mig-test"
+        assert ktp["migration_resume"]["instance"] == 42
+        assert "rebind" not in ktp["migration_resume"]
+        # Clean handoff keeps the client seed untouched (no re-salt).
+        assert leg2["sampling"]["seed"] == 123
+        assert mig.counts.get("resume") == 1
+
+    asyncio.run(go())
+
+
+def test_handoff_marker_rebind_false_propagates():
+    async def go():
+        inner = HandoffInner(2, [38], marker_extra={"rebind": False})
+        mig = Migration(inner, migration_limit=0)
+        [_ async for _ in mig.generate(mig_request(), Context())]
+        pin = inner.requests[1]["kv_transfer_params"]["migration_resume"]
+        assert pin["rebind"] is False
+
+    asyncio.run(go())
+
+
+def test_resume_leg_truncation_falls_back_exactly_once():
+    """Handoff → destination leg dies mid-stream → re-dispatch fallback:
+    budgets still derive from the ORIGINAL request minus ALL delivered
+    tokens (handoff leg included), the destination pin is stripped, and
+    the seed re-salts on the cumulative delivered count."""
+
+    async def go():
+        inner = HandoffInner(3, [2, 35])
+        mig = Migration(inner, migration_limit=3)
+        tokens = [
+            t async for item in mig.generate(mig_request(), Context())
+            for t in (item.get("token_ids") or [])
+        ]
+        assert len(tokens) == 40
+        assert tokens == list(range(100, 140))  # no gap, no repeat
+        leg3 = inner.requests[2]
+        assert leg3["token_ids"] == [1, 2, 3, 4, 5] + list(range(100, 105))
+        assert leg3["stop"]["max_tokens"] == 35   # 40 - (3 + 2)
+        ktp = leg3["kv_transfer_params"]
+        assert "migration_resume" not in ktp      # pin stripped on fallback
+        assert ktp["resume"] == {"prompt_len": 5}
+        assert leg3["sampling"]["seed"] == (123 + 0x9E3779B1 * 5) & 0x7FFFFFFF
+        assert mig.counts == {"resume": 1, "redispatch": 1}
+
+    asyncio.run(go())
+
+
+def test_coalesce_refuses_to_merge_migration_marker():
+    """The engine's delta coalescer must never fold a migration handoff
+    marker into a token delta — only whitelisted keys survive a merge and
+    the resume payload would be silently dropped."""
+    from dynamo_tpu.llm.protocols import coalesce_delta
+
+    head = {"token_ids": [7, 8]}
+    marker = {"token_ids": [], "migration": {"handle": "h"}}
+    assert coalesce_delta(head, marker) is None
+    assert coalesce_delta(marker, {"token_ids": [9]}) is None
+    assert coalesce_delta(head, {"token_ids": [9]}) is not None
 
 
 def test_migration_limit_zero_reraises():

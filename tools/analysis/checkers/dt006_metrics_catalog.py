@@ -107,12 +107,20 @@ async def build_registries():
     planner_registry = MetricsRegistry()
     register_planner_metrics(planner_registry)
 
+    # Live-migration series (worker/migrate.py): registered on their own
+    # registry as the worker role manager does for migratable engines.
+    from dynamo_tpu.worker.migrate import register_migration_metrics
+
+    migration_registry = MetricsRegistry()
+    register_migration_metrics(migration_registry)
+
     registries = [
         ("worker", wrt.metrics),
         ("frontend", frt.metrics),
         ("exporter", ert.metrics),
         ("fleet", fleet_registry),
         ("planner", planner_registry),
+        ("migration", migration_registry),
     ]
 
     async def cleanup():
