@@ -188,6 +188,31 @@ def pctl(xs, q):
     return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
 
 
+def slo_attribution(recs, *, ttft_slo_s=None, itl_slo_ms=None):
+    """Emit the fleet attribution schema (docs/observability.md, ledger
+    v2) from bench per-request records: the TTFT window attributes to
+    the prefill phase, the streaming window to decode. Same shape the
+    frontend's ``/debug/slo`` and the diurnal sim report, so anomaly
+    tooling compares bench runs against live fleets field-for-field."""
+    from dynamo_tpu.runtime.slo import attribution_summary
+
+    records = []
+    for r in recs:
+        if "ttft" not in r:
+            continue
+        rec = {
+            "ttft_s": r["ttft"],
+            "completion_tokens": r.get("n", 0),
+            "phases": {"prefill": r["ttft"]},
+        }
+        if r.get("n", 0) > 1 and r.get("dur"):
+            rec["phases"]["decode"] = r["dur"]
+            rec["itl_s"] = r["dur"] / (r["n"] - 1)
+        records.append(rec)
+    return attribution_summary(
+        records, ttft_slo_s=ttft_slo_s, itl_slo_ms=itl_slo_ms)
+
+
 def _stage(msg: str) -> None:
     """Progress breadcrumbs on stderr — a silent 40-minute compile wall
     is indistinguishable from a hang without these."""
@@ -706,6 +731,7 @@ async def bench(args) -> dict:
         "tokens_per_weight_pass": round(tokens_per_weight_pass, 3),
         **spec_metrics,
         "roofline": roofline,
+        "slo_attribution": slo_attribution(recs),
         **sla,
         **frontend,
     }
@@ -2126,6 +2152,9 @@ async def bench_disagg(args) -> dict:
         "max_prefill_tokens": max_prefill,
         "workload": "lognormal-mixed",
         "quick": bool(quick),
+        # Same attribution schema as bench()/diurnal: the A/B only keeps
+        # TTFTs per request, so only the prefill phase is attributed.
+        "slo_attribution": slo_attribution([{"ttft": t} for t in dis_ttfts]),
     }
     if not parity:
         bad = sum(1 for a, b in zip(agg_streams, dis_streams) if a != b)

@@ -157,6 +157,7 @@ class _Req:
     tokens: int = 0
     itl_sum: float = 0.0
     t_done: float = -1.0
+    stall_s: float = 0.0  # cutover freeze time this stream absorbed
 
     @property
     def ttft(self) -> float:
@@ -300,6 +301,7 @@ class DiurnalSim:
             # Cutover gap: the migrated stream's next token waits out
             # the freeze→commit window, visible as one long ITL.
             req.itl_sum += stall - self.now
+            req.stall_s += stall - self.now
             self.migration_stall_s += stall - self.now
             self.schedule(stall, self._token, w, req)
             return
@@ -438,7 +440,35 @@ def _score(completed: list[_Req], offered: int, day_s: float,
         if completed else None,
         "itl_mean_ms": round(float(np.mean([r.itl_mean for r in completed])) * 1000, 2)
         if completed else None,
+        "slo_attribution": _attribution(completed, ttft_slo_s, itl_slo_ms),
     }
+
+
+def _attribution(completed: list[_Req], ttft_slo_s: float,
+                 itl_slo_ms: float) -> dict:
+    """The fleet attribution schema (docs/observability.md, ledger v2),
+    synthesized from sim bookkeeping: TTFT window → prefill phase,
+    stream time minus cutover stalls → decode, stalls → migration_freeze.
+    Same shape ``bench.py`` and ``/debug/slo`` emit, so anomaly tooling
+    reads real and simulated runs identically."""
+    from dynamo_tpu.runtime.slo import attribution_summary
+
+    records = []
+    for r in completed:
+        phases = {"prefill": r.ttft}
+        stream = max(r.t_done - r.t_first - r.stall_s, 0.0)
+        if stream > 0.0:
+            phases["decode"] = stream
+        if r.stall_s > 0.0:
+            phases["migration_freeze"] = r.stall_s
+        records.append({
+            "ttft_s": r.ttft,
+            "itl_s": r.itl_mean,
+            "completion_tokens": r.glen,
+            "phases": phases,
+        })
+    return attribution_summary(
+        records, ttft_slo_s=ttft_slo_s, itl_slo_ms=itl_slo_ms)
 
 
 async def run_static_arm(trace, interps, n_workers: int, prefill_n: int,

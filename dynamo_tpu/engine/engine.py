@@ -153,7 +153,7 @@ class _Seq:
         "grammar", "grammar_state", "grammar_eos_bits",
         "adapter_id", "adapter_slot", "hash_seed",
         "qos", "qos_rank", "arrival",
-        "step_base", "mig", "offer_deadline",
+        "step_base", "mig", "offer_deadline", "traceparent",
     )
 
     def __init__(self, request_id: str, req: PreprocessedRequest, queue: asyncio.Queue):
@@ -251,6 +251,11 @@ class _Seq:
         # owned, via _migrations).
         self.step_base = 0
         self.mig = None
+        # W3C traceparent of the client request this sequence serves
+        # (stamped by generate() from the wire context). Rides the
+        # migration protocol so the source coordinator's admin RPCs and
+        # the destination's resume leg all join the ORIGINAL trace.
+        self.traceparent: str | None = None
         # Preemption-offer grace: when a migration offer hook fires for
         # this sequence as a preemption victim, the kill waits until
         # this deadline for the relocation to free the blocks instead.
@@ -1049,6 +1054,22 @@ class TpuEngine:
         queue: asyncio.Queue = asyncio.Queue()
         t_submit = time.perf_counter()
         seq = _Seq(context.id, req, queue)
+        # Span lineage across relocation: a resume leg that arrives
+        # without a live trace (engine-direct dispatch, staged-inject
+        # claim path) re-anchors on the traceparent the cutover identity
+        # carried, so destination spans join the original request trace
+        # instead of minting a fresh root.
+        resume_tp = ((req.kv_transfer_params or {}).get("resume") or {}).get("traceparent")
+        if context.trace is None and resume_tp:
+            from dynamo_tpu.runtime.logging import TraceContext
+
+            try:
+                context.trace = TraceContext.parse(str(resume_tp))
+            except Exception:  # noqa: BLE001 — a malformed carried traceparent must never fail the resume leg
+                pass
+        seq.traceparent = (
+            context.trace.traceparent() if context.trace is not None else None
+        )
         if grammar is not None:
             seq.grammar = grammar
             seq.grammar_state = grammar.start
@@ -2055,7 +2076,8 @@ class TpuEngine:
         seq.mig = mig
         self._migrations[request_id] = mig
         self._pump_migration(mig)
-        return {"ok": True, "handle": handle, "published": mig.pub_blocks}
+        return {"ok": True, "handle": handle, "published": mig.pub_blocks,
+                "traceparent": seq.traceparent}
 
     def migration_status(self, request_id: str) -> dict:
         """Cutover-lag probe: how far the stream cursor trails the KV
@@ -2121,6 +2143,7 @@ class TpuEngine:
                     "spec_ema": seq.spec_ema,
                     "grammar_state": seq.grammar_state,
                     "next_write_pos": seq.next_write_pos,
+                    "traceparent": seq.traceparent,
                 },
             },
         }

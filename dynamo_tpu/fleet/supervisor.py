@@ -42,7 +42,7 @@ import time
 from aiohttp import ClientSession, ClientTimeout, web
 
 from dynamo_tpu.fleet import FleetError, register_fleet_supervisor_metrics
-from dynamo_tpu.fleet.aggregate import merge_ledgers, merge_metrics
+from dynamo_tpu.fleet.aggregate import merge_ledgers, merge_metrics, merge_traces
 from dynamo_tpu.fleet.backoff import BackoffPolicy
 from dynamo_tpu.fleet.budget import budget_prefix
 from dynamo_tpu.runtime.config import Config
@@ -202,6 +202,7 @@ class FleetSupervisor:
         app = web.Application()
         app.router.add_get("/metrics", self._agg_metrics)
         app.router.add_get("/debug/requests", self._agg_requests)
+        app.router.add_get("/debug/fleet/traces/{trace_id}", self._fleet_trace)
         app.router.add_get("/health", self._agg_health)
         app.router.add_get("/fleet", self._fleet_status)
         app.router.add_post("/fleet/resize", self._fleet_resize)
@@ -448,6 +449,31 @@ class FleetSupervisor:
     async def _agg_requests(self, request: web.Request) -> web.Response:
         parts = await self._scrape("/debug/requests")
         return web.json_response(merge_ledgers(parts))
+
+    async def _fleet_trace(self, request: web.Request) -> web.Response:
+        """One trace's complete cross-process span tree: every child's
+        ``/debug/traces/{id}`` fragment (pull path) plus the store-backed
+        export under ``fleet/<id>/trace/…`` (push path), stitched into a
+        single Chrome-trace body with one lane per process. Deterministic
+        serialization (sorted spans, sorted keys): repeated GETs of the
+        same fragment set are byte-identical."""
+        from dynamo_tpu.runtime.trace_export import load_fleet_trace
+
+        trace_id = request.match_info["trace_id"]
+        parts = [
+            (wid, body)
+            for wid, body in await self._scrape(f"/debug/traces/{trace_id}")
+            if isinstance(body, dict) and "traceEvents" in body
+        ]
+        extra = await load_fleet_trace(self._store, self.fleet_id, trace_id)
+        if not parts and not extra:
+            return web.json_response(
+                {"error": f"unknown trace {trace_id}"}, status=404
+            )
+        body = merge_traces(trace_id, parts, extra_spans=extra)
+        return web.json_response(
+            body, dumps=lambda b: json.dumps(b, sort_keys=True)
+        )
 
     async def _agg_health(self, request: web.Request) -> web.Response:
         regs = await self.registrations()

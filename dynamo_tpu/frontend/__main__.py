@@ -110,9 +110,22 @@ def parse_args(argv=None):
 
 
 async def async_main(args) -> None:
-    rt = await DistributedRuntime.create(store_url=args.store_url)
+    from dynamo_tpu.runtime import tracing
+
     fleet_child = args.fleet_worker_id is not None
+    # Trace-lane identity: this process's spans render in their own lane
+    # of the stitched fleet timeline (docs/observability.md).
+    lane = f"frontend-{args.fleet_worker_id}" if fleet_child else "frontend"
+    tracing.set_default_lane(lane)
+    rt = await DistributedRuntime.create(store_url=args.store_url, proc_label=lane)
     fcfg = rt.config.fleet
+    trace_exporter = None
+    if tracing.enabled() and os.environ.get("DYNTPU_TRACE_EXPORT", "") not in ("", "0"):
+        from dynamo_tpu.runtime.trace_export import TraceExporter
+
+        trace_exporter = await TraceExporter(
+            rt.store, args.fleet_id, lane=lane
+        ).start()
 
     settings = RouterSettings(mode=RouterMode(args.router_mode), record_dir=args.record_dir)
     if settings.mode == RouterMode.KV:
@@ -280,6 +293,7 @@ async def async_main(args) -> None:
         admission=admission, default_timeout=default_timeout,
         reuse_port=args.reuse_port, sock=inherited,
         admin_port=0 if fleet_child else None,
+        proc_label=lane,
     ).start()
 
     reg_key = None
@@ -343,6 +357,9 @@ async def async_main(args) -> None:
             "drain timeout: %d streams still in flight at shutdown", admission.inflight
         )
     async def teardown() -> None:
+        if trace_exporter is not None:
+            with contextlib.suppress(Exception):
+                await trace_exporter.close()  # final flush before the planes drop
         if reg_key is not None:
             with contextlib.suppress(Exception):
                 await rt.store.delete(reg_key)

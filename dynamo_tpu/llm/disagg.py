@@ -203,11 +203,15 @@ class PrefillPuller:
     replies — the decode side times out into local prefill.
     """
 
-    def __init__(self, engine, queue, store, instance_id: int):
+    def __init__(self, engine, queue, store, instance_id: int, lane: str | None = None):
         self.engine = engine
         self.queue = queue
         self.store = store
         self.instance_id = instance_id
+        # Trace lane: the puller loop is a long-lived task (it would
+        # otherwise inherit whatever lane was current at start()), so it
+        # pins its own process/role label for the spans its jobs record.
+        self.lane = lane
         self.jobs_done = 0
         self._task = None
         self._busy = False
@@ -238,6 +242,8 @@ class PrefillPuller:
         await self.stop()
 
     async def _loop(self) -> None:
+        if self.lane:
+            tracing.set_lane(self.lane)
         while True:
             job = await self.queue.dequeue()
             if job is None:
@@ -270,8 +276,16 @@ class PrefillPuller:
             await self._reply(
                 reply_key, {"status": "claimed", "instance_id": self.instance_id}
             )
+        # The job rode the store, not the wire — rehydrate the dispatcher's
+        # trace context so this worker's engine spans join the request's
+        # trace instead of starting an orphan fragment.
+        from dynamo_tpu.runtime.logging import TraceContext
+
+        trace = None
+        if job.get("traceparent"):
+            trace = TraceContext.parse(job["traceparent"], job.get("tracestate"))
         meta = None
-        async for item in self.engine.generate(req, Context()):
+        async for item in self.engine.generate(req, Context(trace=trace)):
             if isinstance(item, dict) and item.get("kv_transfer_params"):
                 meta = item["kv_transfer_params"]
         reply = {"instance_id": self.instance_id}
@@ -446,7 +460,7 @@ class DisaggDecodeHandler:
         }
         if self.queue is not None and self.store is not None:
             try:
-                disp = await self._dispatch_stream_queue(preq)
+                disp = await self._dispatch_stream_queue(preq, ctx)
             except Exception as e:  # noqa: BLE001 — a store/queue fault during dispatch must degrade to local prefill, never fail the request (disagg is not a correctness dependency)
                 log.warning("queued prefill dispatch failed (%s); falling back", e)
                 return None, "dispatch", None
@@ -581,7 +595,7 @@ class DisaggDecodeHandler:
             task,
         )
 
-    async def _dispatch_stream_queue(self, preq: dict):
+    async def _dispatch_stream_queue(self, preq: dict, ctx: Context | None = None):
         """Enqueue the job and rendezvous on the CLAIM reply (posted at
         dequeue time, before the prefill runs). → (instance_for,
         prefill_done, prefill_failed, watch task) | None when nothing
@@ -592,10 +606,18 @@ class DisaggDecodeHandler:
         import msgpack
 
         reply_key = f"disagg/reply/{os.urandom(8).hex()}"
-        job_key = await self.queue.enqueue({
+        job = {
             "req": preq, "reply_key": reply_key,
             "expires_at": time.time() + self.cfg.queue_timeout_s,
-        })
+        }
+        # Store-queued jobs bypass the wire's traceparent header — carry
+        # the trace in the job itself so the claiming prefill worker's
+        # spans join this request's tree.
+        if ctx is not None and ctx.trace is not None:
+            job["traceparent"] = ctx.trace.traceparent()
+            if ctx.trace.tracestate:
+                job["tracestate"] = ctx.trace.tracestate
+        job_key = await self.queue.enqueue(job)
         deadline = time.monotonic() + self.cfg.queue_timeout_s
         watch = await self.store.watch_prefix(reply_key)
         claimed: dict | None = None
@@ -685,7 +707,7 @@ class DisaggDecodeHandler:
         attrs | None)."""
         preq["kv_transfer_params"] = {"do_remote_decode": True}
         if self.queue is not None and self.store is not None:
-            handle_info, why = await self._dispatch_via_queue(preq)
+            handle_info, why = await self._dispatch_via_queue(preq, ctx)
         else:
             handle_info = await self._dispatch_via_push(preq, ctx)
             why = "dispatch"
@@ -728,7 +750,7 @@ class DisaggDecodeHandler:
             return None
         return meta["remote_handle"], instance_id
 
-    async def _dispatch_via_queue(self, preq: dict):
+    async def _dispatch_via_queue(self, preq: dict, ctx: Context | None = None):
         """Enqueue the job, rendezvous on the reply key.
         → ((handle, instance_id) | None, fallback_reason | None) — the
         reason distinguishes a claim timeout from a failed/empty prefill
@@ -738,10 +760,15 @@ class DisaggDecodeHandler:
         reply_key = f"disagg/reply/{os.urandom(8).hex()}"
         job_key = None
         try:
-            job_key = await self.queue.enqueue({
+            job = {
                 "req": preq, "reply_key": reply_key,
                 "expires_at": time.time() + self.cfg.queue_timeout_s,
-            })
+            }
+            if ctx is not None and ctx.trace is not None:
+                job["traceparent"] = ctx.trace.traceparent()
+                if ctx.trace.tracestate:
+                    job["tracestate"] = ctx.trace.tracestate
+            job_key = await self.queue.enqueue(job)
             deadline = time.monotonic() + self.cfg.queue_timeout_s
             watch = await self.store.watch_prefix(reply_key)
             try:

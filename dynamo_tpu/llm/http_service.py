@@ -37,6 +37,7 @@ from dynamo_tpu.llm.protocols import (
 )
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.admission import AdmissionController, AdmissionRejected
+from dynamo_tpu.runtime.slo import SloBurnTracker, attribution_summary
 from dynamo_tpu.runtime.engine import Context, DeadlineExceededError
 from dynamo_tpu.runtime.logging import TraceContext, current_trace, get_logger
 from dynamo_tpu.runtime.messaging import OverloadedError
@@ -62,8 +63,13 @@ class HttpService:
         reuse_port: bool = False,
         sock=None,
         admin_port: int | None = None,
+        proc_label: str | None = None,
     ):
         self.manager = manager
+        # Trace lane for this ingress's spans (http.request + frontend
+        # phases). None keeps the process default lane; in-process fleets
+        # pass distinct labels so each logical frontend gets its own lane.
+        self.proc_label = proc_label
         self.health = health
         self.host = host
         self.port = port
@@ -127,6 +133,11 @@ class HttpService:
             "deadline_expired_total",
             "Requests that ran out of budget, by enforcement point",
         )
+        # SLO attribution plane: burn-rate EMAs fed by the ledger, read
+        # back by the admission gate (burn-aware early rejection) and
+        # exposed on /debug/slo — the planner/QoS evidence seam.
+        self.slo_burn = SloBurnTracker(qos=self.admission.qos, registry=metrics)
+        self.admission.burn = self.slo_burn
         self._metrics_registry = metrics
 
     def build_app(self) -> web.Application:
@@ -143,6 +154,7 @@ class HttpService:
         app.router.add_get("/debug/requests", self.handle_debug_requests)
         app.router.add_get("/debug/traces/{trace_id}", self.handle_debug_trace)
         app.router.add_get("/debug/admission", self.handle_debug_admission)
+        app.router.add_get("/debug/slo", self.handle_debug_slo)
         return app
 
     async def start(self) -> "HttpService":
@@ -330,7 +342,11 @@ class HttpService:
         spans = rec.spans(trace_id)
         if not spans:
             return web.json_response({"error": f"unknown trace {trace_id}"}, status=404)
-        return web.json_response(tracing.chrome_trace(trace_id, spans))
+        body = tracing.chrome_trace(trace_id, spans)
+        # Raw span dicts ride along for the fleet supervisor's stitcher
+        # (fleet/aggregate.merge_traces) — lossless vs. the Chrome events.
+        body["spans"] = [s.to_dict() for s in spans]
+        return web.json_response(body)
 
     async def handle_debug_admission(self, request: web.Request) -> web.Response:
         """Per-class admission-gate state: queued/inflight, load-scaled
@@ -344,6 +360,16 @@ class HttpService:
                 "drain_interval_s": round(self.admission.drain_interval_s, 4),
                 "profiled": pred.prefill is not None,
             }
+        return web.json_response(body)
+
+    async def handle_debug_slo(self, request: web.Request) -> web.Response:
+        """SLO burn-rate state: per-class/per-phase burn EMAs, attainment
+        EMAs, and an attribution summary over the recent ledger window —
+        the same schema bench.py and the diurnal simulator emit."""
+        body = self.slo_burn.snapshot()
+        rec = tracing.recorder()
+        if rec is not None:
+            body["attribution"] = attribution_summary(rec.ledger(limit=200))
         return web.json_response(body)
 
     # -- inference surface -------------------------------------------------
@@ -425,6 +451,8 @@ class HttpService:
         the inbound ``traceparent`` when present, else a fresh trace), and
         emits the lifecycle ledger record on every exit path."""
         endpoint = self._ENDPOINT_LABEL[kind]
+        if self.proc_label:
+            tracing.set_lane(self.proc_label)
         inbound = None
         tp = request.headers.get("traceparent")
         if tp:
@@ -458,6 +486,16 @@ class HttpService:
         rec = tracing.recorder()
         if rec is None:
             return
+        # SLO budgets for the burn-rate derivation: the admitted class's
+        # policy targets (absent without a QoS policy — the record then
+        # carries an empty slo block and the tracker skips it).
+        ttft_slo = itl_slo = None
+        pol = self.admission.qos
+        if pol is not None:
+            qc = pol.classes.get(info.get("qos") or pol.default)
+            if qc is not None:
+                ttft_slo = qc.ttft_slo_s or None
+                itl_slo = qc.itl_slo_s or None
         record = tracing.build_ledger(
             root.trace_id,
             # Scope to THIS request's span subtree: one client trace id may
@@ -472,8 +510,13 @@ class HttpService:
             completion_tokens=info.get("completion_tokens", 0),
             ttft_s=info.get("ttft_s"),
             itl_s=info.get("itl_s"),
+            qos=info.get("qos"),
+            tenant=info.get("tenant"),
+            ttft_slo_s=ttft_slo,
+            itl_slo_s=itl_slo,
         )
         rec.record_ledger(record)
+        self.slo_burn.observe(record)
         ledger_log.info(
             "request %s %s %s in %.3fs", record["request_id"] or record["trace_id"],
             record["model"], record["status"], record["duration_s"],
@@ -522,6 +565,7 @@ class HttpService:
             raise
         else:
             adm_span.end()
+            info["qos"] = qos_charge
         finally:
             self.m_admission_wait.observe(time.perf_counter() - t_adm)
             self._set_queue_gauges()
@@ -540,6 +584,7 @@ class HttpService:
                 req.tenant = hdr_tenant
             model = req.model
             info["model"] = model
+            info["tenant"] = req.tenant
             if req.tenant is not None and root.recording:
                 root.set_attrs(tenant=req.tenant, qos=qos_charge)
             pipe = self.manager.get(req.model)

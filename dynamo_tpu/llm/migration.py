@@ -88,86 +88,109 @@ class Migration(Operator):
         delivered: list[int] = []  # every token the CLIENT saw, all legs
         finished = False
         request = orig
-        while True:
-            stream = self.inner.generate(request, context.child())
-            marker: dict | None = None
-            try:
-                async for raw in stream:
-                    if (
-                        isinstance(raw, dict)
-                        and raw.get("migration") is not None
-                        and not raw.get("finish_reason")
-                    ):
-                        # Live-migration handoff frame: the stream resumes
-                        # elsewhere. Consumed here — the client never sees it.
-                        marker = raw["migration"]
+        # Client-visible inter-leg gap span (the ledger's migration_freeze
+        # / redispatch phases): opened when a leg ends in a handoff marker
+        # or a truncation, closed by the NEXT leg's first frame — so its
+        # duration is exactly how long the client's stream sat silent.
+        gap_span = tracing.NOOP_SPAN
+        try:
+            while True:
+                stream = self.inner.generate(request, context.child())
+                marker: dict | None = None
+                try:
+                    async for raw in stream:
+                        if (
+                            isinstance(raw, dict)
+                            and raw.get("migration") is not None
+                            and not raw.get("finish_reason")
+                        ):
+                            # Live-migration handoff frame: the stream resumes
+                            # elsewhere. Consumed here — the client never sees
+                            # it. The freeze gap starts NOW (the source posts
+                            # the marker as its very last act).
+                            marker = raw["migration"]
+                            gap_span.end()
+                            gap_span = tracing.start_span_if(
+                                context.trace, "migration.resume",
+                                dest=str(marker.get("dest_instance")),
+                                carried_tokens=len(delivered),
+                            )
+                            continue
+                        if isinstance(raw, dict) and (
+                            raw.get("token_ids") or raw.get("finish_reason")
+                        ):
+                            # First frame of a resumed/re-dispatched leg
+                            # closes the gap interval.
+                            gap_span.end()
+                            gap_span = tracing.NOOP_SPAN
+                        if isinstance(raw, dict) and raw.get("token_ids"):
+                            delivered.extend(raw["token_ids"])
+                        if isinstance(raw, dict) and raw.get("finish_reason"):
+                            finished = True
+                        yield raw
+                    if marker is not None and not finished:
+                        if orig_max is not None and len(delivered) >= orig_max:
+                            # Handoff raced the budget edge: nothing left to
+                            # generate — complete locally instead of resuming.
+                            self._count("budget_exhausted")
+                            yield {"token_ids": [], "finish_reason": "length"}
+                            return
+                        migrated_to = marker.get("dest_instance")
+                        log.info(
+                            "live handoff for %s → instance %s (%d tokens carried)",
+                            context.id, migrated_to, len(delivered),
+                        )
+                        request = self._resume_request(orig, marker, orig_prompt,
+                                                       orig_stop, delivered)
+                        self._count("resume")
                         continue
-                    if isinstance(raw, dict) and raw.get("token_ids"):
-                        delivered.extend(raw["token_ids"])
-                    if isinstance(raw, dict) and raw.get("finish_reason"):
-                        finished = True
-                    yield raw
-                if marker is not None and not finished:
+                    return
+                except TruncatedStreamError:
+                    if finished:
+                        # The worker died between the last payload (which carried
+                        # a finish_reason) and the final bookkeeping frame: the
+                        # generation is semantically complete. Re-dispatching
+                        # would append tokens past the client's budget.
+                        return
                     if orig_max is not None and len(delivered) >= orig_max:
-                        # Handoff raced the budget edge: nothing left to
-                        # generate — complete locally instead of resuming.
+                        # The leg delivered its entire budget, then died before
+                        # the finish frame. Exactly-once accounting: synthesize
+                        # the finish instead of re-dispatching — a retry leg
+                        # would emit (and the ledger would bill) extra tokens.
                         self._count("budget_exhausted")
                         yield {"token_ids": [], "finish_reason": "length"}
                         return
-                    migrated_to = marker.get("dest_instance")
-                    tracing.start_span_if(
-                        context.trace, "migration.resume",
-                        dest=str(migrated_to), carried_tokens=len(delivered),
-                    ).end()
-                    log.info(
-                        "live handoff for %s → instance %s (%d tokens carried)",
-                        context.id, migrated_to, len(delivered),
+                    if migrations >= self.migration_limit or context.cancelled:
+                        raise
+                    # A request that can't finish shouldn't migrate: re-dispatch
+                    # means re-prefilling prompt+carried tokens on a new worker,
+                    # pure waste if the deadline already passed (and the typed
+                    # deadline error beats a truncation error for the client).
+                    context.check_deadline()
+                    migrations += 1
+                    # Gap span: truncation detected → retry leg's first frame.
+                    # Attrs carry the re-dispatch arithmetic for the timeline;
+                    # the ledger bills the duration as the redispatch phase.
+                    gap_span.end()
+                    gap_span = tracing.start_span_if(
+                        context.trace, "migration.redispatch",
+                        migration=migrations, limit=self.migration_limit,
+                        carried_tokens=len(delivered),
                     )
-                    request = self._resume_request(orig, marker, orig_prompt,
-                                                   orig_stop, delivered)
-                    self._count("resume")
+                    log.warning(
+                        "stream died mid-flight for %s; migrating (%d/%d, %d tokens carried)",
+                        context.id, migrations, self.migration_limit, len(delivered),
+                    )
+                    request = self._redispatch_request(orig, orig_prompt, orig_stop,
+                                                       delivered)
+                    self._count("redispatch")
                     continue
-                return
-            except TruncatedStreamError:
-                if finished:
-                    # The worker died between the last payload (which carried
-                    # a finish_reason) and the final bookkeeping frame: the
-                    # generation is semantically complete. Re-dispatching
-                    # would append tokens past the client's budget.
-                    return
-                if orig_max is not None and len(delivered) >= orig_max:
-                    # The leg delivered its entire budget, then died before
-                    # the finish frame. Exactly-once accounting: synthesize
-                    # the finish instead of re-dispatching — a retry leg
-                    # would emit (and the ledger would bill) extra tokens.
-                    self._count("budget_exhausted")
-                    yield {"token_ids": [], "finish_reason": "length"}
-                    return
-                if migrations >= self.migration_limit or context.cancelled:
-                    raise
-                # A request that can't finish shouldn't migrate: re-dispatch
-                # means re-prefilling prompt+carried tokens on a new worker,
-                # pure waste if the deadline already passed (and the typed
-                # deadline error beats a truncation error for the client).
-                context.check_deadline()
-                migrations += 1
-                # Marker span: the ledger counts these; attrs carry the
-                # re-dispatch arithmetic for the flame timeline.
-                tracing.start_span_if(
-                    context.trace, "migration.redispatch",
-                    migration=migrations, limit=self.migration_limit,
-                    carried_tokens=len(delivered),
-                ).end()
-                log.warning(
-                    "stream died mid-flight for %s; migrating (%d/%d, %d tokens carried)",
-                    context.id, migrations, self.migration_limit, len(delivered),
-                )
-                request = self._redispatch_request(orig, orig_prompt, orig_stop,
-                                                   delivered)
-                self._count("redispatch")
-                continue
-            finally:
-                await stream.aclose()
+                finally:
+                    await stream.aclose()
+        finally:
+            # A gap that never saw its next leg (error, cancellation) still
+            # records — truncated at teardown rather than lost.
+            gap_span.end(status=None if finished else "cancelled")
 
     # -- next-leg request builders ------------------------------------------
     #

@@ -115,29 +115,46 @@ class RuntimeActuator:
         it and the loop re-plans from live state. (Admin commands are
         idempotent: set_role to the current role and retire-again are
         both no-ops.)"""
+        from dynamo_tpu.runtime import tracing
         from dynamo_tpu.runtime.engine import Context
 
-        last_err: Exception | None = None
-        for i in range(attempts):
-            last: dict = {}
-            try:
-                async for frame in self.admin_router.generate(
-                    dict(payload), Context(), instance_id=instance_id
-                ):
-                    if isinstance(frame, dict):
-                        last = frame
-            except Exception as e:  # noqa: BLE001 — transport-level failure: retry the idempotent command, typed error after the budget
-                last_err = e
-                await asyncio.sleep(0.1 * min(i + 1, 5))
-                continue
-            if last.get("error"):
-                raise ScaleActionError(
-                    f"admin rpc {payload.get('cmd')} to {instance_id:x}: {last['error']}"
-                )
-            return last
-        raise ScaleActionError(
-            f"admin rpc {payload.get('cmd')} to {instance_id:x} failed: {last_err}"
-        ) from last_err
+        # Planner action span: one root per actuation verb, its trace
+        # threaded through the admin RPC so the worker-side effects
+        # (role change, migrate_out fan-out) stitch under it in the
+        # fleet-assembled timeline.
+        span = tracing.start_span(
+            "planner.action",
+            cmd=str(payload.get("cmd")), instance=f"{instance_id:x}",
+        )
+        trace = span.trace_context() if span.recording else None
+        try:
+            last_err: Exception | None = None
+            for i in range(attempts):
+                last: dict = {}
+                try:
+                    async for frame in self.admin_router.generate(
+                        dict(payload), Context(trace=trace), instance_id=instance_id
+                    ):
+                        if isinstance(frame, dict):
+                            last = frame
+                except Exception as e:  # noqa: BLE001 — transport-level failure: retry the idempotent command, typed error after the budget
+                    last_err = e
+                    await asyncio.sleep(0.1 * min(i + 1, 5))
+                    continue
+                if last.get("error"):
+                    span.end(status="error")
+                    raise ScaleActionError(
+                        f"admin rpc {payload.get('cmd')} to {instance_id:x}: {last['error']}"
+                    )
+                span.set_attrs(attempts=i + 1)
+                span.end()
+                return last
+            span.end(status="error")
+            raise ScaleActionError(
+                f"admin rpc {payload.get('cmd')} to {instance_id:x} failed: {last_err}"
+            ) from last_err
+        finally:
+            span.end()
 
     def _pick(self, pools: dict, role: str) -> WorkerInfo:
         candidates = pools.get(role, [])

@@ -128,6 +128,10 @@ class AdmissionController:
         # callable(cls, predicted_seconds) | None — metrics hook the HTTP
         # layer installs (admission_predicted_ttft_seconds).
         self.predict_observer = None
+        # SloBurnTracker | None — the SLO attribution plane's read seam
+        # (runtime/slo.py, installed by the HTTP layer): when a class's
+        # TTFT attainment EMA has slipped, early rejection tightens.
+        self.burn = None
         self._inflight = 0
         self._inflight_by: collections.Counter = collections.Counter()
         self._class_caps: dict[str, int] | None = None
@@ -323,12 +327,26 @@ class AdmissionController:
     def _shed(self, cls: str, reason: str) -> None:
         self.shed_counts[(cls, reason)] += 1
 
+    # Burn-aware tightening: attainment EMA below this target shrinks the
+    # effective SLO budget proportionally (floored so a bad spell can't
+    # collapse the gate to rejecting everything).
+    BURN_TIGHTEN_BELOW = 0.9
+    BURN_MIN_SLO_SCALE = 0.25
+
     def _maybe_early_reject(self, cls: str) -> None:
         if self.predictor is None or self.qos is None:
             return
         slo = self.qos.ttft_slo(cls)
         if slo <= 0:
             return
+        # SLO attribution read seam: if this class is already missing its
+        # TTFT target (attainment EMA from the ledger-fed burn tracker),
+        # compare the prediction against a shrunken budget — admitting
+        # more borderline work while budget is burning only digs deeper.
+        if self.burn is not None:
+            att = self.burn.attainment(cls, "ttft")
+            if att is not None and att < self.BURN_TIGHTEN_BELOW:
+                slo *= max(att / self.BURN_TIGHTEN_BELOW, self.BURN_MIN_SLO_SCALE)
         pred = self.predictor.predict(self._queued_ahead(cls), self._release_iv_ema)
         if pred is None:
             return

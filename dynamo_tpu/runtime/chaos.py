@@ -24,6 +24,7 @@ replays bit-identically from its seed.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import dataclasses
 import random
 from dataclasses import dataclass
@@ -90,6 +91,18 @@ class ChaosInjector:
     def _count(self, kind: str) -> None:
         if self._m_injections is not None:
             self._m_injections.inc(kind=kind)
+        # Stamp the victim request's trace: when a fault fires inside a
+        # traced request, the injection kind lands in that request's SLO
+        # ledger record (``chaos_injections``), so attribution can tell
+        # "slow because chaos froze it" from "slow, cause unknown".
+        # suppress broadly: observability must never alter a chaos scenario
+        with contextlib.suppress(Exception):
+            from dynamo_tpu.runtime import tracing
+            from dynamo_tpu.runtime.logging import current_trace
+
+            trace = current_trace()
+            if trace is not None and tracing.enabled():
+                tracing.recorder().note_injection(trace.trace_id, kind)
 
     @classmethod
     def from_config(cls, cfg: ChaosConfig) -> "ChaosInjector | None":

@@ -152,10 +152,21 @@ class DistributedRuntime:
     """One per process. Owns: store client + primary lease, the endpoint
     server (lazy), the message client, discovery clients, metrics."""
 
-    def __init__(self, store: KeyValueStore, config: Config, advertise_host: str | None = None):
+    def __init__(
+        self,
+        store: KeyValueStore,
+        config: Config,
+        advertise_host: str | None = None,
+        proc_label: str | None = None,
+    ):
         init_logging()
         self.store = store
         self.config = config
+        # Trace-lane identity: which process/role lane this runtime's
+        # handler-side spans land in (defaults to the process lane; the
+        # endpoint server narrows it per request so in-process fleets
+        # render distinct lanes per runtime).
+        self.proc_label = proc_label or tracing.default_lane()
         self.metrics = MetricsRegistry()
         # Span durations land in this registry as phase histograms (the
         # recorder is process-global; the sink is removed on shutdown so
@@ -178,10 +189,11 @@ class DistributedRuntime:
         store_url: str | None = None,
         config: Config | None = None,
         advertise_host: str | None = None,
+        proc_label: str | None = None,
     ) -> "DistributedRuntime":
         config = config or Config.from_env()
         store = await connect_store(store_url or config.store.url, config.store.lease_ttl)
-        rt = cls(store, config, advertise_host)
+        rt = cls(store, config, advertise_host, proc_label)
         if config.system.enabled:
             # Per-process /health /live /metrics (reference: every process
             # runs the system server, http_server.rs:33-69).
@@ -227,6 +239,7 @@ class DistributedRuntime:
                 max_inflight=self.config.runtime.max_inflight,
                 chaos=chaos,
                 metrics=self.metrics,
+                lane=self.proc_label,
             ).start()
         return self._server
 

@@ -91,9 +91,14 @@ class EndpointServer:
         max_inflight: int = 0,
         chaos: ChaosInjector | None = None,
         metrics=None,
+        lane: str | None = None,
     ):
         self.host = host
         self.port = port
+        # Trace lane for handler execution: spans recorded while serving
+        # a request are stamped with this process/role label (None keeps
+        # the process default) — the fleet trace view's lane identity.
+        self.lane = lane
         self.advertise_host = advertise_host or ("127.0.0.1" if host in ("0.0.0.0", "") else host)
         # Worker-side admission gate: per-subject in-flight bound (0 = off).
         self.max_inflight = max_inflight
@@ -269,6 +274,9 @@ class EndpointServer:
         # Re-anchoring ctx.trace on the span nests every downstream span
         # (engine phases, further hops) and log line under this hop. No
         # inbound traceparent ⇒ untraced infra call ⇒ no span.
+        # Lane narrowing first, so wire.serve and everything the handler
+        # records lands in this server's process/role lane.
+        lane_token = tracing.set_lane(self.lane) if self.lane else None
         span = tracing.start_span_if(ctx.trace, "wire.serve", subject=subject)
         if span.recording:
             ctx.trace = span.trace_context()
@@ -336,6 +344,8 @@ class EndpointServer:
             span.set_attr("frames", n_frames)
             span.end(status="cancelled" if ctx.cancelled else None)
             reset_current_trace(token)
+            if lane_token is not None:
+                tracing.reset_lane(lane_token)
             self._subject_ctxs.get(subject, set()).discard(ctx)
             self._inflight[subject] -= 1
             if self._inflight[subject] == 0:

@@ -33,6 +33,7 @@ span-free so they never pollute the phase histograms.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import secrets
 import threading
@@ -54,9 +55,15 @@ __all__ = [
     "set_recorder",
     "build_ledger",
     "chrome_trace",
+    "chrome_trace_from_dicts",
     "install_metrics_sink",
     "remove_metrics_sink",
     "PHASE_SPANS",
+    "default_lane",
+    "set_default_lane",
+    "current_lane",
+    "set_lane",
+    "reset_lane",
 ]
 
 # Span-name → ledger phase key. The ledger sums durations of all spans
@@ -71,7 +78,53 @@ PHASE_SPANS = {
     "engine.decode": "decode",
     # Disagg data plane (llm/disagg.py): dispatch + streamed KV pull.
     "disagg.remote_prefill": "remote_prefill",
+    # Cross-process attribution phases (ledger schema v2): the streamed
+    # KV transfer window, the client-visible migration freeze gap
+    # (resume marker → first token of the next leg), and re-dispatch
+    # fallback legs.
+    "transfer.kv_pull": "transfer",
+    "migration.resume": "migration_freeze",
+    "migration.redispatch": "redispatch",
 }
+
+
+# -- process/lane identity ----------------------------------------------------
+#
+# Every span is stamped with the *lane* it was recorded in — the process
+# (or, for in-process fleets, the component standing in for a process)
+# that did the work. The fleet-stitched trace view renders one timeline
+# lane per distinct value. Default is per-process (DYNTPU_PROC_LANE or
+# proc-<pid>, overridden once by the CLI entry points); serving seams
+# (EndpointServer, HttpService) narrow it per-task via the contextvar so
+# in-process multi-runtime tests get distinct lanes too.
+
+_default_lane: str = os.environ.get("DYNTPU_PROC_LANE") or f"proc-{os.getpid()}"
+_lane_var: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "dyntpu_lane", default=None
+)
+
+
+def default_lane() -> str:
+    return _default_lane
+
+
+def set_default_lane(label: str) -> None:
+    """Set this process's lane label (CLI entry points, once at startup)."""
+    global _default_lane
+    _default_lane = label
+
+
+def current_lane() -> str:
+    return _lane_var.get() or _default_lane
+
+
+def set_lane(label: str):
+    """Narrow the lane for the current task. → token for :func:`reset_lane`."""
+    return _lane_var.set(label)
+
+
+def reset_lane(token) -> None:
+    _lane_var.reset(token)
 
 
 class Span:
@@ -82,7 +135,7 @@ class Span:
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "start_ts", "_t0",
         "duration_s", "attrs", "events", "status", "_recorder", "_ended",
-        "flags", "tracestate",
+        "flags", "tracestate", "proc",
     )
 
     recording = True
@@ -113,6 +166,9 @@ class Span:
         self.status = "ok"
         self._recorder = recorder
         self._ended = False
+        # Lane stamp: which process/role recorded this span. Stamped at
+        # creation (not end) so cross-thread end() keeps the creator's lane.
+        self.proc = current_lane()
 
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
@@ -163,6 +219,7 @@ class Span:
             "start_ts": self.start_ts,
             "duration_s": self.duration_s,
             "status": self.status,
+            "proc": self.proc,
             "attrs": dict(self.attrs),
             "events": [
                 {"name": n, "offset_s": off, **({"attrs": a} if a else {})}
@@ -182,6 +239,7 @@ class _NoopSpan:
     parent_id = None
     status = "ok"
     duration_s = None
+    proc = ""
 
     def set_attr(self, key, value) -> None:
         pass
@@ -216,6 +274,10 @@ class SpanRecorder:
     index never outlives the ring (no unbounded growth under trace-id
     cardinality)."""
 
+    # Chaos-note bounds: traces tracked × injections kept per trace.
+    CHAOS_TRACES = 256
+    CHAOS_PER_TRACE = 16
+
     def __init__(self, capacity: int = 4096, ledger_capacity: int = 1024):
         self.capacity = capacity
         self.ledger_capacity = ledger_capacity
@@ -225,6 +287,11 @@ class SpanRecorder:
         self._lock = threading.Lock()
         self._sinks: dict[int, Callable[[Span], None]] = {}
         self._next_sink = 0
+        # trace_id → chaos injection kinds absorbed by that request
+        # (ChaosInjector stamps the victim's current trace; the ledger
+        # attaches them so a chaos-killed record names its injection).
+        self._chaos: dict[str, list[str]] = {}
+        self._chaos_order: deque[str] = deque()
 
     # -- spans --------------------------------------------------------------
 
@@ -270,6 +337,27 @@ class SpanRecorder:
                 return list(self._by_trace.get(trace_id, ()))
             return list(self._spans)
 
+    # -- chaos notes --------------------------------------------------------
+
+    def note_injection(self, trace_id: str, kind: str) -> None:
+        """Stamp a chaos injection against the victim request's trace.
+        Bounded both ways (traces tracked, kinds per trace); FIFO eviction."""
+        if not trace_id:
+            return
+        with self._lock:
+            bucket = self._chaos.get(trace_id)
+            if bucket is None:
+                bucket = self._chaos[trace_id] = []
+                self._chaos_order.append(trace_id)
+                while len(self._chaos_order) > self.CHAOS_TRACES:
+                    self._chaos.pop(self._chaos_order.popleft(), None)
+            if len(bucket) < self.CHAOS_PER_TRACE:
+                bucket.append(kind)
+
+    def injections(self, trace_id: str) -> list[str]:
+        with self._lock:
+            return list(self._chaos.get(trace_id, ()))
+
     # -- ledger -------------------------------------------------------------
 
     def record_ledger(self, record: dict) -> None:
@@ -305,6 +393,8 @@ class SpanRecorder:
             self._spans.clear()
             self._by_trace.clear()
             self._ledger.clear()
+            self._chaos.clear()
+            self._chaos_order.clear()
 
 
 # -- process-global recorder --------------------------------------------------
@@ -424,10 +514,19 @@ def build_ledger(
     itl_s: float | None = None,
     spans: Iterable[Span] | None = None,
     root_span_id: str | None = None,
+    qos: str | None = None,
+    tenant: str | None = None,
+    ttft_slo_s: float | None = None,
+    itl_slo_s: float | None = None,
 ) -> dict:
     """One lifecycle record for a finished request, derived from the
     recorder's spans for its trace. Phase durations are sums over the spans
     named in :data:`PHASE_SPANS`; retries/migrations are span counts.
+
+    Schema v2 adds cross-process phases (transfer, migration_freeze,
+    redispatch), QoS identity (``qos``/``tenant``), per-budget SLO burn
+    ratios (``slo.ttft_burn = ttft_s / ttft_slo_s``), and the chaos
+    injections the request absorbed (``chaos_injections``).
 
     ``root_span_id`` restricts the derivation to that span's subtree — a
     client may send several requests under ONE traceparent trace id
@@ -460,12 +559,26 @@ def build_ledger(
             attempts += 1
         elif span.name == "migration.redispatch":
             migrations += 1
+    slo: dict[str, Any] = {}
+    if ttft_slo_s is not None and ttft_slo_s > 0 and ttft_s is not None:
+        slo["ttft_slo_s"] = ttft_slo_s
+        slo["ttft_burn"] = round(ttft_s / ttft_slo_s, 6)
+        slo["ttft_attained"] = ttft_s <= ttft_slo_s
+    if itl_slo_s is not None and itl_slo_s > 0 and itl_s is not None:
+        slo["itl_slo_s"] = itl_slo_s
+        slo["itl_burn"] = round(itl_s / itl_slo_s, 6)
+        slo["itl_attained"] = itl_s <= itl_slo_s
+    rec = _recorder
+    chaos = rec.injections(trace_id) if rec is not None else []
     return {
+        "schema": 2,
         "trace_id": trace_id,
         "request_id": request_id,
         "model": model,
         "endpoint": endpoint,
         "status": status,
+        "qos": qos,
+        "tenant": tenant,
         "duration_s": round(duration_s, 6),
         "ttft_s": None if ttft_s is None else round(ttft_s, 6),
         "itl_s": None if itl_s is None else round(itl_s, 6),
@@ -474,6 +587,8 @@ def build_ledger(
         "retries": max(attempts - 1, 0),
         "migrations": migrations,
         "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "slo": slo,
+        "chaos_injections": chaos,
         "ts": time.time(),
     }
 
@@ -485,32 +600,66 @@ def chrome_trace(trace_id: str, spans: Iterable[Span] | None = None) -> dict:
     if spans is None:
         rec = _recorder
         spans = rec.spans(trace_id) if rec is not None else []
-    events = []
-    for span in sorted(spans, key=lambda s: s.start_ts):
+    return chrome_trace_from_dicts(trace_id, [s.to_dict() for s in spans])
+
+
+def chrome_trace_from_dicts(trace_id: str, span_dicts: Iterable[dict]) -> dict:
+    """Chrome-trace JSON from span *dicts* (``Span.to_dict`` shape). This is
+    the fleet-stitch entry point: spans scraped from several processes or
+    loaded from the store merge into ONE timeline, with a pid **lane** per
+    distinct ``proc`` label (named via "M" process_name metadata events).
+    Output is deterministic for a given span set — duplicate span_ids are
+    dropped and ordering is (start_ts, span_id) — so repeated assembly of
+    the same trace is byte-stable."""
+    seen: set[str] = set()
+    spans = []
+    for d in span_dicts:
+        sid = d.get("span_id", "")
+        if sid in seen:
+            continue
+        seen.add(sid)
+        spans.append(d)
+    spans.sort(key=lambda d: (d.get("start_ts") or 0.0, d.get("span_id", "")))
+    lanes = sorted({d.get("proc") or "proc" for d in spans})
+    pid_of = {lane: i + 1 for i, lane in enumerate(lanes)}
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid_of[lane],
+            "tid": 1,
+            "args": {"name": lane},
+        }
+        for lane in lanes
+    ]
+    for d in spans:
+        pid = pid_of[d.get("proc") or "proc"]
+        start_ts = d.get("start_ts") or 0.0
         events.append({
-            "name": span.name,
+            "name": d.get("name", ""),
             "cat": "serving",
             "ph": "X",
-            "ts": int(span.start_ts * 1e6),
-            "dur": int((span.duration_s or 0.0) * 1e6),
-            "pid": 1,
+            "ts": int(start_ts * 1e6),
+            "dur": int((d.get("duration_s") or 0.0) * 1e6),
+            "pid": pid,
             "tid": 1,
             "args": {
-                "span_id": span.span_id,
-                "parent_id": span.parent_id,
-                "status": span.status,
-                **span.attrs,
+                "span_id": d.get("span_id"),
+                "parent_id": d.get("parent_id"),
+                "status": d.get("status", "ok"),
+                "proc": d.get("proc") or "proc",
+                **(d.get("attrs") or {}),
             },
         })
-        for name, offset, attrs in span.events:
+        for ev in d.get("events") or []:
             events.append({
-                "name": f"{span.name}:{name}",
+                "name": f"{d.get('name', '')}:{ev.get('name', '')}",
                 "cat": "serving",
                 "ph": "i",
                 "s": "t",
-                "ts": int((span.start_ts + offset) * 1e6),
-                "pid": 1,
+                "ts": int((start_ts + (ev.get("offset_s") or 0.0)) * 1e6),
+                "pid": pid,
                 "tid": 1,
-                "args": dict(attrs),
+                "args": dict(ev.get("attrs") or {}),
             })
     return {"traceEvents": events, "displayTimeUnit": "ms", "otherData": {"trace_id": trace_id}}

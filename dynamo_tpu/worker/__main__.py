@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import contextlib
+import os
 import signal
 
 from dynamo_tpu.kv_router.publisher import KvEventBroadcaster, serve_kv_endpoints
@@ -377,7 +378,23 @@ async def build_engine(args, config=None):
 
 
 async def async_main(args) -> None:
-    rt = await DistributedRuntime.create(store_url=args.store_url)
+    from dynamo_tpu.runtime import tracing
+
+    # Trace-lane identity: role-named lane (DYNTPU_PROC_LANE wins) so the
+    # stitched fleet timeline shows "prefill-…"/"worker-…" rows, not PIDs
+    # of indistinct processes.
+    lane = os.environ.get("DYNTPU_PROC_LANE")
+    if not lane:
+        lane = f"{'prefill' if args.is_prefill_worker else 'worker'}-{os.getpid()}"
+        tracing.set_default_lane(lane)
+    rt = await DistributedRuntime.create(store_url=args.store_url, proc_label=lane)
+    trace_exporter = None
+    if tracing.enabled() and os.environ.get("DYNTPU_TRACE_EXPORT", "") not in ("", "0"):
+        from dynamo_tpu.runtime.trace_export import TraceExporter
+
+        trace_exporter = await TraceExporter(
+            rt.store, os.environ.get("DYNTPU_FLEET_ID", "default"), lane=lane
+        ).start()
     engine, card = await build_engine(args, config=rt.config)
     # Multi-LoRA: register every --lora adapter on the engine (paged
     # into the tier economy now; device slots fill on first request).
@@ -437,6 +454,9 @@ async def async_main(args) -> None:
                 await t
         log.info("worker shutting down")
         await manager.close()
+        if trace_exporter is not None:
+            with contextlib.suppress(Exception):
+                await trace_exporter.close()
         stop_fn = getattr(engine, "stop", None)
         if stop_fn is not None:
             await stop_fn()
@@ -474,6 +494,7 @@ async def async_main(args) -> None:
             WorkQueue(rt.store, dcfg.queue_name),
             rt.store,
             gen_handle.instance.instance_id,
+            lane=lane,
         ).start()
         # No model card: the frontend must route only to decode workers.
         role = "prefill worker"
@@ -568,6 +589,9 @@ async def async_main(args) -> None:
             loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     log.info("worker shutting down")
+    if trace_exporter is not None:
+        with contextlib.suppress(Exception):
+            await trace_exporter.close()
     stop_fn = getattr(engine, "stop", None)
     if stop_fn is not None:
         await stop_fn()

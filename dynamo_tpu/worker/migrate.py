@@ -44,8 +44,9 @@ import contextlib
 import time
 from typing import Any
 
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.engine import Context
-from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.logging import TraceContext, get_logger
 from dynamo_tpu.transfer.stream import (
     DEFAULT_CREDIT_BYTES,
     inject_payload_from_chunks,
@@ -138,10 +139,19 @@ class MigrationCoordinator:
         if self.metrics is not None:
             self.metrics["fallbacks"].inc(reason=reason)
 
-    def _chaos_victim(self, phase: str) -> str | None:
+    def _chaos_victim(self, phase: str, trace: TraceContext | None = None) -> str | None:
         if self.chaos is None:
             return None
-        return self.chaos.maybe_cut_migration(phase)
+        victim = self.chaos.maybe_cut_migration(phase)
+        if victim is not None and trace is not None:
+            # Bind the injection to the MIGRATING request's trace (the
+            # admin RPC's ambient trace is the planner's, not the
+            # victim's) so its ledger record carries the fault.
+            with contextlib.suppress(Exception):
+                tracing.recorder().note_injection(
+                    trace.trace_id, f"migration_cut:{phase}:{victim}"
+                )
+        return victim
 
     # -- the protocol -------------------------------------------------------
 
@@ -157,6 +167,8 @@ class MigrationCoordinator:
         if self.metrics is not None:
             self.metrics["inflight"].add(1)
         begun = False
+        trace: TraceContext | None = None
+        mspan = tracing.NOOP_SPAN
         try:
             # -- phase: streaming -------------------------------------------
             victim = self._chaos_victim("streaming")
@@ -170,16 +182,33 @@ class MigrationCoordinator:
                 return {"ok": False, "reason": res["error"]}
             begun = True
             handle = res["handle"]
-            await self._admin(dest_instance, {
+            # Join the CLIENT REQUEST's trace, not a coordinator-local
+            # root: the engine stamps every running sequence with its
+            # traceparent at submit and hands it back from
+            # migration_begin, so the source's admin RPCs and the
+            # destination's pull all stitch into the original tree.
+            if res.get("traceparent"):
+                with contextlib.suppress(Exception):
+                    trace = TraceContext.parse(str(res["traceparent"]))
+            mspan = tracing.start_span_if(
+                trace, "migration.out",
+                request_id=request_id, dest=str(dest_instance),
+            )
+            if mspan.recording:
+                trace = mspan.trace_context()
+            start_payload = {
                 "cmd": "migrate_in_start",
                 "handle": handle,
                 "source_component": self.component,
                 "source_instance": self.source_instance,
-            })
+            }
+            if trace is not None:
+                start_payload["traceparent"] = trace.traceparent()
+            await self._admin(dest_instance, start_payload, trace=trace)
             await self._await_caught_up(request_id)
 
             # -- phase: cutover ---------------------------------------------
-            victim = self._chaos_victim("cutover")
+            victim = self._chaos_victim("cutover", trace)
             if victim == "source":
                 raise MigrationError("chaos:cutover:source")
             cut = await eng.run_on_engine_thread(
@@ -191,6 +220,8 @@ class MigrationCoordinator:
                     # the client has its complete stream; nothing to move.
                     begun = False
                     self._outcome("noop")
+                    mspan.set_attrs(outcome="finished")
+                    mspan.end()
                     return {"ok": False, "reason": "finished"}
                 raise MigrationError(f"cutover:{cut['error']}")
             t_freeze = time.monotonic()
@@ -202,12 +233,12 @@ class MigrationCoordinator:
                 "cmd": "migrate_in_commit",
                 "handle": handle,
                 "kv_blocks": cut["kv_blocks"],
-            })
+            }, trace=trace)
             gap = time.monotonic() - t_freeze
 
             # -- phase: rebind ----------------------------------------------
             rebind = True
-            victim = self._chaos_victim("rebind")
+            victim = self._chaos_victim("rebind", trace)
             if victim == "source":
                 # Source dying here would truncate the client stream —
                 # the Migration operator's re-dispatch completes it. The
@@ -226,7 +257,7 @@ class MigrationCoordinator:
                 with contextlib.suppress(MigrationError):
                     await self._admin(dest_instance, {
                         "cmd": "migrate_in_abort", "handle": handle,
-                    })
+                    }, trace=trace)
             marker: dict[str, Any] = {
                 "handle": handle,
                 "dest_instance": dest_instance,
@@ -243,6 +274,9 @@ class MigrationCoordinator:
                 raise MigrationError(f"finish:{fin['error']}")
             if self.metrics is not None:
                 self.metrics["cutover_gap"].observe(gap)
+            mspan.set_attrs(outcome="ok", kv_blocks=cut["kv_blocks"],
+                            cutover_gap_ms=round(gap * 1e3, 3))
+            mspan.end()
             self._outcome("ok")
             log.info(
                 "migrated %s → %x (%d KV blocks, cutover gap %.1f ms)",
@@ -254,6 +288,8 @@ class MigrationCoordinator:
                     "cutover_gap_s": gap}
         except MigrationError as e:
             reason = str(e)
+            mspan.set_attrs(outcome="fallback", reason=reason)
+            mspan.end(status="error")
             if begun:
                 await eng.run_on_engine_thread(
                     lambda: eng.migration_abort(request_id, reason)
@@ -266,6 +302,7 @@ class MigrationCoordinator:
             )
             return {"ok": False, "reason": reason}
         finally:
+            mspan.end()  # idempotent — closes the span on surprise exits
             if self.metrics is not None:
                 self.metrics["inflight"].add(-1)
 
@@ -288,13 +325,15 @@ class MigrationCoordinator:
                 raise MigrationError("stream_lag")
             await asyncio.sleep(0.01)
 
-    async def _admin(self, instance_id: int, payload: dict) -> dict:
+    async def _admin(self, instance_id: int, payload: dict,
+                     trace: TraceContext | None = None) -> dict:
         """One admin RPC to the destination; transport faults and error
-        frames both become the typed MigrationError fallback."""
+        frames both become the typed MigrationError fallback. ``trace``
+        stitches the hop into the migrating request's span tree."""
         last: dict = {}
         try:
             async for frame in self.admin_router.generate(
-                dict(payload), Context(), instance_id=instance_id
+                dict(payload), Context(trace=trace), instance_id=instance_id
             ):
                 if isinstance(frame, dict):
                     last = frame
@@ -345,30 +384,51 @@ class MigrationReceiver:
         return router
 
     async def start_pull(self, handle: str, source_component: str,
-                         source_instance: int) -> dict:
+                         source_instance: int,
+                         traceparent: str | None = None) -> dict:
         """Begin pulling the migration stream in the background (the
         source is still decoding — this overlaps the transfer with the
-        remaining generation, the same push-on-ready shape as disagg)."""
+        remaining generation, the same push-on-ready shape as disagg).
+        ``traceparent`` joins the pull's spans to the migrating
+        request's trace (the coordinator forwards it from the source
+        engine's sequence stamp)."""
         self._reap()
         if handle in self._pulls or handle in self._staged:
             return {"ok": True}
         router = await self._fetch_router(source_component)
+        trace: TraceContext | None = None
+        if traceparent:
+            with contextlib.suppress(Exception):
+                trace = TraceContext.parse(str(traceparent))
 
         def window_call(cursor: int, credit: int, wait_s: float):
             return router.generate(
                 {"handle": handle, "stream": True, "cursor": cursor,
                  "credit_bytes": credit, "wait_s": wait_s},
-                Context(), instance_id=source_instance,
+                Context(trace=trace), instance_id=source_instance,
             )
 
-        self._pulls[handle] = asyncio.get_running_loop().create_task(
-            pull_kv_stream(
-                window_call,
-                credit_bytes=self.credit_bytes,
-                stall_timeout_s=self.stall_timeout_s,
-                window_wait_s=self.window_wait_s,
-            )
-        )
+        async def pull():
+            # The destination lane's transfer phase: same span name the
+            # disagg KV pull records, so the stitched timeline shows
+            # migration transfers with identical semantics.
+            span = tracing.start_span_if(trace, "transfer.kv_pull",
+                                         handle=handle, kind="migration")
+            try:
+                pulled = await pull_kv_stream(
+                    window_call,
+                    credit_bytes=self.credit_bytes,
+                    stall_timeout_s=self.stall_timeout_s,
+                    window_wait_s=self.window_wait_s,
+                )
+            except BaseException:
+                span.end(status="error")
+                raise
+            span.set_attrs(blocks=pulled.num_blocks, bytes=pulled.total_bytes)
+            span.end()
+            return pulled
+
+        self._pulls[handle] = asyncio.get_running_loop().create_task(pull())
         return {"ok": True}
 
     async def commit(self, handle: str, kv_blocks: int) -> dict:

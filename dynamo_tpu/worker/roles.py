@@ -449,6 +449,7 @@ class WorkerRoleManager:
                         payload.get("handle", ""),
                         payload.get("source_component", ""),
                         int(payload.get("source_instance") or 0),
+                        traceparent=payload.get("traceparent"),
                     )
             elif cmd == "migrate_in_commit":
                 if self.receiver is None:

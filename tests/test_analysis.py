@@ -518,6 +518,92 @@ def test_dt005_suppression(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# DT007 span/metric catalog
+# ---------------------------------------------------------------------------
+
+DT007_SRC = """
+    from dynamo_tpu.runtime import tracing
+
+    def serve(registry, parent):
+        span = tracing.start_span("wire.serve", subject="s")
+        gap = tracing.start_span_if(parent, "migration.resume", dest="w2")
+        tracing.record_interval("engine.queue", parent, start=0.0, end=1.0)
+        m = registry.counter("http_requests_total", "finished requests")
+        g = registry.gauge("slo_budget_burn_ratio", "burn EMA")
+        dynamic = tracing.start_span(f"span.{span}")   # non-literal: skipped
+        return span, gap, m, g, dynamic
+"""
+
+DT007_DOC = """
+    # Observability
+
+    Spans: `wire.serve`, `migration.resume`, `engine.queue`.
+    Metrics: `http_requests_total`, `slo_budget_burn_ratio{class,phase}`.
+"""
+
+
+def test_dt007_documented_names_pass(tmp_path):
+    r = run_on(tmp_path, {
+        "dynamo_tpu/runtime/x.py": DT007_SRC,
+        "docs/observability.md": DT007_DOC,
+    }, checks=["DT007"])
+    assert codes(r) == []
+
+
+def test_dt007_undocumented_span_and_metric_flagged(tmp_path):
+    doc = DT007_DOC.replace("`migration.resume`, ", "").replace(
+        "`slo_budget_burn_ratio{class,phase}`", "`other_metric`")
+    r = run_on(tmp_path, {
+        "dynamo_tpu/runtime/x.py": DT007_SRC,
+        "docs/observability.md": doc,
+    }, checks=["DT007"])
+    assert codes(r) == ["DT007"] * 2
+    msgs = " | ".join(f.message for f in r.findings)
+    assert "migration.resume" in msgs and "slo_budget_burn_ratio" in msgs
+
+
+def test_dt007_missing_catalog_is_one_finding(tmp_path):
+    r = run_on(tmp_path, {"dynamo_tpu/runtime/x.py": DT007_SRC},
+               checks=["DT007"])
+    assert codes(r) == ["DT007"]
+    assert "catalog missing" in r.findings[0].message
+
+
+def test_dt007_scope_excludes_tests_and_tools(tmp_path):
+    r = run_on(tmp_path, {
+        "tests/test_x.py": DT007_SRC,
+        "tools/probe.py": DT007_SRC,
+        "docs/observability.md": "# empty catalog\n",
+    }, checks=["DT007"])
+    assert codes(r) == []
+
+
+def test_dt007_suppression_requires_reason(tmp_path):
+    ok = DT007_SRC.replace(
+        '        gap = tracing.start_span_if(parent, "migration.resume", dest="w2")\n',
+        '        gap = tracing.start_span_if(parent, "migration.resume", dest="w2")'
+        "  # dyntpu: allow[DT007] reason=experimental span pending catalog entry\n",
+    )
+    doc = DT007_DOC.replace("`migration.resume`, ", "")
+    r = run_on(tmp_path, {
+        "dynamo_tpu/runtime/x.py": ok,
+        "docs/observability.md": doc,
+    }, checks=["DT007"])
+    assert codes(r) == [] and len(r.suppressed) == 1
+    # Without a reason the finding stands AND the allow itself is DT000.
+    bad = DT007_SRC.replace(
+        '        gap = tracing.start_span_if(parent, "migration.resume", dest="w2")\n',
+        '        gap = tracing.start_span_if(parent, "migration.resume", dest="w2")'
+        "  # dyntpu: allow[DT007]\n",
+    )
+    r2 = run_on(tmp_path / "b", {
+        "dynamo_tpu/runtime/x.py": bad,
+        "docs/observability.md": doc,
+    }, checks=["DT007"])
+    assert sorted(codes(r2)) == ["DT000", "DT007"]
+
+
+# ---------------------------------------------------------------------------
 # Framework: suppressions, baseline, reporters, CLI surface
 # ---------------------------------------------------------------------------
 
@@ -651,9 +737,12 @@ def test_unknown_check_raises():
 
 def test_all_checkers_registered():
     checkers = core.all_checkers()
-    assert set(checkers) >= {"DT001", "DT002", "DT003", "DT004", "DT005", "DT006"}
+    assert set(checkers) >= {"DT001", "DT002", "DT003", "DT004", "DT005", "DT006", "DT007"}
     assert checkers["DT006"].dynamic
-    assert not any(checkers[c].dynamic for c in ("DT001", "DT002", "DT003", "DT004", "DT005"))
+    assert not any(
+        checkers[c].dynamic
+        for c in ("DT001", "DT002", "DT003", "DT004", "DT005", "DT007")
+    )
 
 
 def test_repo_self_run_is_clean():

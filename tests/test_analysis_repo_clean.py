@@ -33,7 +33,9 @@ def test_analysis_repo_is_clean_and_fast():
     data = json.loads(proc.stdout)
     assert data["findings"] == [], data["findings"]
     # The static checkers all ran (DT006 is dynamic and excluded by default).
-    assert set(data["checks_run"]) == {"DT001", "DT002", "DT003", "DT004", "DT005"}
+    assert set(data["checks_run"]) == {
+        "DT001", "DT002", "DT003", "DT004", "DT005", "DT007",
+    }
     assert data["files_scanned"] > 100  # the sweep actually walked the repo
     # Every suppression in the tree carries a reason (DT000 would be a
     # finding) — and the repo stays CLEAN, not grandfathered: baseline empty.

@@ -7,4 +7,5 @@ from tools.analysis.checkers import (  # noqa: F401
     dt004_test_rng,
     dt005_typed_errors,
     dt006_metrics_catalog,
+    dt007_span_catalog,
 )

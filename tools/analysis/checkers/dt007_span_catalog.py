@@ -1,0 +1,146 @@
+"""DT007 — span/metric catalog guard (static).
+
+Every literal span name handed to ``start_span`` / ``start_span_if`` /
+``record_interval`` and every metric family name registered via
+``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)`` inside
+``dynamo_tpu/`` must appear as a backticked token in the observability
+catalog (``docs/observability.md``). The fleet-stitched trace view and
+the SLO attribution plane are only debuggable if the taxonomy the code
+emits and the taxonomy the docs promise are the SAME set — an
+undocumented span is a lane nobody can interpret, an undocumented
+metric is a dashboard query nobody can write.
+
+Mechanics (pure AST + one doc read, no imports):
+
+- Span sites: calls whose final attribute/name is ``start_span`` (name
+  at position 0), ``start_span_if`` (position 1 — the parent rides
+  first), or ``record_interval`` (position 0); ``name=`` keyword also
+  accepted. Non-literal names (f-strings, variables) are skipped — the
+  checker is a catalog tripwire, not a constant propagator.
+- Metric sites: attribute calls ``*.counter/gauge/histogram`` whose
+  first argument is a string literal.
+- Catalog: every `token` in docs/observability.md; a documented
+  ``name{label,...}`` form also catalogs its bare family name.
+
+Like every dyntpu-analyze invariant, exceptions require a scoped
+``# dyntpu: allow[DT007] reason=...`` — a reasonless allow is DT000.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable
+
+from tools.analysis.core import Checker, Finding, SourceModule, register
+
+DOC_PATH = "docs/observability.md"
+# call name -> positional index of the span-name argument
+SPAN_CALLS = {"start_span": 0, "start_span_if": 1, "record_interval": 0}
+METRIC_CALLS = {"counter", "gauge", "histogram"}
+BACKTICK_RE = re.compile(r"`([^`\s]+)`")
+
+
+def _call_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _literal_arg(call: ast.Call, pos: int, kw: str = "name") -> str | None:
+    for k in call.keywords:
+        if k.arg == kw and isinstance(k.value, ast.Constant) \
+                and isinstance(k.value.value, str):
+            return k.value.value
+    if pos < len(call.args):
+        a = call.args[pos]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def load_catalog(doc_text: str) -> set[str]:
+    """Backticked tokens; `family{labels}` also yields `family`."""
+    tokens: set[str] = set()
+    for tok in BACKTICK_RE.findall(doc_text):
+        tokens.add(tok)
+        if "{" in tok:
+            tokens.add(tok.split("{", 1)[0])
+    return tokens
+
+
+def _repo_root(modules: list[SourceModule]) -> str | None:
+    for m in modules:
+        rel = m.path.replace("/", os.sep)
+        if m.abspath.endswith(rel):
+            return m.abspath[: len(m.abspath) - len(rel)]
+    return None
+
+
+@register
+class SpanCatalogChecker(Checker):
+    code = "DT007"
+    name = "span-catalog"
+    description = (
+        "every literal span name (start_span/start_span_if/"
+        "record_interval) and metric family (.counter/.gauge/.histogram) "
+        "appears in the docs/observability.md catalog"
+    )
+    scope = ("dynamo_tpu",)
+
+    def run_repo(self, modules) -> Iterable[Finding]:
+        swept = [m for m in modules
+                 if m.tree is not None and self.applies(m)]
+        if not swept:
+            return
+        root = _repo_root(modules)
+        doc = os.path.join(root, DOC_PATH) if root else None
+        if doc is None or not os.path.exists(doc):
+            yield Finding(
+                check=self.code, path=DOC_PATH, line=1,
+                message=(
+                    "observability catalog missing — span/metric names "
+                    "have nowhere to be documented"
+                ),
+            )
+            return
+        with open(doc, encoding="utf-8") as f:
+            catalog = load_catalog(f.read())
+        for module in swept:
+            yield from self._check_module(module, catalog)
+
+    def _check_module(
+        self, module: SourceModule, catalog: set[str]
+    ) -> Iterable[Finding]:
+        assert module.tree is not None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _call_name(node.func)
+            if fn in SPAN_CALLS:
+                name = _literal_arg(node, SPAN_CALLS[fn])
+                if name is not None and name not in catalog:
+                    yield self._finding(
+                        module, node.lineno,
+                        f"span name '{name}' ({fn}) is not in the "
+                        f"{DOC_PATH} catalog — document it (backticked) "
+                        "or rename to a cataloged span",
+                    )
+            elif fn in METRIC_CALLS and isinstance(node.func, ast.Attribute):
+                name = _literal_arg(node, 0, kw="name")
+                if name is not None and name not in catalog:
+                    yield self._finding(
+                        module, node.lineno,
+                        f"metric family '{name}' ({fn}) is not in the "
+                        f"{DOC_PATH} catalog — document it (backticked) "
+                        "or rename to a cataloged family",
+                    )
+
+    def _finding(self, module: SourceModule, line: int, message: str) -> Finding:
+        return Finding(
+            check=self.code, path=module.path, line=line,
+            message=message, snippet=module.line_text(line),
+        )

@@ -8,10 +8,18 @@ file, stdin, or fetched live with ``--base/--trace``.
     curl -s localhost:8080/debug/traces/<id> | python tools/trace_report.py -
     python tools/trace_report.py --base http://localhost:8080 --trace <id>
     python tools/trace_report.py --base http://localhost:8080 --latest
+    python tools/trace_report.py --fleet --base http://localhost:9090 --trace <id>
 
 Output: one line per span, indented by parent lineage, with offset from
 the trace start, duration, a proportional bar, status, and key attrs —
 a slow request's hop-by-hop timeline at a glance.
+
+``--fleet`` consumes the supervisor's stitched body
+(``GET /debug/fleet/traces/{trace_id}``, see docs/observability.md) and
+renders ONE timeline with a lane per process: every lane's bars share
+the same time axis, so cross-process causality (frontend admission →
+remote prefill → KV pull → decode → migration freeze → resumed decode)
+reads top to bottom.
 """
 
 from __future__ import annotations
@@ -40,6 +48,8 @@ def load(args) -> dict:
             args.trace = records[0]["trace_id"]
         if not args.trace:
             sys.exit("--base requires --trace <id> or --latest")
+        if args.fleet:
+            return fetch(f"{args.base}/debug/fleet/traces/{args.trace}")
         return fetch(f"{args.base}/debug/traces/{args.trace}")
     if args.input == "-":
         return json.load(sys.stdin)
@@ -65,17 +75,8 @@ def build_tree(events: list[dict]):
     return roots, children
 
 
-def render(trace: dict, out=sys.stdout) -> None:
-    events = trace.get("traceEvents", [])
-    roots, children = build_tree(events)
-    if not roots:
-        print("no spans in trace", file=out)
-        return
-    t0 = min(e["ts"] for e in roots)
-    t_end = max(e["ts"] + e.get("dur", 0) for e in events if e.get("ph") == "X")
-    total = max(t_end - t0, 1)
-    trace_id = trace.get("otherData", {}).get("trace_id", "?")
-    print(f"trace {trace_id}  total {total / 1000:.2f} ms", file=out)
+def _walk_spans(roots, children, t0: float, total: float, out) -> None:
+    """Print one span tree against a shared [t0, t0+total] time axis."""
 
     def bar(e) -> str:
         lead = int(BAR_WIDTH * (e["ts"] - t0) / total)
@@ -83,7 +84,10 @@ def render(trace: dict, out=sys.stdout) -> None:
         return " " * lead + "#" * min(width, BAR_WIDTH - lead)
 
     def attrs_str(e) -> str:
-        pairs = [f"{k}={v}" for k, v in e["args"].items() if k not in SKIP_ATTRS]
+        pairs = [
+            f"{k}={v}" for k, v in e["args"].items()
+            if k not in SKIP_ATTRS and k != "proc"
+        ]
         status = e["args"].get("status", "ok")
         if status != "ok":
             pairs.insert(0, f"status={status}")
@@ -103,11 +107,69 @@ def render(trace: dict, out=sys.stdout) -> None:
 
     for root in roots:
         walk(root, 0)
+
+
+def render(trace: dict, out=sys.stdout) -> None:
+    events = trace.get("traceEvents", [])
+    roots, children = build_tree(events)
+    if not roots:
+        print("no spans in trace", file=out)
+        return
+    t0 = min(e["ts"] for e in roots)
+    t_end = max(e["ts"] + e.get("dur", 0) for e in events if e.get("ph") == "X")
+    total = max(t_end - t0, 1)
+    trace_id = trace.get("otherData", {}).get("trace_id", "?")
+    print(f"trace {trace_id}  total {total / 1000:.2f} ms", file=out)
+    _walk_spans(roots, children, t0, total, out)
     instants = [e for e in events if e.get("ph") == "i"]
     if instants:
         print(f"\n{len(instants)} event marker(s):", file=out)
         for e in sorted(instants, key=lambda e: e["ts"]):
             print(f"  {(e['ts'] - t0) / 1000:9.2f}ms  {e['name']} {e.get('args', {})}", file=out)
+
+
+def render_fleet(trace: dict, out=sys.stdout) -> None:
+    """One timeline, a lane per process: the stitched fleet body names
+    its lanes via Chrome 'process_name' metadata; every lane's bars are
+    positioned on the SAME global axis so cross-process causality reads
+    straight down the page."""
+    events = trace.get("traceEvents", [])
+    lane_of = {
+        e.get("pid"): (e.get("args") or {}).get("name", f"pid-{e.get('pid')}")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    xs = [e for e in events if e.get("ph") == "X"]
+    if not xs:
+        print("no spans in trace", file=out)
+        return
+    t0 = min(e["ts"] for e in xs)
+    total = max(max(e["ts"] + e.get("dur", 0) for e in xs) - t0, 1)
+    trace_id = trace.get("otherData", {}).get("trace_id", "?")
+    pids = sorted(
+        {e.get("pid") for e in xs},
+        key=lambda p: (str(lane_of.get(p, "")), p if isinstance(p, int) else -1),
+    )
+    print(
+        f"fleet trace {trace_id}  total {total / 1000:.2f} ms  "
+        f"{len(pids)} lane(s)",
+        file=out,
+    )
+    for pid in pids:
+        lane = lane_of.get(pid, f"pid-{pid}")
+        lane_events = [e for e in xs if e.get("pid") == pid]
+        print(f"\n── lane {lane} ({len(lane_events)} span(s)) " + "─" * 20, file=out)
+        roots, children = build_tree(lane_events)
+        _walk_spans(roots, children, t0, total, out)
+    instants = [e for e in events if e.get("ph") == "i"]
+    if instants:
+        print(f"\n{len(instants)} event marker(s):", file=out)
+        for e in sorted(instants, key=lambda e: e["ts"]):
+            lane = lane_of.get(e.get("pid"), "")
+            print(
+                f"  {(e['ts'] - t0) / 1000:9.2f}ms  [{lane}] {e['name']} {e.get('args', {})}",
+                file=out,
+            )
 
 
 def main(argv=None) -> int:
@@ -119,8 +181,16 @@ def main(argv=None) -> int:
     p.add_argument("--trace", default=None, help="trace id to fetch from --base")
     p.add_argument("--latest", action="store_true",
                    help="with --base: render the most recent ledger entry's trace")
+    p.add_argument("--fleet", action="store_true",
+                   help="render a supervisor-stitched fleet trace (one lane "
+                        "per process; with --base, fetches "
+                        "/debug/fleet/traces/{id} from the supervisor)")
     args = p.parse_args(argv)
-    render(load(args))
+    body = load(args)
+    if args.fleet:
+        render_fleet(body)
+    else:
+        render(body)
     return 0
 
 
