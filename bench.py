@@ -125,6 +125,12 @@ def parse_args():
     p.add_argument("--sp-system-tokens", type=int, default=0,
                    help="shared-prefix workload: shared system prompt length "
                         "(0 = 4x --prompt-len)")
+    p.add_argument("--fleet", action="store_true",
+                   help="with --workload shared-prefix: two-engine fleet A/B "
+                        "(benchmarks/fleet_kv.py) — global prefix directory + "
+                        "transfer-vs-recompute routing vs per-engine-only on "
+                        "the identical jittered schedule, ending with the "
+                        "drain-on-retire proof (docs/performance.md)")
     p.add_argument("--max-num-seqs", type=int, default=128,
                    help="upper bound; auto-shrunk to what HBM-resident KV allows")
     p.add_argument("--decode-steps", type=int, default=32,
@@ -2169,6 +2175,10 @@ def main():
     try:
         if args.disagg:
             result = asyncio.run(bench_disagg(args))
+        elif args.workload == "shared-prefix" and args.fleet:
+            from benchmarks.fleet_kv import bench_fleet_kv
+
+            result = asyncio.run(bench_fleet_kv(args))
         elif args.workload == "shared-prefix":
             result = asyncio.run(bench_shared_prefix(args))
         elif args.workload == "structured":

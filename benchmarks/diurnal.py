@@ -29,7 +29,7 @@ from __future__ import annotations
 import heapq
 import math
 import random
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -142,6 +142,113 @@ def gen_trace(phases: list[Phase], seed: int) -> list[tuple[float, int, int]]:
     return out
 
 
+def sessionize(trace, seed: int, n_sessions: int,
+               share: float = 0.75) -> list[tuple[float, int, int, int, int]]:
+    """Assign each arrival to a returning session: a session's next turn
+    carries ``share`` of its previous prompt as an already-prefilled
+    prefix — the fleet-wide shared-prefix structure the KV economy
+    monetizes. Generated once and replayed identically by every arm."""
+    rng = random.Random(seed + 7)
+    last_len: dict[int, int] = {}
+    out = []
+    for t, plen, glen in trace:
+        s = rng.randrange(n_sessions)
+        prefix = min(last_len.get(s, 0), int(plen * share))
+        last_len[s] = plen
+        out.append((t, plen, glen, s, prefix))
+    return out
+
+
+class KvEconomyModel:
+    """Fleet KV economy at DES scale (docs/performance.md "Fleet KV
+    economy"): per-engine prefix residency with LRU session capacity,
+    an optional global directory that steers a returning session's
+    prefill to a live holder and prices a cross-engine transfer at
+    ``transfer_block_cost`` of recompute, and an optional shared G4
+    pool that keeps evicted prefixes transferable. 100+ real engines
+    cannot share this host; the model answers the scaling question the
+    two-engine ``bench.py --fleet`` A/B cannot — what the directory is
+    worth when the holder is 1 of 120."""
+
+    def __init__(self, directory: bool, transfer_block_cost: float = 0.35,
+                 capacity_sessions: int = 8, g4: bool = False):
+        self.directory = directory
+        self.tbc = transfer_block_cost
+        self.cap = capacity_sessions
+        self.g4 = g4
+        self.resident: dict[int, OrderedDict] = {}   # wid → LRU session set
+        self.holder_of: dict[int, int] = {}          # session → wid
+        self.g4_pool: set[int] = set()               # evicted-but-shared
+        self.local_hits = 0
+        self.transfers = 0
+        self.recomputes = 0
+        self.evictions = 0
+        self.prefill_tokens_true = 0
+        self.prefill_tokens_effective = 0.0
+
+    def place(self, free: list, req: _Req):
+        """Directory-aware placement: land on the session's holder when
+        it has a free prefill slot; otherwise any free engine (the
+        pricing then decides transfer vs recompute)."""
+        if self.directory and req.prefix_len > 0:
+            holder = self.holder_of.get(req.session)
+            for w in free:
+                if w.wid == holder:
+                    return w
+        return free[0]
+
+    def effective_len(self, w, req: _Req) -> int:
+        """Prefill tokens this placement actually pays for, and the
+        residency/counter bookkeeping of serving it there."""
+        self.prefill_tokens_true += req.plen
+        eff = float(req.plen)
+        if req.prefix_len > 0:
+            holder = self.holder_of.get(req.session)
+            if holder == w.wid:
+                eff = req.plen - req.prefix_len
+                self.local_hits += 1
+            elif self.directory and (
+                holder is not None
+                or (self.g4 and req.session in self.g4_pool)
+            ):
+                eff = (req.plen - req.prefix_len) + self.tbc * req.prefix_len
+                self.transfers += 1
+            else:
+                self.recomputes += 1
+        self._touch(w.wid, req.session)
+        self.prefill_tokens_effective += eff
+        return max(int(eff), 8)
+
+    def _touch(self, wid: int, sess: int) -> None:
+        old = self.holder_of.get(sess)
+        if old is not None and old != wid:
+            self.resident.get(old, OrderedDict()).pop(sess, None)
+        lru = self.resident.setdefault(wid, OrderedDict())
+        lru[sess] = None
+        lru.move_to_end(sess)
+        self.holder_of[sess] = wid
+        self.g4_pool.discard(sess)
+        while len(lru) > self.cap:
+            evicted, _ = lru.popitem(last=False)
+            del self.holder_of[evicted]
+            self.evictions += 1
+            if self.g4:
+                self.g4_pool.add(evicted)
+
+    def stats(self) -> dict:
+        true_t = max(self.prefill_tokens_true, 1)
+        return {
+            "prefill_tokens_true": self.prefill_tokens_true,
+            "prefill_tokens_effective": round(self.prefill_tokens_effective),
+            "prefill_compute_frac": round(
+                self.prefill_tokens_effective / true_t, 4),
+            "local_hits": self.local_hits,
+            "transfers": self.transfers,
+            "recomputes": self.recomputes,
+            "evictions": self.evictions,
+        }
+
+
 # ---------------------------------------------------------------------------
 # Discrete-event cluster
 # ---------------------------------------------------------------------------
@@ -153,6 +260,8 @@ class _Req:
     t_arrive: float
     plen: int
     glen: int
+    session: int = -1
+    prefix_len: int = 0   # leading tokens a prior turn already prefilled
     t_first: float = -1.0
     tokens: int = 0
     itl_sum: float = 0.0
@@ -194,12 +303,14 @@ class DiurnalSim:
 
     def __init__(self, decode_interp, prefill_interp, n_workers: int,
                  prefill_n: int, switch_delay_s: float = 0.5,
-                 relocate: bool = False, migrate_gap_s: float = 0.25):
+                 relocate: bool = False, migrate_gap_s: float = 0.25,
+                 kv_economy: KvEconomyModel | None = None):
         self.dec = decode_interp
         self.pre = prefill_interp
         self.switch_delay_s = switch_delay_s
         self.relocate = relocate
         self.migrate_gap_s = migrate_gap_s
+        self.kv_economy = kv_economy
         self.workers = [
             _Worker(i, POOL_PREFILL if i < prefill_n else POOL_DECODE)
             for i in range(n_workers)
@@ -262,10 +373,16 @@ class DiurnalSim:
     def _pump_prefill(self) -> None:
         free = [w for w in self._available(POOL_PREFILL) if w.busy is None]
         while free and self.prefill_q:
-            w = free.pop()
             req = self.prefill_q.popleft()
+            if self.kv_economy is not None:
+                w = self.kv_economy.place(free, req)
+                free.remove(w)
+                svc_len = self.kv_economy.effective_len(w, req)
+            else:
+                w = free.pop()
+                svc_len = req.plen
             w.busy = req
-            svc = self.pre.ttft_at(req.plen) / 1000.0
+            svc = self.pre.ttft_at(svc_len) / 1000.0
             self.schedule(self.now + svc, self._prefill_done, w, req)
 
     def _prefill_done(self, w: _Worker, req: _Req) -> None:
@@ -483,6 +600,24 @@ async def run_static_arm(trace, interps, n_workers: int, prefill_n: int,
     return out
 
 
+async def run_kv_economy_arm(strace, interps, n_workers: int, prefill_n: int,
+                             day_s: float, ttft_slo_s: float,
+                             itl_slo_ms: float,
+                             economy: KvEconomyModel) -> dict:
+    """Static split, sessionized trace, prefill cost shaped by the KV
+    economy model — the question is cache economics at 100+ engines,
+    not control, so the autoscaler stays out of this arm."""
+    dec, pre = interps
+    sim = DiurnalSim(dec, pre, n_workers, prefill_n, kv_economy=economy)
+    for i, (t, plen, glen, sess, prefix) in enumerate(strace):
+        sim.schedule(t, sim.arrive,
+                     _Req(i, t, plen, glen, session=sess, prefix_len=prefix))
+    sim.run_until(math.inf)
+    out = _score(sim.completed, len(strace), day_s, ttft_slo_s, itl_slo_ms)
+    out.update(economy.stats())
+    return out
+
+
 async def run_closed_loop_arm(trace, interps, n_workers: int, prefill_n: int,
                               day_s: float, ttft_slo_s: float, itl_slo_ms: float,
                               interval_s: float = 5.0, seed: int = 0,
@@ -630,6 +765,27 @@ async def bench_diurnal(args) -> dict:
         if fleet_arms["drain"]["slo_goodput_tok_s"] > 0 else float("inf")
     )
 
+    # KV economy at fleet scale: the same 120-engine day, sessionized
+    # (returning sessions carry a prior-turn prefix), per-engine-only
+    # residency vs directory+G4 transfer-vs-recompute pricing. At 120
+    # engines a returning session lands on its holder ~1/120 of the
+    # time by chance — exactly the regime where the directory's steering
+    # + priced transfers dominate and the two-engine A/B understates.
+    strace = sessionize(fleet_trace, seed, n_sessions=4 * fleet_n)
+    econ_arms = {}
+    for mode, economy in (
+        ("per_engine", KvEconomyModel(directory=False)),
+        ("directory", KvEconomyModel(directory=True, g4=True)),
+    ):
+        econ_arms[mode] = await run_kv_economy_arm(
+            strace, interps, fleet_n, fleet_start_p, fleet_day_s,
+            ttft_slo_s, itl_slo_ms, economy,
+        )
+    econ_compute_ratio = (
+        econ_arms["per_engine"]["prefill_tokens_effective"]
+        / max(econ_arms["directory"]["prefill_tokens_effective"], 1)
+    )
+
     ratio = (
         closed["slo_goodput_tok_s"] / best_static["slo_goodput_tok_s"]
         if best_static["slo_goodput_tok_s"] > 0 else float("inf")
@@ -663,10 +819,22 @@ async def bench_diurnal(args) -> dict:
             "drain": fleet_arms["drain"],
             "relocate": fleet_arms["relocate"],
             "relocate_vs_drain_goodput": round(fleet_ratio, 4),
+            "kv_economy": {
+                "sessions": 4 * fleet_n,
+                "transfer_block_cost": 0.35,
+                "per_engine": econ_arms["per_engine"],
+                "directory": econ_arms["directory"],
+                "prefill_compute_saved": round(econ_compute_ratio, 4),
+                "goodput_ratio": round(
+                    econ_arms["directory"]["slo_goodput_tok_s"]
+                    / max(econ_arms["per_engine"]["slo_goodput_tok_s"], 1e-9),
+                    4),
+            },
         },
         "zero_failed_requests": all(
             a["failed"] == 0
-            for a in [closed, *statics.values(), *fleet_arms.values()]
+            for a in [closed, *statics.values(), *fleet_arms.values(),
+                      *econ_arms.values()]
         ),
         "note": (
             "Discrete-event cluster executing the REAL planner control "

@@ -1,0 +1,377 @@
+"""Fleet KV economy A/B: ``bench.py --workload shared-prefix --fleet``.
+
+Two real role-managed engines behind one KV router run the SAME seeded
+multi-turn shared-prefix schedule twice:
+
+- **arm A (per-engine-only)**: no directory, no peer fetch — a
+  placement flip recomputes the conversation's whole history on the
+  newly-chosen engine (today's per-engine prefix caching).
+- **arm B (fleet economy)**: every engine publishes block residency to
+  the global prefix directory; the router prices missing prefixes as
+  transfers (``transfer_block_cost``) and attaches multi-holder
+  ``peer_prefix`` hints, so a flip PULLS the history over the data
+  plane instead of recomputing it.
+
+``router_temperature > 0`` jitters placement identically in both arms
+(the reference's anti-herding sampling), so flips — the event the
+economy exists for — occur at equal offered load. Greedy seeded
+sampling pins token parity per (user, turn) across arms: the economy
+must be free of output drift.
+
+Arm B ends with the drain-on-retire proof (ISSUE 18 acceptance): the
+engine holding a conversation's deepest run RETIRES, its warm blocks
+drain to the survivor via ``kv_adopt``, and the conversation's next
+turn must hit the adopted prefix through directory routing before any
+recompute.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import types
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.fleet.directory import DirectoryPublisher, PrefixDirectory
+from dynamo_tpu.kv_router.publisher import KvEventBroadcaster
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.planner.actions import POOL_DECODE
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.tokens import compute_block_hashes
+from dynamo_tpu.worker.roles import WorkerRoleManager
+
+CFG = ModelConfig()  # control-plane bench: tiny model, real protocol
+BS = 4
+
+
+def _worker_cli_args(namespace: str) -> types.SimpleNamespace:
+    """The worker-CLI shape WorkerRoleManager reads: conditional disagg
+    with an unreachable local-prefill threshold = every prompt prefills
+    locally, but the decode handler still carries the PeerPrefixFetcher
+    wrap — the same composition ``python -m dynamo_tpu.worker
+    --autoscaler on`` serves."""
+    return types.SimpleNamespace(
+        namespace=namespace, component="backend", prefill_component="prefill",
+        endpoint="generate", engine="tpu", disagg="auto",
+        prefill_dispatch="push", max_local_prefill_length=1 << 30,
+        no_disagg_stream=False,
+    )
+
+
+class _FleetWorker:
+    def __init__(self, rt, engine, mgr, publisher, wid):
+        self.rt = rt
+        self.engine = engine
+        self.mgr = mgr
+        self.publisher = publisher
+        self.wid = wid
+
+    async def stop(self):
+        await self.mgr.close()
+        if self.publisher is not None:
+            await self.publisher.close()
+        await self.engine.stop()
+        await self.rt.shutdown()
+
+
+async def _make_worker(url: str, namespace: str, eargs: EngineArgs,
+                       directory_on: bool) -> _FleetWorker:
+    rt = await DistributedRuntime.create(store_url=url)
+    engine = await TpuEngine(eargs, seed=0).start()
+    broadcaster = KvEventBroadcaster(engine.pool)
+    publisher = None
+    if directory_on:
+        publisher = await DirectoryPublisher(
+            rt.store, namespace, await rt.primary_lease(), flush_interval=0.05
+        ).start()
+        pub = publisher
+        engine.pool.set_event_sink(
+            lambda ev: (broadcaster.publish(ev), pub.pool_sink(ev))
+        )
+        engine.tiers.set_event_sink(pub.tier_sink)
+    else:
+        engine.pool.set_event_sink(broadcaster.publish)
+    mgr = await WorkerRoleManager(
+        rt, engine, [], _worker_cli_args(namespace), broadcaster
+    ).start(POOL_DECODE)
+    return _FleetWorker(rt, engine, mgr, publisher, await rt.primary_lease())
+
+
+def _turn_req(history: list[int], u: int, t: int, gen: int) -> dict:
+    req = PreprocessedRequest(model=CFG.name, token_ids=list(history))
+    req.sampling.temperature = 0.0
+    req.sampling.seed = u * 131 + t
+    req.stop.max_tokens = gen
+    req.stop.ignore_eos = True
+    return req.to_dict()
+
+
+async def _run_arm(url: str, namespace: str, eargs: EngineArgs,
+                   schedule: dict, fleet_on: bool) -> dict:
+    """One full schedule pass on a fresh two-engine cluster. Returns the
+    measured dict plus live handles for the arm-B drain phase (caller
+    stops the cluster)."""
+    import random
+
+    workers = [
+        await _make_worker(url, namespace, eargs, directory_on=fleet_on)
+        for _ in range(2)
+    ]
+    frt = await DistributedRuntime.create(store_url=url)
+    push = await (
+        frt.namespace(namespace).component("backend").endpoint("generate")
+        .router(RouterMode.DIRECT)
+    )
+    await push.discovery.wait_for_instances(2)
+    directory = None
+    if fleet_on:
+        directory = await PrefixDirectory(frt.store, namespace).start()
+    router = await KvPushRouter(
+        push,
+        KvRouterConfig(
+            block_size=BS,
+            router_temperature=schedule["temperature"],
+            peer_fetch_min_blocks=2 if fleet_on else 0,
+        ),
+        directory=directory,
+    ).start()
+    # Seeded placement jitter: both arms sample flips from the same rng
+    # stream, so the economy is measured at equal offered churn.
+    router.scheduler._rng = random.Random(0)
+
+    n_users, turns = schedule["n_users"], schedule["turns"]
+    system, user_msgs, gen_lens = (
+        schedule["system"], schedule["user_msgs"], schedule["gen_lens"]
+    )
+    histories = [list(system) + user_msgs[u][0] for u in range(n_users)]
+    tokens: dict = {}
+    placements: dict = {}
+    ttfts: list[float] = []
+    total_prompt = 0
+    prefilled0 = sum(w.engine.total_prefilled for w in workers)
+
+    async def one_turn(u: int, t: int):
+        nonlocal total_prompt
+        req = _turn_req(histories[u], u, t, int(gen_lens[u][t]))
+        total_prompt += len(histories[u])
+        ctx = Context()
+        out: list[int] = []
+        t0 = time.perf_counter()
+        first = None
+        async for item in router.generate(req, ctx):
+            if item.get("token_ids"):
+                if first is None:
+                    first = time.perf_counter() - t0
+                out.extend(item["token_ids"])
+        if first is not None:
+            ttfts.append(first)
+        tokens[(u, t)] = out
+        placements[(u, t)] = ctx.metadata.get("worker_instance_id")
+        histories[u] = histories[u] + out
+
+    for t in range(turns):
+        # Wave barrier: every user's turn t in flight together — the
+        # concurrency is what makes the load term flip placements.
+        await asyncio.gather(*(one_turn(u, t) for u in range(n_users)))
+        if t + 1 < turns:
+            for u in range(n_users):
+                histories[u] = histories[u] + user_msgs[u][t + 1]
+            # Let KV events index and (arm B) residency publish before
+            # the next wave prices against them.
+            await asyncio.sleep(0.25)
+
+    from bench import pctl
+
+    prefilled = sum(w.engine.total_prefilled for w in workers) - prefilled0
+    flips = sum(
+        1 for u in range(n_users) for t in range(1, turns)
+        if placements[(u, t)] != placements[(u, t - 1)]
+    )
+    return {
+        "workers": workers, "frt": frt, "router": router,
+        "directory": directory, "push": push,
+        "tokens": tokens, "histories": histories,
+        "prompt_tokens": total_prompt,
+        "prefilled_true": prefilled,
+        "prefill_multiplier": total_prompt / max(1, prefilled),
+        "ttft_p50_ms": pctl(ttfts, 50) * 1000,
+        "ttft_p99_ms": pctl(ttfts, 99) * 1000,
+        "placement_flips": flips,
+    }
+
+
+async def _stop_arm(arm: dict) -> None:
+    await arm["router"].close()
+    if arm["directory"] is not None:
+        await arm["directory"].close()
+    await arm["frt"].shutdown()
+    for w in arm["workers"]:
+        await w.stop()
+
+
+async def _drain_phase(arm: dict, schedule: dict) -> dict:
+    """Arm-B epilogue: retire the engine holding some conversation's
+    deepest run; the survivor must serve that conversation's next turn
+    from the DRAINED blocks (directory-routed) before any recompute."""
+    workers, directory, router = arm["workers"], arm["directory"], arm["router"]
+    rng = np.random.default_rng(7)
+
+    # Pick the (user, victim) pair with the largest residency asymmetry:
+    # the retiring engine knows strictly more of this conversation than
+    # the survivor, so the drain has something real to hand over.
+    best = None
+    for u in range(schedule["n_users"]):
+        hashes = compute_block_hashes(arm["histories"][u], BS)
+        runs = [w.engine.tiers.peek_run_len(hashes) for w in workers]
+        for vi in (0, 1):
+            gain = runs[vi] - runs[1 - vi]
+            if gain > 0 and (best is None or gain > best[0]):
+                best = (gain, u, vi)
+    if best is None:
+        return {"drained_prefix_hit": False,
+                "drain_error": "no residency asymmetry to drain"}
+    _, u, vi = best
+    victim, survivor = workers[vi], workers[1 - vi]
+    hashes = compute_block_hashes(arm["histories"][u], BS)
+    run_before = survivor.engine.tiers.peek_run_len(hashes)
+
+    await victim.mgr.retire()
+    run_after = survivor.engine.tiers.peek_run_len(hashes)
+    adopted = run_after - run_before
+
+    # The survivor's tier puts republished residency: wait until the
+    # frontend's directory mirror sees the adopted run, then route the
+    # conversation's next turn — the hit must be directory-visible
+    # BEFORE dispatch, not a lucky local cache.
+    deadline = asyncio.get_running_loop().time() + 5.0
+    while (directory.run_depth(survivor.wid, hashes) < run_after
+           and asyncio.get_running_loop().time() < deadline):
+        await asyncio.sleep(0.05)
+    dir_overlap = directory.run_depth(survivor.wid, hashes)
+
+    await arm["push"].discovery.wait_for_instances(1)
+    next_msg = rng.integers(1, CFG.vocab_size - 1, size=8).tolist()
+    prompt = arm["histories"][u] + next_msg
+    prefilled0 = survivor.engine.total_prefilled
+    ctx = Context()
+    out = [x async for x in router.generate(
+        _turn_req(prompt, u, schedule["turns"], 8), ctx
+    )]
+    assert any(item.get("token_ids") for item in out)
+    recomputed = survivor.engine.total_prefilled - prefilled0
+    served_blocks = (len(prompt) - recomputed) // BS
+    return {
+        "drain_user_history_blocks": len(hashes),
+        "drain_victim_run_blocks": int(
+            max(0, run_after)  # victim is gone; its run == what drained in
+        ),
+        "drain_adopted_blocks": int(adopted),
+        "drain_directory_overlap_blocks": int(dir_overlap),
+        "drain_prompt_tokens": len(prompt),
+        "drain_recomputed_tokens": int(recomputed),
+        "drain_served_blocks": int(served_blocks),
+        # THE acceptance bit: the drained prefix produced a cache hit on
+        # the survivor (≥1 adopted block served) before any recompute.
+        "drained_prefix_hit": bool(
+            adopted > 0 and dir_overlap >= run_after and served_blocks >= adopted
+        ),
+    }
+
+
+async def bench_fleet_kv(args) -> dict:
+    quick = bool(getattr(args, "quick", False)) or bool(getattr(args, "cpu", False))
+    turns = 2 if quick else max(2, args.sp_turns)
+    n_users = 4 if quick else max(4, min(12, args.num_requests // turns))
+    sys_len = 32 if quick else (args.sp_system_tokens or 64)
+    sfx_len = 8 if quick else 16
+    gen_len = 8 if quick else 16
+
+    rng = np.random.default_rng(0)
+    schedule = {
+        "n_users": n_users, "turns": turns, "temperature": 0.6,
+        "system": rng.integers(1, CFG.vocab_size - 1, size=sys_len).tolist(),
+        "user_msgs": [
+            [rng.integers(1, CFG.vocab_size - 1, size=sfx_len).tolist()
+             for _ in range(turns)]
+            for _ in range(n_users)
+        ],
+        "gen_lens": [[gen_len] * turns for _ in range(n_users)],
+    }
+    max_hist = sys_len + turns * (sfx_len + gen_len) + 2 * gen_len
+    blocks_per_seq = max_hist // BS + 2
+    eargs = EngineArgs(
+        model=CFG, block_size=BS,
+        num_kv_blocks=(n_users + 2) * blocks_per_seq,
+        max_num_seqs=max(2, n_users // 2),
+        max_model_len=blocks_per_seq * BS,
+        max_prefill_tokens=max(128, max_hist),
+        dtype="float32", decode_steps=4,
+        host_kv_blocks=2 * (n_users + 2) * blocks_per_seq,
+    )
+
+    # Arm A: per-engine-only (no directory, no peer fetch).
+    arm_a = await _run_arm("memory://kvecon-a", "kvecon", eargs,
+                           schedule, fleet_on=False)
+    await _stop_arm(arm_a)
+    # Arm B: directory + transfer-vs-recompute + drain-on-retire.
+    arm_b = await _run_arm("memory://kvecon-b", "kvecon", eargs,
+                           schedule, fleet_on=True)
+    try:
+        drain = await _drain_phase(arm_b, schedule)
+    finally:
+        await _stop_arm(arm_b)
+
+    mismatches = sum(
+        1 for key, toks in arm_a["tokens"].items()
+        if arm_b["tokens"].get(key) != toks
+    )
+    parity = mismatches == 0
+    mult_ratio = arm_b["prefill_multiplier"] / max(1e-9, arm_a["prefill_multiplier"])
+    ttft_ratio = arm_a["ttft_p50_ms"] / max(1e-9, arm_b["ttft_p50_ms"])
+    result = {
+        "metric": "fleet_kv_prefill_multiplier_ratio",
+        "value": round(mult_ratio, 2),
+        "unit": "x",
+        "vs_baseline": round(mult_ratio, 2),
+        "vs_baseline_basis": "prompt-tokens-served per prefilled token, "
+                             "directory+transfer vs per-engine-only on the "
+                             "identical jittered schedule",
+        "workload": "shared-prefix-fleet",
+        "model": CFG.name,
+        "num_users": n_users,
+        "turns_per_user": turns,
+        "system_tokens": sys_len,
+        "router_temperature": schedule["temperature"],
+        "prompt_tokens": int(arm_a["prompt_tokens"]),
+        "prefilled_true_fleet": int(arm_b["prefilled_true"]),
+        "prefilled_true_baseline": int(arm_a["prefilled_true"]),
+        "prefill_multiplier_fleet": round(arm_b["prefill_multiplier"], 2),
+        "prefill_multiplier_baseline": round(arm_a["prefill_multiplier"], 2),
+        "ttft_p50_ms_fleet": round(arm_b["ttft_p50_ms"], 1),
+        "ttft_p50_ms_baseline": round(arm_a["ttft_p50_ms"], 1),
+        "ttft_p99_ms_fleet": round(arm_b["ttft_p99_ms"], 1),
+        "ttft_p99_ms_baseline": round(arm_a["ttft_p99_ms"], 1),
+        "ttft_p50_speedup": round(ttft_ratio, 2),
+        "placement_flips_fleet": int(arm_b["placement_flips"]),
+        "placement_flips_baseline": int(arm_a["placement_flips"]),
+        "parity": parity,
+        "quick": quick,
+        **drain,
+    }
+    if not parity:
+        result["error"] = (
+            f"stream parity FAILED on {mismatches}/{len(arm_a['tokens'])} "
+            "turns — the fleet economy drifted output"
+        )
+    elif not drain.get("drained_prefix_hit"):
+        result["error"] = (
+            "drain-on-retire proof failed: no directory-routed hit on the "
+            f"survivor ({drain.get('drain_error', 'adopted blocks not served')})"
+        )
+    return result

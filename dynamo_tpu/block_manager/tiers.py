@@ -1,4 +1,4 @@
-"""KV cache tiers beyond HBM: G2 host RAM and G3 local disk.
+"""KV cache tiers beyond HBM: G2 host RAM, G3 local disk, G4 fleet pool.
 
 Reference analogue: the KVBM tier stack G1 device / G2 pinned host / G3
 disk with offload + onboard (reference: lib/llm/src/block_manager.rs:
@@ -23,6 +23,21 @@ the evictor skips positive-credit entries (re-queueing them MRU, counted
 in ``protected_evictions``) until it finds a cold one. Credits age, so a
 protected block that stops earning hits still leaves eventually; scans
 are bounded, so eviction stays O(spares) and always terminates.
+
+G4 (:class:`FleetBlockPool`) extends the stack across engines: a
+directory shared by EVERY worker on the host/filestore (Mooncake's
+cluster KV pool shape, 2407.00079). Blocks are keyed by the same salted
+sequence-hash chain, so two engines that computed the same prefix write
+the same file name — the second write is a dedup no-op, counted, never
+re-encoded. G3 eviction SPILLS into G4 by file rename (os.replace:
+atomic, zero-copy on one filesystem) instead of deleting, so a block
+ages down the whole ladder before the fleet truly forgets it.
+
+Tier residency events: ``TierStack.set_event_sink(cb)`` attaches
+``cb(kind, tier, hashes)`` (kind ``stored``/``removed``, tier 2/3/4) to
+every pool — the feed the fleet prefix directory
+(fleet/directory.py) publishes so routers know who holds what, how warm.
+Callbacks fire OUTSIDE the pool locks.
 """
 
 from __future__ import annotations
@@ -72,6 +87,61 @@ def _second_chance_pop(order, credit: dict[int, int]):
     return h, v, scans
 
 
+def _write_npz(path: str, pages: tuple) -> None:
+    """Encode one page tuple to ``path`` atomically (tmp + rename).
+    KV page tuples keep the legacy k/v(+scales) layout so a persistent
+    ``--disk-kv-dir`` (or a shared ``--fleet-kv-dir``) stays readable
+    across versions; general object tuples ride positional arrays.
+    bf16 numpy (ml_dtypes) isn't npz-portable → stored as uint16 views."""
+    if len(pages) in (2, 4):
+        k, v = pages[0], pages[1]
+        kind = str(k.dtype)
+        if kind == "bfloat16":
+            k, v = k.view(np.uint16), v.view(np.uint16)
+        extra = {}
+        if len(pages) == 4:  # int8 pages carry fp32 scale sidecars
+            extra = {"k_scale": pages[2], "v_scale": pages[3]}
+        payload = {"k": k, "v": v, "dtype": np.bytes_(kind), **extra}
+    else:
+        # General object tuples (LoRA adapter pages and any future
+        # paged object): positional arrays + per-array dtype names.
+        payload = {"n": np.int64(len(pages))}
+        for i, a in enumerate(pages):
+            kind = str(a.dtype)
+            payload[f"d{i}"] = np.bytes_(kind)
+            payload[f"p{i}"] = a.view(np.uint16) if kind == "bfloat16" else a
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+
+
+def _read_npz(path: str) -> tuple | None:
+    """Decode one page tuple from ``path``; None on any corruption/race
+    (a shared fleet dir can lose a file to a peer's eviction mid-read)."""
+    try:
+        with np.load(path) as z:
+            if "n" in z.files:  # general object tuple
+                pages = []
+                for i in range(int(z["n"])):
+                    a, kind = z[f"p{i}"], bytes(z[f"d{i}"]).decode()
+                    if kind == "bfloat16":
+                        import ml_dtypes
+
+                        a = a.view(ml_dtypes.bfloat16)
+                    pages.append(a)
+                return tuple(pages)
+            k, v, kind = z["k"], z["v"], bytes(z["dtype"]).decode()
+            scales = (z["k_scale"], z["v_scale"]) if "k_scale" in z.files else ()
+            if kind == "bfloat16":
+                import ml_dtypes
+
+                k, v = k.view(ml_dtypes.bfloat16), v.view(ml_dtypes.bfloat16)
+            return (k, v, *scales)
+    except (OSError, KeyError, ValueError):
+        return None
+
+
 class HostBlockPool:
     """G2: host-RAM pages keyed by sequence hash, credit-aware-LRU
     bounded (module header).
@@ -96,6 +166,14 @@ class HostBlockPool:
         self.hits = 0
         self.misses = 0
         self.protected_evictions = 0  # eviction scans that spared an entry
+        # Tier residency feed (module header): callable(kind, tier, hashes),
+        # fired outside the lock. TierStack.set_event_sink wires it.
+        self.event_sink = None
+        self.tier_no = 2
+
+    def _emit(self, kind: str, hashes: list[int]) -> None:
+        if self.event_sink is not None and hashes:
+            self.event_sink(kind, self.tier_no, hashes)
 
     def __len__(self) -> int:
         with self._lock:
@@ -104,6 +182,7 @@ class HostBlockPool:
     def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False,
             weight: int = 1) -> None:
         spilled = []
+        stored = False
         # Own the storage: callers pass views into shared batch buffers
         # (engine extracts up to 64 blocks per DMA and slices per block);
         # retaining a view would pin the whole batch buffer and break the
@@ -118,12 +197,16 @@ class HostBlockPool:
             self._weights[seq_hash] = max(1, int(weight))
             self._units += self._weights[seq_hash]
             _credit_seed(self._credit, seq_hash, protected)
+            stored = True
             while self._units > self.capacity and self._pages:
                 h, pgs, spared = _second_chance_pop(self._pages, self._credit)
                 w = self._weights.pop(h, 1)
                 self._units -= w
                 self.protected_evictions += spared
                 spilled.append((h, pgs, w))
+        if stored:
+            self._emit("stored", [seq_hash])
+        self._emit("removed", [h for h, _, _ in spilled])
         for h, pgs, w in spilled:
             if self._spill is None:
                 continue
@@ -149,12 +232,13 @@ class HostBlockPool:
 
     def clear(self) -> int:
         with self._lock:
-            n = len(self._pages)
+            dropped = list(self._pages)
             self._pages.clear()
             self._credit.clear()
             self._weights.clear()
             self._units = 0
-            return n
+        self._emit("removed", dropped)
+        return len(dropped)
 
 
 class DiskBlockPool:
@@ -186,6 +270,15 @@ class DiskBlockPool:
         self.hits = 0
         self.misses = 0
         self.protected_evictions = 0  # eviction scans that spared an entry
+        # G3→G4 spill hook: callable(hash, path) → bool (True = the file
+        # was adopted/deduped by the fleet tier; False = delete locally).
+        self._spill = None
+        self.event_sink = None  # module header: callable(kind, tier, hashes)
+        self.tier_no = 3
+
+    def _emit(self, kind: str, hashes: list[int]) -> None:
+        if self.event_sink is not None and hashes:
+            self.event_sink(kind, self.tier_no, hashes)
 
     def _path(self, seq_hash: int) -> str:
         return os.path.join(self.dir, f"{seq_hash}.npz")
@@ -211,62 +304,23 @@ class DiskBlockPool:
                 self._units -= self._weights.pop(h, 1)
                 self.protected_evictions += spared
                 evict.append(h)
-        if len(pages) in (2, 4):
-            # KV page tuples keep the legacy k/v(+scales) layout so a
-            # persistent --disk-kv-dir stays readable across versions.
-            k, v = pages[0], pages[1]
-            # bf16 numpy (ml_dtypes) isn't npz-portable → store uint16 view.
-            kind = str(k.dtype)
-            if kind == "bfloat16":
-                k, v = k.view(np.uint16), v.view(np.uint16)
-            extra = {}
-            if len(pages) == 4:  # int8 pages carry fp32 scale sidecars
-                extra = {"k_scale": pages[2], "v_scale": pages[3]}
-            payload = {"k": k, "v": v, "dtype": np.bytes_(kind), **extra}
-        else:
-            # General object tuples (LoRA adapter pages and any future
-            # paged object): positional arrays + per-array dtype names,
-            # bf16 via the same uint16-view trick.
-            payload = {"n": np.int64(len(pages))}
-            for i, a in enumerate(pages):
-                kind = str(a.dtype)
-                payload[f"d{i}"] = np.bytes_(kind)
-                payload[f"p{i}"] = a.view(np.uint16) if kind == "bfloat16" else a
-        tmp = self._path(seq_hash) + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, self._path(seq_hash))
+        _write_npz(self._path(seq_hash), pages)
+        self._emit("stored", [seq_hash])
         for h in evict:
+            # Eviction ages a block DOWN the ladder when a fleet tier is
+            # wired: rename into the shared pool (or dedup against a
+            # peer's identical copy) instead of deleting.
+            if self._spill is not None and self._spill(h, self._path(h)):
+                continue
             try:
                 os.remove(self._path(h))
             except OSError:
                 pass
+        self._emit("removed", evict)
 
     def get(self, seq_hash: int) -> tuple[np.ndarray, ...] | None:
-        path = self._path(seq_hash)
-        try:
-            with np.load(path) as z:
-                if "n" in z.files:  # general object tuple
-                    pages = []
-                    for i in range(int(z["n"])):
-                        a, kind = z[f"p{i}"], bytes(z[f"d{i}"]).decode()
-                        if kind == "bfloat16":
-                            import ml_dtypes
-
-                            a = a.view(ml_dtypes.bfloat16)
-                        pages.append(a)
-                    out = tuple(pages)
-                else:
-                    k, v, kind = z["k"], z["v"], bytes(z["dtype"]).decode()
-                    scales = (
-                        (z["k_scale"], z["v_scale"]) if "k_scale" in z.files else ()
-                    )
-                    if kind == "bfloat16":
-                        import ml_dtypes
-
-                        k, v = k.view(ml_dtypes.bfloat16), v.view(ml_dtypes.bfloat16)
-                    out = (k, v, *scales)
-        except (OSError, KeyError, ValueError):
+        out = _read_npz(self._path(seq_hash))
+        if out is None:
             self.misses += 1
             return None
         with self._lock:
@@ -292,26 +346,153 @@ class DiskBlockPool:
                 os.remove(self._path(h))
             except OSError:
                 pass
+        self._emit("removed", hashes)
+        return len(hashes)
+
+
+class FleetBlockPool:
+    """G4: a fleet-SHARED block pool on a common directory (NFS mount,
+    tmpfs on a multi-engine host, or any mounted object store) — the
+    module-header cluster-commodity tier.
+
+    Same one-file-per-hash npz layout as :class:`DiskBlockPool`, so a
+    ``--disk-kv-dir`` can be promoted to a fleet dir without migration.
+    Because the chained block hash encodes the whole salted prefix, any
+    two engines producing the same file name produced the same bytes:
+    ``put`` of an existing hash is a **dedup** (counted, skipped), never
+    a rewrite. Capacity is enforced by oldest-mtime eviction over the
+    SHARED directory — each writer prunes past the cap, so the pool
+    stays bounded no matter how many engines feed it; a reader losing a
+    race with a peer's eviction just misses (the caller recomputes).
+    No in-process LRU/credit state: the filesystem IS the shared truth."""
+
+    def __init__(self, directory: str, capacity_blocks: int):
+        self.dir = directory
+        self.capacity = capacity_blocks
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.dedup_blocks = 0   # puts/adoptions skipped: a peer already wrote the hash
+        self.evictions = 0      # files pruned by the capacity sweep
+        self.event_sink = None  # module header: callable(kind, tier, hashes)
+        self.tier_no = 4
+
+    def _emit(self, kind: str, hashes: list[int]) -> None:
+        if self.event_sink is not None and hashes:
+            self.event_sink(kind, self.tier_no, hashes)
+
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.dir, f"{seq_hash}.npz")
+
+    def __len__(self) -> int:
+        return sum(1 for f in os.listdir(self.dir) if f.endswith(".npz"))
+
+    def contains(self, seq_hash: int) -> bool:
+        # Existence probe against the SHARED dir: sees peers' writes too.
+        return os.path.exists(self._path(seq_hash))
+
+    def put(self, seq_hash: int, *pages: np.ndarray, protected: bool = False,
+            weight: int = 1) -> None:
+        if self.contains(seq_hash):
+            with self._lock:
+                self.dedup_blocks += 1
+            return
+        _write_npz(self._path(seq_hash), pages)
+        self._emit("stored", [seq_hash])
+        self._sweep()
+
+    def adopt_file(self, seq_hash: int, src_path: str) -> bool:
+        """G3 spill entry: move an evicted npz into the fleet pool by
+        rename (zero-copy). → True (the source file is consumed either
+        way: renamed in, or removed as a dedup against a peer's copy)."""
+        dst = self._path(seq_hash)
+        if os.path.exists(dst):
+            with self._lock:
+                self.dedup_blocks += 1
+            try:
+                os.remove(src_path)
+            except OSError:
+                pass
+            return True
+        try:
+            os.replace(src_path, dst)
+        except OSError:
+            return False  # cross-device rename refused: fall back to delete
+        self._emit("stored", [seq_hash])
+        self._sweep()
+        return True
+
+    def get(self, seq_hash: int) -> tuple[np.ndarray, ...] | None:
+        out = _read_npz(self._path(seq_hash))
+        if out is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return out
+
+    def _sweep(self) -> None:
+        """Prune oldest-mtime files past capacity. Races with peers are
+        benign: a double-remove is an ignored OSError, and over-pruning
+        by one writer just leaves headroom for the next."""
+        try:
+            files = [
+                f for f in os.listdir(self.dir) if f.endswith(".npz")
+            ]
+            if len(files) <= self.capacity:
+                return
+            files.sort(key=lambda f: os.path.getmtime(os.path.join(self.dir, f)))
+            victims = files[: len(files) - self.capacity]
+        except OSError:
+            return
+        removed: list[int] = []
+        for f in victims:
+            try:
+                os.remove(os.path.join(self.dir, f))
+                removed.append(int(f[:-4]))
+            except (OSError, ValueError):
+                pass
+        with self._lock:
+            self.evictions += len(removed)
+        self._emit("removed", removed)
+
+    def clear(self) -> int:
+        hashes = []
+        for f in list(os.listdir(self.dir)):
+            if f.endswith(".npz"):
+                try:
+                    os.remove(os.path.join(self.dir, f))
+                    hashes.append(int(f[:-4]))
+                except (OSError, ValueError):
+                    pass
+        self._emit("removed", hashes)
         return len(hashes)
 
 
 class TierStack:
-    """G2(+G3) lookup/offload facade the engine talks to.
+    """G2(+G3+G4) lookup/offload facade the engine talks to.
 
     - ``offload(pairs)``: write-through sealed blocks (bounded per call —
       the offload queue analogue of the reference's OffloadManager
       priority queues; overflow is dropped, it is only a cache).
     - ``lookup_run(hashes)``: longest consecutive run of leading hashes
-      available across tiers → list of (k, v) pages, promoting G3 hits
+      available across tiers → list of (k, v) pages, promoting G3/G4 hits
       into G2.
+
+    Spill chain: G2 eviction → G3 ``put`` (re-serialize); G3 eviction →
+    G4 ``adopt_file`` (zero-copy rename into the shared pool). With no
+    G3, G2 spills straight to G4. A G4 hit found by a PEER engine that
+    never produced the block is the cross-engine dedup payoff.
     """
 
     MAX_OFFLOAD_PER_STEP = 64
 
     def __init__(self, host: HostBlockPool | None, disk: DiskBlockPool | None,
+                 fleet: "FleetBlockPool | None" = None,
                  unit_bytes: int | None = None):
         self.host = host
         self.disk = disk
+        self.fleet = fleet
         # Bytes one capacity unit represents (the engine passes its
         # kv_bytes_per_block): NON-KV paged objects charge the pools
         # ceil(bytes/unit) so the blocks-denominated budget stays a byte
@@ -319,8 +500,19 @@ class TierStack:
         self.unit_bytes = unit_bytes
         if host is not None and disk is not None:
             host._spill = disk.put
+        elif host is not None and fleet is not None:
+            host._spill = fleet.put
+        if disk is not None and fleet is not None:
+            disk._spill = fleet.adopt_file
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
+
+    def set_event_sink(self, cb) -> None:
+        """Attach one ``cb(kind, tier, hashes)`` residency sink across all
+        tiers (module header) — the fleet directory publisher's feed."""
+        for pool in (self.host, self.disk, self.fleet):
+            if pool is not None:
+                pool.event_sink = cb
 
     def _object_weight(self, pages: tuple) -> int:
         if not self.unit_bytes:
@@ -330,7 +522,8 @@ class TierStack:
 
     @property
     def enabled(self) -> bool:
-        return self.host is not None or self.disk is not None
+        return (self.host is not None or self.disk is not None
+                or self.fleet is not None)
 
     def offload(self, pairs: list[tuple],
                 protected: list[bool] | None = None) -> int:
@@ -347,6 +540,8 @@ class TierStack:
                 self.host.put(seq_hash, *pages, protected=prot)
             elif self.disk is not None:
                 self.disk.put(seq_hash, *pages, protected=prot)
+            elif self.fleet is not None:
+                self.fleet.put(seq_hash, *pages, protected=prot)
             n += 1
         self.offloaded_blocks += n
         return n
@@ -374,6 +569,9 @@ class TierStack:
         if self.disk is not None:
             hits += self.disk.hits
             misses += self.disk.misses
+        if self.fleet is not None:
+            hits += self.fleet.hits
+            misses += self.fleet.misses
         total = hits + misses
         return hits / total if total else 0.0
 
@@ -390,17 +588,22 @@ class TierStack:
             self.host.put(obj_hash, *pages, protected=protected, weight=w)
         elif self.disk is not None:
             self.disk.put(obj_hash, *pages, protected=protected, weight=w)
+        elif self.fleet is not None:
+            self.fleet.put(obj_hash, *pages, protected=protected, weight=w)
 
     def get_object(self, obj_hash: int) -> tuple[np.ndarray, ...] | None:
-        """Fetch one paged object, promoting a G3 hit back into G2 (same
-        policy as lookup_run). Hit/miss counts feed tier_hit_rate."""
+        """Fetch one paged object, promoting a G3/G4 hit back into G2
+        (same policy as lookup_run). Hit/miss counts feed tier_hit_rate.
+        A G4 hit may have been written by a PEER engine — adapter tier
+        objects dedup fleet-wide under their synthetic hashes."""
         pages = self.host.get(obj_hash) if self.host is not None else None
         if pages is None and self.disk is not None:
             pages = self.disk.get(obj_hash)
-            if pages is not None and self.host is not None:
-                self.host.put(
-                    obj_hash, *pages, weight=self._object_weight(pages)
-                )
+        if pages is None and self.fleet is not None:
+            pages = self.fleet.get(obj_hash)
+        if pages is not None and self.host is not None and \
+                not self.host.contains(obj_hash):
+            self.host.put(obj_hash, *pages, weight=self._object_weight(pages))
         return pages
 
     def peek_run_len(self, hashes: list[int]) -> int:
@@ -411,6 +614,7 @@ class TierStack:
             if not (
                 (self.host is not None and self.host.contains(h))
                 or (self.disk is not None and self.disk.contains(h))
+                or (self.fleet is not None and self.fleet.contains(h))
             ):
                 break
             n += 1
@@ -420,12 +624,17 @@ class TierStack:
         out: list[tuple[np.ndarray, ...]] = []
         for h in hashes:
             pages = self.host.get(h) if self.host is not None else None
+            promoted = False
             if pages is None and self.disk is not None:
                 pages = self.disk.get(h)
-                if pages is not None and self.host is not None:
-                    self.host.put(h, *pages)
+                promoted = pages is not None
+            if pages is None and self.fleet is not None:
+                pages = self.fleet.get(h)
+                promoted = pages is not None
             if pages is None:
                 break
+            if promoted and self.host is not None:
+                self.host.put(h, *pages)
             out.append(pages)
         self.onboarded_blocks += len(out)
         return out
@@ -440,6 +649,8 @@ class TierStack:
             pages = self.host.get(h) if self.host is not None else None
             if pages is None and self.disk is not None:
                 pages = self.disk.get(h)
+            if pages is None and self.fleet is not None:
+                pages = self.fleet.get(h)
             if pages is None:
                 break
             out.append(pages)
@@ -451,6 +662,10 @@ class TierStack:
             "g2_hits": self.host.hits if self.host else 0,
             "g3_blocks": len(self.disk) if self.disk else 0,
             "g3_hits": self.disk.hits if self.disk else 0,
+            "g4_blocks": len(self.fleet) if self.fleet else 0,
+            "g4_hits": self.fleet.hits if self.fleet else 0,
+            "g4_dedup_blocks": self.fleet.dedup_blocks if self.fleet else 0,
+            "g4_evictions": self.fleet.evictions if self.fleet else 0,
             "offloaded_blocks": self.offloaded_blocks,
             "onboarded_blocks": self.onboarded_blocks,
             "protected_evictions": self.protected_evictions,

@@ -268,6 +268,13 @@ class EngineArgs:
     host_kv_blocks: int = 0
     disk_kv_dir: str | None = None
     disk_kv_blocks: int = 4096
+    # G4 fleet-SHARED pool: a directory mounted by EVERY engine (NFS,
+    # multi-engine-host tmpfs, fused object store). Blocks spill here
+    # from G3 keyed by the salted hash chain, so identical prefixes
+    # produced by different engines dedup to one file and any engine can
+    # onboard a peer's cold prefix without recompute or a live holder.
+    fleet_kv_dir: str | None = None
+    fleet_kv_blocks: int = 16384
     # Speculative decoding (engine/drafter.py + model.spec_verify): max
     # draft tokens verified per pass (0 = off). Decode is weight-
     # bandwidth-bound — one verify pass streams the weights ONCE and can

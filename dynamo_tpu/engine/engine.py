@@ -501,6 +501,22 @@ def register_engine_metrics(registry):
             "request requeues and re-prefills, so its stream stays "
             "byte-identical under greedy sampling)",
         ),
+        registry.counter(
+            "tier_g4_hits_total",
+            "G4 fleet-shared pool lookups that found the block file "
+            "(possibly written by a PEER engine — the cross-engine "
+            "dedup payoff)",
+        ),
+        registry.counter(
+            "tier_g4_evictions_total",
+            "G4 fleet-pool files pruned by this engine's oldest-mtime "
+            "capacity sweep of the SHARED directory",
+        ),
+        registry.counter(
+            "tier_g4_dedup_blocks_total",
+            "G4 puts/spill-adoptions skipped because a peer engine "
+            "already wrote the identical salted-hash block file",
+        ),
     )
 
 
@@ -707,7 +723,7 @@ class TpuEngine:
         # (proposed, accepted, tree passes, protected tier evictions,
         # budget reallocs, lora page-ins) already inc'd into the
         # registry counters.
-        self._ctr_pushed = [0, 0, 0, 0, 0, 0]
+        self._ctr_pushed = [0] * 9
 
     def bind_metrics(self, registry) -> None:
         """Attach the engine gauges to a MetricsRegistry; updated once
@@ -720,7 +736,8 @@ class TpuEngine:
         (g_win, g_first, g_pad, c_prop, c_acc, g_rate, g_tpp,
          g_kvb, g_kvq, c_tree, g_tree_depth, c_tier_prot, g_tier_hit,
          g_gram_seqs, g_gram_mask, c_budget,
-         g_lora_res, c_lora_swap, g_lora_s, c_preempt) = self._gauges
+         g_lora_res, c_lora_swap, g_lora_s, c_preempt,
+         c_g4_hit, c_g4_evict, c_g4_dedup) = self._gauges
         g_kvb.set(self.args.kv_bytes_per_block() * self.args.num_kv_blocks)
         g_kvq.set(1 if self.args.kv_quant == "int8" else 0)
         g_win.set(sum(1 for it in self._fetchq if isinstance(it, _Window)))
@@ -756,6 +773,15 @@ class TpuEngine:
                 c_lora_swap.inc(self._lora_pool.pageins - self._ctr_pushed[5])
                 self._ctr_pushed[5] = self._lora_pool.pageins
         g_lora_s.set(self.total_lora_s)
+        if self.tiers.fleet is not None:
+            fl = self.tiers.fleet
+            for i, (ctr, cur) in enumerate(
+                ((c_g4_hit, fl.hits), (c_g4_evict, fl.evictions),
+                 (c_g4_dedup, fl.dedup_blocks)), start=6,
+            ):
+                if cur > self._ctr_pushed[i]:
+                    ctr.inc(cur - self._ctr_pushed[i])
+                    self._ctr_pushed[i] = cur
         for cls, n in self.total_preemptions_by.items():
             pushed = self._preempt_pushed.get(cls, 0)
             if n > pushed:
@@ -771,7 +797,12 @@ class TpuEngine:
 
     @staticmethod
     def _build_tiers(args: EngineArgs):
-        from dynamo_tpu.block_manager.tiers import DiskBlockPool, HostBlockPool, TierStack
+        from dynamo_tpu.block_manager.tiers import (
+            DiskBlockPool,
+            FleetBlockPool,
+            HostBlockPool,
+            TierStack,
+        )
 
         host = HostBlockPool(args.host_kv_blocks) if args.host_kv_blocks > 0 else None
         disk = (
@@ -779,11 +810,16 @@ class TpuEngine:
             if args.disk_kv_dir
             else None
         )
+        fleet = (
+            FleetBlockPool(args.fleet_kv_dir, args.fleet_kv_blocks)
+            if args.fleet_kv_dir
+            else None
+        )
         # unit_bytes makes NON-KV paged objects (LoRA adapters) charge
         # the blocks-denominated capacity by their byte size — a 34 MB
         # 8B-geometry adapter costs ~50 block units, not 1, so the
         # host/disk byte budget the capacity was sized for holds.
-        return TierStack(host, disk, unit_bytes=args.kv_bytes_per_block())
+        return TierStack(host, disk, fleet, unit_bytes=args.kv_bytes_per_block())
 
     # -- lifecycle --------------------------------------------------------
 

@@ -239,6 +239,17 @@ def concat_page_run(
     )
 
 
+def split_page_run(pages: tuple, n_blocks: int) -> list[tuple]:
+    """Inverse of :func:`concat_page_run`: slice one batched page tuple
+    ([L, n_blocks, bs, …] on every array) back into per-block tuples
+    ([L, 1, bs, …]) for individual tier puts — the drain-on-retire
+    receiver stores each adopted block under its own hash."""
+    return [
+        tuple(np.ascontiguousarray(p[:, i : i + 1]) for p in pages)
+        for i in range(n_blocks)
+    ]
+
+
 # ---------------------------------------------------------------------------
 # Wire format (msgpack-safe dicts with raw bytes)
 # ---------------------------------------------------------------------------

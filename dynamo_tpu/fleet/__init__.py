@@ -69,6 +69,18 @@ def register_fleet_child_metrics(registry) -> dict:
         "decision_writes": registry.counter(
             "fleet_decision_writes_total", "Router decisions published to the shared cache"
         ),
+        "directory_entries": registry.gauge(
+            "fleet_kv_directory_entries",
+            "Block-residency entries in the global prefix directory "
+            "mirror (summed over every published worker holdings map)",
+        ),
+        "transfer_choices": registry.counter(
+            "fleet_kv_transfer_vs_recompute_total",
+            "Routed placements with a non-trivial missing prefix, by "
+            "economy outcome: choice=transfer (pull the run from a "
+            "directory-listed holder) vs choice=recompute (prefill it "
+            "locally)",
+        ),
     }
 
 

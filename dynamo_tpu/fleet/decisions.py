@@ -62,6 +62,8 @@ class RouterDecisionCache:
         self._mirror: dict[tuple[str, int], tuple[int, int]] = {}
         self._watch = None
         self._watch_task: asyncio.Task | None = None
+        self._workers_watch = None
+        self._workers_task: asyncio.Task | None = None
         self._lease_id: int | None = None
         self._lease_born = 0.0
         self._active_leases: list[int] = []
@@ -77,6 +79,60 @@ class RouterDecisionCache:
         self._watch_task = asyncio.get_running_loop().create_task(self._watch_loop())
         return self
 
+    async def watch_workers(self, namespace: str) -> None:
+        """Eagerly drop decisions for retired/dead workers. Worker
+        registrations (autoscaler/<ns>/workers/<lease hex>) are DELETEd
+        on retire and lease-reaped on death; without this watch the
+        decision entries only age out via decision_ttl, so post-scale-down
+        placements keep boosting a worker that no longer exists."""
+        from dynamo_tpu.planner.actuate import workers_prefix
+
+        self._workers_watch = await self.store.watch_prefix(
+            workers_prefix(namespace)
+        )
+        self._workers_task = asyncio.get_running_loop().create_task(
+            self._workers_loop()
+        )
+
+    async def _workers_loop(self) -> None:
+        try:
+            async for ev in self._workers_watch:
+                if ev.kind != EventKind.DELETE:
+                    continue
+                try:
+                    worker = int(ev.key.rsplit("/", 1)[-1], 16)
+                except ValueError:
+                    continue
+                self.drop_worker(worker)
+        except asyncio.CancelledError:
+            pass
+
+    def drop_worker(self, worker: int) -> None:
+        """Purge every mirror entry pointing at ``worker`` and delete the
+        store keys so peers and late-joining snapshots prune too (the
+        deletes race across frontends watching the same registration
+        prefix, but delete is idempotent)."""
+        dead = [k for k, v in self._mirror.items() if v[0] == worker]
+        if not dead:
+            return
+        for k in dead:
+            self._mirror.pop(k, None)
+        log.info("dropped %d decision(s) for dead worker %x", len(dead), worker)
+        if "entries" in self._m:
+            self._m["entries"].set(len(self._mirror))
+        if self._closed:
+            return
+        task = asyncio.get_running_loop().create_task(self._delete_keys(dead))
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    async def _delete_keys(self, keys: list[tuple[str, int]]) -> None:
+        for scope, h in keys:
+            with contextlib.suppress(Exception):
+                await self.store.delete(
+                    f"{route_prefix(self.fleet_id, scope)}{h:016x}"
+                )
+
     async def close(self, flush: bool = False) -> None:
         """Stop mirroring; ``flush=True`` (the SIGTERM drain path) revokes
         the active write leases so this process's entries vanish NOW
@@ -86,12 +142,14 @@ class RouterDecisionCache:
         self._closed = True
         for t in list(self._bg):
             t.cancel()
-        if self._watch_task is not None:
-            self._watch_task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._watch_task
-        if self._watch is not None:
-            await self._watch.cancel()
+        for task in (self._watch_task, self._workers_task):
+            if task is not None:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+        for watch in (self._watch, self._workers_watch):
+            if watch is not None:
+                await watch.cancel()
         if flush:
             for lease_id in self._active_leases:
                 with contextlib.suppress(Exception):

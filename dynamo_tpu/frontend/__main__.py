@@ -136,10 +136,11 @@ async def async_main(args) -> None:
             index_shards=args.index_shards,
         )
 
-    fleet_metrics = budget = decisions = None
+    fleet_metrics = budget = decisions = directory = None
     if fleet_child:
         from dynamo_tpu.fleet import register_fleet_child_metrics
         from dynamo_tpu.fleet.decisions import RouterDecisionCache
+        from dynamo_tpu.fleet.directory import PrefixDirectory
 
         fleet_metrics = register_fleet_child_metrics(rt.metrics)
         # Sticky routing across sibling processes: every KV placement is
@@ -154,7 +155,20 @@ async def async_main(args) -> None:
                 "writes": fleet_metrics["decision_writes"],
             },
         ).start()
+        # Eagerly purge decisions for retired/dead workers (their
+        # registration DELETE fires well before decision_ttl expires).
+        with contextlib.suppress(Exception):
+            await decisions.watch_workers(args.namespace or "dynamo")
         settings.decisions = decisions
+        # Global prefix directory: the ground-truth residency mirror
+        # behind transfer-vs-recompute routing (workers publish under
+        # --kv-directory on; an empty mirror is simply inert).
+        directory = await PrefixDirectory(
+            rt.store, args.namespace or "dynamo",
+            metrics={"entries": fleet_metrics["directory_entries"]},
+        ).start()
+        settings.directory = directory
+        settings.fleet_metrics = fleet_metrics
 
     acfg = rt.config.admission
     qcfg = rt.config.qos
@@ -373,6 +387,8 @@ async def async_main(args) -> None:
             await budget.close()  # return every held chunk NOW, not at lease TTL
         if decisions is not None:
             await decisions.close(flush=True)  # revoke decision leases NOW
+        if directory is not None:
+            await directory.close()
         log.info("frontend shutting down")
         await watcher.close()
         await manager.close()

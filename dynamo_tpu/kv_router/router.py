@@ -64,7 +64,7 @@ class KvPushRouter:
     """AsyncEngine shape over a DIRECT PushRouter."""
 
     def __init__(self, push_router: PushRouter, config: KvRouterConfig | None = None,
-                 event_sink=None, decisions=None):
+                 event_sink=None, decisions=None, directory=None, metrics=None):
         self.config = config or KvRouterConfig()
         # callable(KVHitRateEvent) — routing-quality observability
         # (reference: scheduler.rs KVHitRateEvent → components/metrics).
@@ -74,6 +74,15 @@ class KvPushRouter:
         # overlap floor, so a conversation's follow-up turn routes to the
         # engine holding its prefix no matter which process accepts it.
         self.decisions = decisions
+        # Global prefix directory (fleet/directory.py PrefixDirectory):
+        # ground-truth block residency for transfer-vs-recompute pricing.
+        # A worker's OWN directory run floors its overlap (the index only
+        # sees G1 events; the directory also knows its G2-G4 holdings),
+        # and the deepest run held by anyone ELSE prices as a transfer.
+        self.directory = directory
+        # Optional {"transfer_choices": counter} — the
+        # fleet_kv_transfer_vs_recompute_total{choice} feed.
+        self._m = metrics or {}
         self.push = push_router
         self.discovery = push_router.discovery
         self.messaging = push_router.messaging
@@ -174,8 +183,9 @@ class KvPushRouter:
     def _place(self, token_ids: list[int], excluded: set[int] = frozenset(),
                adapter_id: str | None = None):
         """Shared placement recipe: hash → overlap lookup → cost schedule.
-        → (Placement, hashes, per-worker overlap scores). Raises
-        NoInstancesError when no candidate.
+        → (Placement, hashes, per-worker overlap scores, eligible
+        workers, directory runs). Raises NoInstancesError when no
+        candidate.
 
         ``adapter_id`` salts the block hashes (tokens.adapter_hash_seed)
         exactly as the engines do, so stickiness and overlap scoring are
@@ -199,33 +209,77 @@ class KvPushRouter:
                 wid, depth = cached
                 if wid in workers and depth > overlaps.scores.get(wid, 0):
                     overlaps.scores[wid] = depth
-        placement = self.scheduler.schedule(workers, request_blocks, overlaps, self.active)
-        return placement, hashes, overlaps.scores, workers
+        dir_runs: dict[int, int] = {}
+        fetchable: dict[int, int] | None = None
+        if self.directory is not None:
+            dir_runs = {
+                wid: d for wid, d in self.directory.best_runs(hashes).items()
+                if wid not in excluded
+            }
+            if dir_runs:
+                for wid in workers:
+                    # Own holdings floor the overlap: the live index only
+                    # mirrors G1 events, the directory also knows the
+                    # worker's G2-G4 (and drained-in) residency.
+                    d = dir_runs.get(wid, 0)
+                    if d > overlaps.scores.get(wid, 0):
+                        overlaps.scores[wid] = d
+                # Per-candidate transferable depth: the deepest run some
+                # OTHER holder (any pool — a prefill-role engine serves
+                # kv_prefix too) could stream to it.
+                fetchable = {}
+                for w in workers:
+                    peer = max(
+                        (d for wid, d in dir_runs.items() if wid != w),
+                        default=0,
+                    )
+                    if peer:
+                        fetchable[w] = peer
+                fetchable = fetchable or None
+        placement = self.scheduler.schedule(
+            workers, request_blocks, overlaps, self.active, fetchable=fetchable
+        )
+        return placement, hashes, overlaps.scores, workers, dir_runs
 
     def _peer_hint(self, placement, scores: dict[int, int],
-                   eligible: list[int]) -> dict | None:
-        """G4 cross-worker reuse hint: the live, non-excluded worker
-        holding the most extra prefix blocks relative to the chosen
-        placement, if the gap clears ``peer_fetch_min_blocks``. The index
-        can lag discovery, so candidates are filtered to ``eligible``
-        (the same set placement chose from)."""
+                   eligible: list[int],
+                   dir_runs: dict[int, int] | None = None) -> dict | None:
+        """G4 cross-worker reuse hint: the workers holding the most extra
+        prefix blocks relative to the chosen placement, if the gap clears
+        ``peer_fetch_min_blocks``. Index-scored candidates are filtered
+        to ``eligible`` (the index can lag discovery); directory-listed
+        holders are lease-live by construction and may sit in OTHER pools
+        (a prefill-role or draining engine serves kv_prefix too, so it
+        need not be in the placement set). The hint carries every viable
+        holder deepest-first — the fetcher fails over down the list —
+        plus the legacy single-holder fields."""
         m = self.config.peer_fetch_min_blocks
         if m <= 0:
             return None
         live = set(eligible)
-        best_wid, best_overlap = None, placement.overlap_blocks + m - 1
+        cand: dict[int, int] = {}
         for wid, overlap in scores.items():
-            if wid != placement.worker and wid in live and overlap > best_overlap:
-                best_wid, best_overlap = wid, overlap
-        if best_wid is None:
+            if wid != placement.worker and wid in live:
+                cand[wid] = max(cand.get(wid, 0), int(overlap))
+        for wid, depth in (dir_runs or {}).items():
+            if wid != placement.worker:
+                cand[wid] = max(cand.get(wid, 0), int(depth))
+        floor = placement.overlap_blocks + m
+        ranked = sorted(
+            ((d, wid) for wid, d in cand.items() if d >= floor), reverse=True
+        )
+        if not ranked:
             return None
-        return {"instance_id": best_wid, "num_blocks": int(best_overlap)}
+        holders = [
+            {"instance_id": wid, "num_blocks": d} for d, wid in ranked[:3]
+        ]
+        return {**holders[0], "holders": holders}
 
     def find_best_match(self, token_ids: list[int],
                         adapter_id: str | None = None) -> tuple[int, int]:
         """→ (worker_instance_id, overlap_blocks) without routing — the
         reference's `query_instance_id` surface (kv_router.rs:225-264)."""
-        placement, _, _, _ = self._place(token_ids, adapter_id=adapter_id)
+        placement, _, _, _, _ = self._place(token_ids, adapter_id=adapter_id)
         return placement.worker, placement.overlap_blocks
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
@@ -258,7 +312,7 @@ class KvPushRouter:
         while attempts < self.config.max_attempts:
             attempts += 1
             try:
-                placement, hashes, scores, eligible = self._place(
+                placement, hashes, scores, eligible, dir_runs = self._place(
                     token_ids, excluded, adapter_id
                 )
             except NoInstancesError:
@@ -286,10 +340,21 @@ class KvPushRouter:
                 if user_ktp:
                     request["kv_transfer_params"] = user_ktp
                 else:
-                    hint = self._peer_hint(placement, scores, eligible)
+                    hint = self._peer_hint(placement, scores, eligible, dir_runs)
                     request["kv_transfer_params"] = (
                         {"peer_prefix": hint} if hint is not None else None
                     )
+                    if (
+                        self.directory is not None
+                        and "transfer_choices" in self._m
+                        and 0 < self.config.peer_fetch_min_blocks
+                        <= placement.total_blocks - placement.overlap_blocks
+                    ):
+                        # Economy outcome for a non-trivially-missing
+                        # prefix: pull it from a holder, or prefill it.
+                        self._m["transfer_choices"].inc(
+                            choice="transfer" if hint else "recompute"
+                        )
             self.active.add_request(
                 context.id, wid, placement.total_blocks, placement.overlap_blocks, len(token_ids)
             )

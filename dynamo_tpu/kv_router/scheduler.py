@@ -6,6 +6,16 @@ potential_decode_blocks`` per worker, min-max normalized, then
 softmax-sampled with ``router_temperature`` (0 ⇒ deterministic argmin;
 scheduler.rs:272-340,356-439). Temperature>0 spreads bursts of identical
 prompts across workers instead of herding them onto one.
+
+Transfer-vs-recompute pricing: when a global prefix directory is live
+(fleet/directory.py) the router also passes each candidate's FETCHABLE
+depth — prefix blocks it is missing locally but could pull from a
+directory-listed holder over the credit-flow transfer plane
+(llm/peer_kv.py). Those blocks are priced at ``transfer_block_cost``
+(< 1.0: a DMA'd block is cheaper than recomputing it, Mooncake's
+transfer-vs-compute tradeoff) instead of full recompute, so a cold but
+idle engine next to a warm peer can beat a warm but saturated one —
+the directory stops being a stickiness booster and becomes an economy.
 """
 
 from __future__ import annotations
@@ -24,6 +34,13 @@ WorkerId = int
 class KvSchedulerConfig:
     overlap_score_weight: float = 1.0
     router_temperature: float = 0.0
+    # Cost of pulling one missing prefix block from a peer, in units of
+    # recomputing one block locally (0 = transfers are free, 1 = no
+    # cheaper than recompute — directory pricing effectively off).
+    # ~0.35 matches the measured peer-fetch vs prefill ratio on the
+    # loopback transfer plane (BENCH_DISAGG_r08 frame throughput vs
+    # prefill tok/s); a WAN-separated fleet wants it near 1.
+    transfer_block_cost: float = 0.35
 
 
 @dataclass
@@ -31,6 +48,9 @@ class Placement:
     worker: WorkerId
     overlap_blocks: int
     total_blocks: int
+    # Blocks the chosen worker should PULL from a peer (directory-priced
+    # transfer); 0 when the plain overlap path won.
+    fetch_blocks: int = 0
 
 
 class KvScheduler:
@@ -44,25 +64,48 @@ class KvScheduler:
         request_blocks: int,
         overlaps: OverlapScores,
         active: ActiveSequences,
+        fetchable: dict[WorkerId, int] | None = None,
     ) -> Placement:
-        """Pick a worker for a request spanning ``request_blocks`` blocks."""
+        """Pick a worker for a request spanning ``request_blocks`` blocks.
+
+        ``fetchable`` maps worker → the deepest leading-run depth any
+        OTHER directory-listed holder has for this request (absolute
+        blocks from the root); the part beyond the worker's own overlap
+        is what a transfer would save, priced at transfer_block_cost."""
         if not workers:
             raise ValueError("no workers")
         costs: list[float] = []
         for w in workers:
             overlap = min(overlaps.scores.get(w, 0), request_blocks)
-            potential_prefill = request_blocks - overlap
+            fetch = self._fetch_blocks(w, overlap, request_blocks, fetchable)
+            potential_prefill = (
+                request_blocks
+                - overlap
+                - fetch
+                + self.config.transfer_block_cost * fetch
+            )
             potential_decode = active.active_blocks(w) + request_blocks
             costs.append(
                 self.config.overlap_score_weight * potential_prefill + potential_decode
             )
         idx = softmax_sample(costs, self.config.router_temperature, self._rng)
         w = workers[idx]
+        overlap = min(overlaps.scores.get(w, 0), request_blocks)
         return Placement(
             worker=w,
-            overlap_blocks=min(overlaps.scores.get(w, 0), request_blocks),
+            overlap_blocks=overlap,
             total_blocks=request_blocks,
+            fetch_blocks=self._fetch_blocks(w, overlap, request_blocks, fetchable),
         )
+
+    @staticmethod
+    def _fetch_blocks(
+        w: WorkerId, overlap: int, request_blocks: int,
+        fetchable: dict[WorkerId, int] | None,
+    ) -> int:
+        if not fetchable:
+            return 0
+        return max(0, min(fetchable.get(w, 0), request_blocks) - overlap)
 
 
 def softmax_sample(costs: list[float], temperature: float, rng: random.Random) -> int:

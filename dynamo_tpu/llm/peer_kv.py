@@ -108,13 +108,25 @@ class PeerPrefixFetcher:
 
     async def _fetch(self, req: dict, hint: dict, ctx: Context) -> dict | None:
         """→ wire KvPagePayload dict (with ``block_offset``) | None
-        (local prefill fallback)."""
+        (local prefill fallback).
+
+        Multi-holder failover: a directory-built hint carries a
+        ``holders`` list deepest-first; each is tried in turn on a
+        declined/failed stream (a holder can evict or die between the
+        frontend's pricing and this fetch). A legacy single-holder hint
+        is the one-element case."""
         try:
             tokens = list(req.get("token_ids") or [])
             adapter_id = req.get("adapter_id")
             bs = self.engine.args.block_size
             max_hit = (len(tokens) - 1) // bs
-            want = min(int(hint.get("num_blocks") or 0), max_hit)
+            holders = hint.get("holders") or [
+                {"instance_id": hint.get("instance_id"),
+                 "num_blocks": hint.get("num_blocks")}
+            ]
+            want = min(
+                max(int(h.get("num_blocks") or 0) for h in holders), max_hit
+            )
             # Adapter-salted like every other KV identity consumer: the
             # peer's tiers key adapter KV under the same salted hashes.
             hashes = compute_block_hashes(
@@ -135,23 +147,37 @@ class PeerPrefixFetcher:
             # Frames assemble through the shared data-plane chunk reader
             # (dynamo_tpu/transfer), the same one the streaming disagg
             # pull uses; a declined stream raises the typed TransferError.
-            try:
-                payload = await read_kv_payload_frames(
-                    self.fetch_router.generate(
-                        {"hashes": hashes[covered:]}, Context(trace=ctx.trace),
-                        instance_id=hint["instance_id"],
+            payload = None
+            for holder in holders:
+                source = int(holder.get("instance_id") or 0)
+                run = min(int(holder.get("num_blocks") or 0), max_hit)
+                if run <= covered or not source:
+                    continue
+                try:
+                    payload = await read_kv_payload_frames(
+                        self.fetch_router.generate(
+                            {"hashes": hashes[covered:run]},
+                            Context(trace=ctx.trace),
+                            instance_id=source,
+                        )
                     )
-                )
-            except TransferError as e:
-                self.peer_fetch_failures += 1
-                log.debug("peer prefix fetch declined: %s", e)
-                return None
-            if payload.num_tokens <= 0:
+                except TransferError as e:
+                    self.peer_fetch_failures += 1
+                    log.debug("peer prefix fetch from %x declined: %s", source, e)
+                    continue
+                except Exception as e:  # noqa: BLE001 — failover: the holder died mid-stream; the next may still serve
+                    self.peer_fetch_failures += 1
+                    log.debug("peer prefix fetch from %x failed: %s", source, e)
+                    continue
+                if payload.num_tokens > 0:
+                    break
+                payload = None
+            if payload is None:
                 return None
             self.peer_fetches += 1
             log.info(
                 "peer prefix: fetched %d blocks from %x (offset %d)",
-                payload.k.shape[1], hint["instance_id"], covered,
+                payload.k.shape[1], source, covered,
             )
             out = payload.to_dict()
             out["block_offset"] = covered

@@ -40,6 +40,15 @@ class RouterSettings:
     # store-backed RouterDecisionCache per frontend process, scoped per
     # model for each KvPushRouter. None outside fleet mode.
     decisions: Any | None = None
+    # Global prefix directory (fleet/directory.py PrefixDirectory):
+    # block-hash → holders residency mirror for transfer-vs-recompute
+    # routing. One per frontend process, shared across every model's
+    # router (engine hashes are already adapter/model-salted).
+    directory: Any | None = None
+    # Fleet-series registry handles the routers should feed (the
+    # fleet_kv_transfer_vs_recompute_total counter). None outside fleet
+    # mode.
+    fleet_metrics: Any | None = None
 
 
 class _RouterEngine:
@@ -141,9 +150,15 @@ class ModelPipeline:
                 self.settings.decisions.scoped(self.card.slug)
                 if self.settings.decisions is not None else None
             )
+            fm = self.settings.fleet_metrics or {}
             self.kv_router = await KvPushRouter(
                 push, kv_cfg, event_sink=self._make_hit_rate_sink(),
                 decisions=decisions,
+                directory=self.settings.directory,
+                metrics=(
+                    {"transfer_choices": fm["transfer_choices"]}
+                    if "transfer_choices" in fm else None
+                ),
             ).start()
             engine = self.kv_router
         else:

@@ -169,8 +169,15 @@ async def operate_main(args) -> None:
         rt.namespace(args.namespace).component(ADMIN_COMPONENT)
         .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
     )
+    # Cache-aware scale-down: when engines publish residency
+    # (--kv-directory on) the victim choice consults measured prefix
+    # heat; an empty mirror degrades to the age heuristic for free.
+    from dynamo_tpu.fleet.directory import PrefixDirectory
+
+    heat_source = await PrefixDirectory(rt.store, args.namespace).start()
     pool_actuator = RuntimeActuator(
-        rt.store, args.namespace, admin_router, launcher=launcher
+        rt.store, args.namespace, admin_router, launcher=launcher,
+        heat_source=heat_source,
     )
     fleet_actuator = (
         FleetHttpActuator(args.fleet_admin) if args.fleet_admin else None
@@ -201,6 +208,7 @@ async def operate_main(args) -> None:
             loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     await auto.stop()
+    await heat_source.close()
     if launcher is not None:
         await launcher.close()
     await rt.shutdown()

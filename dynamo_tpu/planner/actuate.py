@@ -97,12 +97,17 @@ class RuntimeActuator:
     the ``workerctl/admin`` endpoint."""
 
     def __init__(self, store, namespace: str, admin_router,
-                 launcher=None, converge_timeout_s: float = 120.0):
+                 launcher=None, converge_timeout_s: float = 120.0,
+                 heat_source=None):
         self.store = store
         self.namespace = namespace
         self.admin_router = admin_router
         self.launcher = launcher
         self.converge_timeout_s = converge_timeout_s
+        # Cache-aware victim choice: a fleet/directory.py PrefixDirectory
+        # (or anything with .heat(instance_id) → float). None keeps the
+        # age heuristic.
+        self.heat_source = heat_source
 
     async def pools(self) -> dict[str, list[WorkerInfo]]:
         return await read_pools(self.store, self.namespace)
@@ -160,8 +165,33 @@ class RuntimeActuator:
         candidates = pools.get(role, [])
         if not candidates:
             raise ScaleActionError(f"no workers in pool {role!r}")
-        # Newest first: the youngest worker holds the least KV/prefix
-        # state, so moving/retiring it wastes the least warm cache.
+        return self._coldest(candidates)
+
+    def _coldest(self, candidates: list[WorkerInfo]) -> WorkerInfo:
+        """The candidate whose removal wastes the least warm cache.
+
+        With a prefix directory wired, that is MEASURED: minimum
+        exclusivity-weighted resident-prefix heat (a worker whose blocks
+        are replicated on peers or spilled to G4 scores near zero even
+        if it is old). Ties — and the no-directory case — fall back to
+        newest-first, the age proxy for the same thing."""
+        if self.heat_source is not None:
+            try:
+                heats = {
+                    w.key: float(self.heat_source.heat(w.instance_id))
+                    for w in candidates
+                }
+                coldest = min(heats.values())
+                cold = [w for w in candidates if heats[w.key] == coldest]
+                if len(cold) > 1 or coldest > 0.0:
+                    log.info(
+                        "victim heat: %s → picking %s",
+                        {k: round(v, 2) for k, v in heats.items()},
+                        cold[-1].key,
+                    )
+                return cold[-1]  # tie → newest
+            except Exception as e:  # noqa: BLE001 — a degraded directory must not block scale-down; age heuristic still converges
+                log.warning("heat source failed (%s); age heuristic", e)
         return candidates[-1]
 
     async def move(self, action: PoolMove) -> None:
@@ -220,7 +250,7 @@ class RuntimeActuator:
                 ]
                 if not candidates or len(pools.get(action.pool, ())) <= action.target:
                     break
-                victim = candidates[-1]  # newest un-retired
+                victim = self._coldest(candidates)
                 await self._retire(victim)
                 retired.add(victim.key)
             await self._wait(

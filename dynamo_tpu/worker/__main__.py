@@ -157,6 +157,16 @@ def parse_args(argv=None):
                    help="G2 host-RAM KV tier capacity in blocks (0 = off)")
     p.add_argument("--disk-kv-dir", default=None, help="G3 disk KV tier directory")
     p.add_argument("--disk-kv-blocks", type=int, default=4096)
+    p.add_argument("--fleet-kv-dir", default=None,
+                   help="G4 fleet-SHARED KV pool directory (mounted by "
+                        "every engine; salted-hash-keyed files dedup "
+                        "across the fleet, block_manager/tiers.py)")
+    p.add_argument("--fleet-kv-blocks", type=int, default=16384)
+    p.add_argument("--kv-directory", choices=["on", "off"], default="off",
+                   help="publish this engine's KV block residency to the "
+                        "global prefix directory (fleet/directory.py) so "
+                        "frontends can price transfer-vs-recompute and "
+                        "the autoscaler sees cache heat")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
@@ -414,7 +424,25 @@ async def async_main(args) -> None:
         engine.bind_metrics(rt.metrics)
 
     broadcaster = KvEventBroadcaster(engine.pool)
-    engine.pool.set_event_sink(broadcaster.publish)
+    publisher = None
+    if args.kv_directory == "on":
+        # Global prefix directory (fleet/directory.py): mirror this
+        # engine's block residency — G1 from the pool event stream, the
+        # host/disk/fleet tiers from the TierStack sink — so frontends
+        # can price transfer-vs-recompute and the autoscaler sees heat.
+        from dynamo_tpu.fleet.directory import DirectoryPublisher
+
+        publisher = await DirectoryPublisher(
+            rt.store, args.namespace, await rt.primary_lease()
+        ).start()
+        engine.pool.set_event_sink(
+            lambda ev: (broadcaster.publish(ev), publisher.pool_sink(ev))
+        )
+        tiers = getattr(engine, "tiers", None)
+        if tiers is not None and hasattr(tiers, "set_event_sink"):
+            tiers.set_event_sink(publisher.tier_sink)
+    else:
+        engine.pool.set_event_sink(broadcaster.publish)
 
     manager = None
     if args.autoscaler == "on":
@@ -454,6 +482,9 @@ async def async_main(args) -> None:
                 await t
         log.info("worker shutting down")
         await manager.close()
+        if publisher is not None:
+            with contextlib.suppress(Exception):
+                await publisher.close()
         if trace_exporter is not None:
             with contextlib.suppress(Exception):
                 await trace_exporter.close()
@@ -589,6 +620,9 @@ async def async_main(args) -> None:
             loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     log.info("worker shutting down")
+    if publisher is not None:
+        with contextlib.suppress(Exception):
+            await publisher.close()
     if trace_exporter is not None:
         with contextlib.suppress(Exception):
             await trace_exporter.close()
@@ -643,6 +677,8 @@ def _engine_args(args, model):
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_dir=args.disk_kv_dir,
         disk_kv_blocks=args.disk_kv_blocks,
+        fleet_kv_dir=args.fleet_kv_dir,
+        fleet_kv_blocks=args.fleet_kv_blocks,
     )
 
 

@@ -61,6 +61,13 @@ class WorkerRoleManager:
     namespace (component names + disagg knobs); ``cards`` is the model
     card list the decode role publishes (base card first)."""
 
+    #: Max blocks a retiring replica pushes to survivors (drain-on-retire,
+    #: docs/performance.md "Fleet KV economy"). Bounds the retirement
+    #: latency the autoscaler observes: the drain is an optimization, not
+    #: a durability guarantee — anything past the budget re-enters the
+    #: fleet through G4 or recompute.
+    DRAIN_BUDGET_BLOCKS = 256
+
     def __init__(self, rt, engine, cards, args, broadcaster, chaos=None):
         self.rt = rt
         self.engine = engine
@@ -159,6 +166,7 @@ class WorkerRoleManager:
             log.info("retiring (%s)", self.role)
             if relocate:
                 await self._relocate_running()
+            await self._drain_hot_kv()
             await self._deactivate()
             try:
                 await self.rt.store.delete(
@@ -217,6 +225,138 @@ class WorkerRoleManager:
         if moved or kept:
             log.info("relocation: %d moved, %d left to drain", moved, kept)
         return {"relocated": moved, "kept": kept}
+
+    # -- drain-on-retire KV handoff -----------------------------------------
+
+    def _hot_chains(self) -> list[list[int]]:
+        """Root→leaf block-hash chains from the radix pool snapshot,
+        deepest first, each truncated to its tier-resident leading run
+        (``kv_prefix`` serves from the tiers, not HBM — but write-through
+        offload keeps the tiers current for sealed blocks)."""
+        snap = self.engine.pool.snapshot()
+        parent = {h: p for h, p in snap}
+        inner = {p for _, p in snap if p is not None}
+        chains: list[list[int]] = []
+        for leaf in (h for h in parent if h not in inner):
+            chain: list[int] = []
+            h: int | None = leaf
+            while h is not None and h in parent:
+                chain.append(h)
+                h = parent[h]
+            chain.reverse()
+            run = self.engine.tiers.peek_run_len(chain)
+            if run:
+                chains.append(chain[:run])
+        chains.sort(key=len, reverse=True)
+        return chains
+
+    async def _drain_hot_kv(self) -> dict:
+        """Push this worker's warm prefixes to surviving decode peers
+        before the endpoints deregister — the retirement half of the
+        fleet KV economy: a scale-down must not cold-start the very
+        prefixes that made this replica the victim's *survivors* hot.
+
+        Each survivor PULLS the pages itself (``kv_adopt`` admin RPC →
+        our still-registered ``kv_prefix`` endpoint), so the transfer
+        rides the same bounded-frame data plane as routed peer fetches,
+        and the survivor's tier puts republish directory residency.
+        Best-effort throughout: any failure (peer gone, RPC timeout,
+        this process dying mid-drain) degrades to a plain retire."""
+        try:
+            tiers = getattr(self.engine, "tiers", None)
+            pool = getattr(self.engine, "pool", None)
+            if (tiers is None or not getattr(tiers, "enabled", False)
+                    or pool is None or not hasattr(pool, "snapshot")):
+                return {}
+            peers = await self._peers()
+            if not peers:
+                return {}
+            from dynamo_tpu.runtime.engine import Context
+            from dynamo_tpu.runtime.push_router import RouterMode
+
+            admin = await (
+                self.rt.namespace(self.namespace).component(ADMIN_COMPONENT)
+                .endpoint(ADMIN_ENDPOINT).router(RouterMode.DIRECT)
+            )
+            me = await self.rt.primary_lease()
+            budget = self.DRAIN_BUDGET_BLOCKS
+            sent: set[int] = set()
+            drained = 0
+            for i, chain in enumerate(self._hot_chains()):
+                if budget <= 0:
+                    break
+                hashes = [h for h in chain if h not in sent][:budget]
+                if not hashes:
+                    continue
+                peer = peers[i % len(peers)]
+                res: dict = {}
+                try:
+                    async for item in admin.generate(
+                        {"cmd": "kv_adopt", "hashes": hashes,
+                         "source_component": self.args.component,
+                         "source_instance": me},
+                        Context(), instance_id=peer,
+                    ):
+                        res = item or {}
+                except Exception as e:  # noqa: BLE001 — a dead survivor just forfeits its share of the drain
+                    log.debug("kv drain to %x failed: %s", peer, e)
+                    continue
+                n = int(res.get("adopted") or 0)
+                if n:
+                    sent.update(hashes[:n])
+                    budget -= n
+                    drained += n
+            if drained:
+                log.info(
+                    "hot-KV drain: %d blocks adopted by %d survivor(s)",
+                    drained, len(peers),
+                )
+            return {"drained": drained}
+        except Exception as e:  # noqa: BLE001 — the drain is an optimization; retirement must proceed
+            log.warning("hot-KV drain failed (%s); retiring without it", e)
+            return {}
+
+    async def _kv_adopt_cmd(self, payload: dict) -> dict:
+        """``{"cmd": "kv_adopt", "hashes", "source_component",
+        "source_instance"}`` — adopt a retiring peer's warm prefix run:
+        pull the pages from its ``kv_prefix`` endpoint and store them in
+        our own tiers (protected, so the adopted prefix survives the
+        next one-off-prompt burst). → {"ok", "adopted": n}."""
+        tiers = getattr(self.engine, "tiers", None)
+        if tiers is None or not getattr(tiers, "enabled", False):
+            return {"error": "no kv tiers on this worker"}
+        hashes = [int(h) for h in payload.get("hashes") or []]
+        source = int(payload.get("source_instance") or 0)
+        component = payload.get("source_component") or self.args.component
+        if not hashes or not source:
+            return {"ok": True, "adopted": 0}
+        from dynamo_tpu.engine.kv_transfer import split_page_run
+        from dynamo_tpu.llm.peer_kv import KV_PREFIX_ENDPOINT
+        from dynamo_tpu.runtime.engine import Context
+        from dynamo_tpu.runtime.push_router import RouterMode
+        from dynamo_tpu.transfer.stream import TransferError, read_kv_payload_frames
+
+        router = await (
+            self.rt.namespace(self.namespace).component(component)
+            .endpoint(KV_PREFIX_ENDPOINT).router(RouterMode.DIRECT)
+        )
+        try:
+            kv = await read_kv_payload_frames(
+                router.generate({"hashes": hashes}, Context(), instance_id=source)
+            )
+        except TransferError as e:
+            return {"ok": False, "reason": str(e)}
+        if kv.num_tokens <= 0:
+            return {"ok": True, "adopted": 0}
+        pages = kv.pages()
+        blocks = split_page_run(pages, pages[0].shape[1])
+        pairs = [(h, *blk) for h, blk in zip(hashes, blocks)]
+        step = tiers.MAX_OFFLOAD_PER_STEP
+        adopted = 0
+        for i in range(0, len(pairs), step):
+            chunk = pairs[i : i + step]
+            adopted += tiers.offload(chunk, protected=[True] * len(chunk))
+        return {"ok": True, "adopted": adopted}
 
     async def _offer_migration(self, request_id: str) -> None:
         """Engine preemption-offer hook target: try to relocate the
@@ -441,6 +581,8 @@ class WorkerRoleManager:
                 asyncio.get_running_loop().create_task(self.retire(relocate=relocate))
             elif cmd == "migrate_out":
                 yield await self._migrate_out_cmd(payload)
+            elif cmd == "kv_adopt":
+                yield await self._kv_adopt_cmd(payload)
             elif cmd == "migrate_in_start":
                 if self.receiver is None:
                     yield {"error": "no migration receiver"}

@@ -1,0 +1,42 @@
+"""Tier-1 guard for ``bench.py --workload shared-prefix --fleet``: the
+two-engine fleet A/B (global prefix directory + transfer-vs-recompute
+routing vs per-engine-only) must run end to end at smoke shapes, keep
+token-identical streams in both arms, and end with the drain-on-retire
+proof — a retiring replica's hot prefix serving a directory-routed hit
+on the survivor before any recompute.
+
+No timing or ratio assertions: --quick makes no throughput claims.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_fleet_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--workload", "shared-prefix", "--fleet", "--quick"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout + proc.stderr[-2000:]
+    result = json.loads(lines[-1])
+    assert "error" not in result, result
+    # Both arms decode the identical greedy streams for every (user, turn).
+    assert result["parity"] is True
+    # The economy arm actually saved prefill work relative to baseline.
+    assert result["prefilled_true_fleet"] <= result["prefilled_true_baseline"]
+    # Retirement drained hot KV and the survivor served it from the
+    # directory before recomputing.
+    assert result["drain_adopted_blocks"] > 0
+    assert result["drained_prefix_hit"] is True
+    # The trajectory keys bench rounds compare.
+    for key in ("prefill_multiplier_fleet", "prefill_multiplier_baseline",
+                "ttft_p50_ms_fleet", "drain_served_blocks"):
+        assert key in result, key

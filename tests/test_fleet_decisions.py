@@ -125,6 +125,7 @@ def _router_with(decisions, index_scores, workers):
     r.discovery = _StubDiscovery(workers)
     r.scheduler = KvScheduler()
     r.active = ActiveSequences()
+    r.directory = None
     return r
 
 
@@ -144,7 +145,7 @@ def test_router_uses_cache_as_overlap_floor():
     tokens = list(range(32))  # 8 blocks at block_size 4
     # Cache says worker 2 holds 6 blocks; live index knows nothing.
     r = _router_with(_FixedDecisions((2, 6)), {}, [1, 2, 3])
-    placement, _hashes, scores, _workers = r._place(tokens)
+    placement, _hashes, scores, _workers, _runs = r._place(tokens)
     assert placement.worker == 2
     assert placement.overlap_blocks == 6
     assert scores[2] == 6
@@ -154,7 +155,7 @@ def test_router_live_index_beats_shallower_cache():
     tokens = list(range(32))
     # Index: worker 1 holds 7 blocks; cache: worker 2 holds 3.
     r = _router_with(_FixedDecisions((2, 3)), {1: 7}, [1, 2, 3])
-    placement, _, _, _ = r._place(tokens)
+    placement, _, _, _, _ = r._place(tokens)
     assert placement.worker == 1
 
 
@@ -162,6 +163,6 @@ def test_router_ignores_cached_dead_worker():
     tokens = list(range(32))
     # Cached worker 9 is not in the live set: boost must not apply.
     r = _router_with(_FixedDecisions((9, 6)), {1: 1}, [1, 2])
-    placement, _, scores, _ = r._place(tokens)
+    placement, _, scores, _, _ = r._place(tokens)
     assert placement.worker == 1
     assert 9 not in scores

@@ -1,0 +1,393 @@
+"""Fleet-wide KV economy (ISSUE 18): global prefix directory,
+transfer-vs-recompute pricing, the shared G4 tier, and drain-on-retire.
+
+Four seams, each tested at its own layer:
+- directory: publisher → store → watch-mirror convergence under holder
+  churn (eviction, re-publish, holder death via lease revoke);
+- pricing: the scheduler's transfer term over an overlap × fetchable ×
+  queue-depth grid (pure unit, no runtime);
+- G4: cross-engine dedup on the shared directory + mixed int8/float
+  block bridging through ``concat_page_run``;
+- drain-on-retire: a retiring replica hands its warm prefix to a
+  survivor (real engines over the runtime), and a mid-drain death
+  degrades to a plain retire.
+"""
+
+import asyncio
+import types
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.fleet.directory import DirectoryPublisher, PrefixDirectory
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, StoredBlock
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+from dynamo_tpu.runtime.store import connect_store
+
+BS = 4
+
+
+async def wait_for(cond, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        assert asyncio.get_running_loop().time() < deadline, "condition timed out"
+        await asyncio.sleep(interval)
+
+
+# ---------------------------------------------------------------------------
+# Directory: publish → mirror convergence under churn
+# ---------------------------------------------------------------------------
+
+
+def _stored(*hashes):
+    return KvCacheEvent.stored([StoredBlock(h, None) for h in hashes])
+
+
+def test_directory_mirror_converges_under_churn():
+    async def go():
+        store = await connect_store("memory://kvdir_churn")
+        pa = await DirectoryPublisher(store, "ns", 0xA1, flush_interval=0.05).start()
+        pb = await DirectoryPublisher(store, "ns", 0xB2, flush_interval=0.05).start()
+        mirror = await PrefixDirectory(store, "ns").start()
+        try:
+            # A holds 1,2,3 in G1; 2 also has a G2 write-through copy —
+            # the directory publishes the WARMEST tier per hash.
+            pa.pool_sink(_stored(1, 2, 3))
+            pa.tier_sink("stored", 2, [2])
+            await pa.flush()
+            await wait_for(lambda: mirror.holders(1) == {0xA1: 1})
+            assert mirror.holders(2) == {0xA1: 1}
+            assert mirror.run_depth(0xA1, [1, 2, 3]) == 3
+            assert mirror.run_depth(0xA1, [1, 9, 3]) == 1  # leading run only
+
+            # B publishes a shared hash + its own G4-resident block.
+            pb.pool_sink(_stored(2))
+            pb.tier_sink("stored", 4, [7])
+            await pb.flush()
+            await wait_for(lambda: len(mirror.holders(2)) == 2)
+            assert mirror.holders(7) == {0xB2: 4}
+            assert mirror.best_runs([2]) == {0xA1: 1, 0xB2: 1}
+
+            # Heat: A holds one exclusive warm block + shares 2; B's
+            # holdings are a shared block and a fleet-shared G4 copy —
+            # B is the cheaper victim.
+            assert mirror.heat(0xA1) > mirror.heat(0xB2)
+
+            # Churn: A evicts from HBM but keeps 2's G2 copy; the mirror
+            # tracks the demotion (tier 1 → 2), and a fully-dropped hash
+            # vanishes.
+            pa.pool_sink(KvCacheEvent.removed([1, 2]))
+            await pa.flush()
+            await wait_for(lambda: mirror.holders(1) == {})
+            assert mirror.holders(2) == {0xA1: 2, 0xB2: 1}
+
+            # Holder death: close revokes the lease → DELETE prunes the
+            # mirror before a doomed transfer could be priced against it.
+            await pb.close()
+            await wait_for(lambda: 0xB2 not in mirror.worker_ids())
+            assert mirror.holders(2) == {0xA1: 2}
+            assert mirror.heat(0xB2) == 0.0
+        finally:
+            await pa.close()
+            await pb.close()
+            await mirror.close()
+
+    asyncio.run(go())
+
+
+def test_directory_flush_loop_publishes_without_explicit_flush():
+    async def go():
+        store = await connect_store("memory://kvdir_loop")
+        pub = await DirectoryPublisher(store, "ns", 0xC3, flush_interval=0.05).start()
+        mirror = await PrefixDirectory(store, "ns").start()
+        try:
+            pub.pool_sink(_stored(11, 12))
+            await wait_for(lambda: mirror.run_depth(0xC3, [11, 12]) == 2)
+        finally:
+            await pub.close()
+            await mirror.close()
+
+    asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
+# Pricing: transfer term unit grid
+# ---------------------------------------------------------------------------
+
+
+def _sched(**kw):
+    import random
+
+    return KvScheduler(KvSchedulerConfig(**kw), rng=random.Random(0))
+
+
+def test_transfer_pricing_grid():
+    req = 8
+    overlaps = OverlapScores(scores={1: 2, 2: 0})
+    idle = ActiveSequences()
+
+    # No directory: the warm worker wins on overlap alone.
+    p = _sched().schedule([1, 2], req, overlaps, idle)
+    assert p.worker == 1 and p.fetch_blocks == 0
+
+    # Directory says a peer holds the whole prefix reachable from 2:
+    # 8 transfer-priced blocks (2.8 recompute-equivalents) beat worker
+    # 1's 6 full recomputes.
+    p = _sched().schedule([1, 2], req, overlaps, idle, fetchable={2: 8})
+    assert p.worker == 2 and p.fetch_blocks == 8 and p.overlap_blocks == 0
+
+    # transfer_block_cost = 1.0 switches the economy off: a transfer
+    # prices like a recompute, so real overlap wins again.
+    p = _sched(transfer_block_cost=1.0).schedule(
+        [1, 2], req, overlaps, idle, fetchable={2: 8}
+    )
+    assert p.worker == 1 and p.fetch_blocks == 0
+
+    # Fetch is the DELTA past the candidate's own overlap — never the
+    # blocks it already holds.
+    p = _sched().schedule(
+        [1], req, OverlapScores(scores={1: 4}), idle, fetchable={1: 6}
+    )
+    assert p.fetch_blocks == 2 and p.overlap_blocks == 4
+
+    # A fetchable run deeper than the request prices only request blocks.
+    p = _sched().schedule([1], req, OverlapScores(scores={1: 0}), idle,
+                          fetchable={1: 50})
+    assert p.fetch_blocks == req
+
+    # Queue depth still dominates: the transfer-capable worker is
+    # saturated, so the warm idle one wins despite the cheaper prefill.
+    busy = ActiveSequences()
+    busy.add_request("r0", 2, 40, 0, 160)
+    p = _sched().schedule([1, 2], req, overlaps, busy, fetchable={2: 8})
+    assert p.worker == 1
+
+    # Grid sanity: cost is monotonically non-increasing in fetchable
+    # depth for a fixed worker (deeper transferable prefix never hurts).
+    cfg = KvSchedulerConfig()
+    last = None
+    for depth in (0, 2, 4, 6, 8):
+        fetch = max(0, min(depth, req) - 2)
+        cost = cfg.overlap_score_weight * (
+            req - 2 - fetch + cfg.transfer_block_cost * fetch
+        ) + req
+        if last is not None:
+            assert cost <= last
+        last = cost
+
+
+# ---------------------------------------------------------------------------
+# G4: shared-directory dedup + mixed-format bridging
+# ---------------------------------------------------------------------------
+
+
+def _page(seed, bs=BS, heads=2, hd=4):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((1, 1, bs, heads * hd)).astype(np.float32)
+    v = rng.standard_normal((1, 1, bs, heads * hd)).astype(np.float32)
+    return k, v
+
+
+def test_g4_dedup_across_engines_and_capacity_sweep(tmp_path):
+    from dynamo_tpu.block_manager.tiers import FleetBlockPool
+
+    shared = str(tmp_path / "g4")
+    a = FleetBlockPool(shared, capacity_blocks=8)
+    b = FleetBlockPool(shared, capacity_blocks=8)
+    events = []
+    a.event_sink = lambda kind, tier, hashes: events.append((kind, tier, list(hashes)))
+
+    k, v = _page(0)
+    a.put(101, k, v)
+    assert a.contains(101)
+    assert ("stored", 4, [101]) in events
+    # Same salted hash ⇒ same bytes: engine B's put is a dedup, not a
+    # rewrite — the fleet pool stores one copy no matter who computed it.
+    b.put(101, *_page(0))
+    assert b.dedup_blocks == 1 and a.dedup_blocks == 0
+    got = b.get(101)
+    assert got is not None and np.allclose(got[0], k)
+    assert b.hits == 1
+
+    # Capacity sweep: each writer prunes the SHARED dir past the cap.
+    import os
+    import time
+
+    small = FleetBlockPool(str(tmp_path / "small"), capacity_blocks=2)
+    now = time.time()
+    for i, h in enumerate((1, 2, 3)):
+        small.put(h, *_page(h))
+        # Distinct mtimes so oldest-first eviction is deterministic.
+        os.utime(small._path(h), (now + i, now + i))
+    small._sweep()
+    assert small.evictions >= 1
+    assert small.get(1) is None  # oldest pruned
+    assert small.get(3) is not None
+
+
+def test_g4_mixed_int8_float_bridging_roundtrip(tmp_path):
+    from dynamo_tpu.block_manager.tiers import FleetBlockPool
+    from dynamo_tpu.engine.kv_transfer import (
+        concat_page_run,
+        dequantize_pages_np,
+        quantize_pages_np,
+        split_page_run,
+    )
+
+    pool = FleetBlockPool(str(tmp_path / "g4"), capacity_blocks=8)
+    heads = 2
+    k1, v1 = _page(1, heads=heads)
+    k2, v2 = _page(2, heads=heads)
+    # Block 1 written dense, block 2 written int8 (a dense-era shared dir
+    # reused by an int8 worker — both formats coexist under one run).
+    pool.put(201, k1, v1)
+    pool.put(202, *quantize_pages_np(k2, v2, heads))
+    run = [pool.get(201), pool.get(202)]
+    assert len(run[0]) == 2 and len(run[1]) == 4
+
+    # Bridge to dense: the int8 block dequantizes; values match within
+    # absmax-int8 tolerance.
+    dense = concat_page_run(run, quantized=False, num_kv_heads=heads,
+                            dtype="float32")
+    assert len(dense) == 2 and dense[0].shape[1] == 2
+    assert np.allclose(dense[0][:, :1], k1)
+    assert np.allclose(dense[0][:, 1:], k2, atol=0.02)
+
+    # Bridge to int8: the dense block quantizes; round-trip both back to
+    # float and compare against the originals.
+    quant = concat_page_run(run, quantized=True, num_kv_heads=heads,
+                            dtype="float32")
+    assert len(quant) == 4 and quant[0].shape[1] == 2
+    dk, dv = dequantize_pages_np(*quant, num_kv_heads=heads, dtype=np.float32)
+    assert np.allclose(dk[:, :1], k1, atol=0.02)
+    assert np.allclose(dv[:, 1:], v2, atol=0.02)
+
+    # split_page_run is concat's inverse (the kv_adopt receiver path).
+    blocks = split_page_run(dense, 2)
+    assert len(blocks) == 2 and blocks[0][0].shape[1] == 1
+    assert np.allclose(blocks[0][0], dense[0][:, :1])
+
+
+# ---------------------------------------------------------------------------
+# Drain-on-retire: warm prefix hands off to a survivor
+# ---------------------------------------------------------------------------
+
+
+PROMPT = [7 * i % 500 + 1 for i in range(23)]  # 5 full blocks + suffix
+
+
+def make_request(prompt=PROMPT, max_tokens=8):
+    from dynamo_tpu.llm.protocols import PreprocessedRequest
+
+    r = PreprocessedRequest(model="tiny", token_ids=list(prompt))
+    r.sampling.temperature = 0.0
+    r.sampling.seed = 0
+    r.stop.max_tokens = max_tokens
+    r.stop.ignore_eos = True
+    return r.to_dict()
+
+
+def _worker_args(namespace):
+    return types.SimpleNamespace(
+        namespace=namespace, component="backend", prefill_component="prefill",
+        endpoint="generate", engine="tpu", disagg="off", prefill_dispatch="pull",
+        max_local_prefill_length=0, no_disagg_stream=False,
+    )
+
+
+async def start_role_worker(store_url, namespace):
+    from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+    from dynamo_tpu.engine.engine import TpuEngine
+    from dynamo_tpu.kv_router.publisher import KvEventBroadcaster
+    from dynamo_tpu.planner.actions import POOL_DECODE
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.worker.roles import WorkerRoleManager
+
+    rt = await DistributedRuntime.create(store_url=store_url)
+    engine = await TpuEngine(EngineArgs(
+        model=ModelConfig(), block_size=BS, num_kv_blocks=64, max_num_seqs=4,
+        max_model_len=128, dtype="float32", decode_steps=2, host_kv_blocks=32,
+    )).start()
+    broadcaster = KvEventBroadcaster(engine.pool)
+    engine.pool.set_event_sink(broadcaster.publish)
+    mgr = await WorkerRoleManager(
+        rt, engine, [], _worker_args(namespace), broadcaster
+    ).start(POOL_DECODE)
+    return rt, engine, mgr
+
+
+def test_retiring_replica_drains_hot_kv_to_survivor():
+    """A generates (warm tiers), A retires: the survivor B must hold A's
+    prefix run afterwards and serve the same prompt with ONLY the suffix
+    prefilled — the drained prefix hits before any recompute."""
+
+    async def go():
+        from dynamo_tpu.runtime.engine import Context
+        from dynamo_tpu.tokens import compute_block_hashes
+
+        url = "memory://kvecon_drain"
+        rt_a, eng_a, mgr_a = await start_role_worker(url, "kvecon")
+        rt_b, eng_b, mgr_b = await start_role_worker(url, "kvecon")
+        try:
+            out_a = [x async for x in eng_a.generate(make_request(), Context())]
+            toks_a = [t for it in out_a for t in (it.get("token_ids") or [])]
+            assert len(toks_a) == 8
+            await wait_for(lambda: len(eng_a.tiers.host) >= 5)
+
+            await mgr_a.retire()
+            assert mgr_a.retired.is_set()
+
+            hashes = compute_block_hashes(PROMPT, BS)[:5]
+            assert eng_b.tiers.peek_run_len(hashes) == 5  # adopted
+
+            out_b = [x async for x in eng_b.generate(make_request(), Context())]
+            toks_b = [t for it in out_b for t in (it.get("token_ids") or [])]
+            assert toks_b == toks_a  # parity through the adopted pages
+            # Only the 3-token suffix was recomputed on the survivor.
+            assert eng_b.total_prefilled == len(PROMPT) - 5 * BS
+        finally:
+            await mgr_a.close()
+            await mgr_b.close()
+            await eng_a.stop()
+            await eng_b.stop()
+            await rt_a.shutdown()
+            await rt_b.shutdown()
+
+    asyncio.run(go())
+
+
+def test_mid_drain_death_degrades_to_plain_retire():
+    """The survivor dies mid-drain (its kv_adopt raises): retirement must
+    still complete — the drain is an optimization, never a gate."""
+
+    async def go():
+        from dynamo_tpu.runtime.engine import Context
+
+        url = "memory://kvecon_draindeath"
+        rt_a, eng_a, mgr_a = await start_role_worker(url, "kvecon2")
+        rt_b, eng_b, mgr_b = await start_role_worker(url, "kvecon2")
+        try:
+            _ = [x async for x in eng_a.generate(make_request(), Context())]
+            await wait_for(lambda: len(eng_a.tiers.host) >= 5)
+
+            async def dying(payload):
+                raise RuntimeError("survivor crashed mid-adopt")
+
+            mgr_b._kv_adopt_cmd = dying
+            await asyncio.wait_for(mgr_a.retire(), timeout=30)
+            assert mgr_a.retired.is_set()
+
+            # No survivors at all: B's own retire drains nowhere, fast.
+            await asyncio.wait_for(mgr_b.retire(), timeout=30)
+            assert mgr_b.retired.is_set()
+        finally:
+            await mgr_a.close()
+            await mgr_b.close()
+            await eng_a.stop()
+            await eng_b.stop()
+            await rt_a.shutdown()
+            await rt_b.shutdown()
+
+    asyncio.run(go())
