@@ -44,7 +44,7 @@ def parse_args():
     p.add_argument("--workload", default="lognormal-mixed",
                    choices=["lognormal-mixed", "fixed", "repetitive",
                             "shared-prefix", "structured", "multi-lora",
-                            "multi-tenant", "diurnal", "migrate"],
+                            "multi-tenant", "diurnal", "migrate", "skewed"],
                    help="lognormal-mixed = ShareGPT-like regression workload; "
                         "repetitive = agentic/extractive prompts with high "
                         "n-gram overlap (the speculation-friendly shape) — "
@@ -68,7 +68,12 @@ def parse_args():
                         "request force-relocated mid-decode between two "
                         "engines — cutover gap p50/p99, KV bytes moved, "
                         "chaos fallback rate, byte-identity pinned "
-                        "(benchmarks/migrate.py, docs/robustness.md)")
+                        "(benchmarks/migrate.py, docs/robustness.md); "
+                        "skewed = fleet hot-spot rebalancing A/B: one "
+                        "seeded schedule admitted entirely to engine A "
+                        "with B cold, balancer-on vs balancer-off at equal "
+                        "chip count, SLO-attaining tok/s + token parity "
+                        "(benchmarks/balance.py, docs/autoscaler.md)")
     p.add_argument("--spec-budget", choices=["adaptive", "uniform"],
                    default="adaptive",
                    help="per-pass draft-node allocation (engine "
@@ -2195,6 +2200,10 @@ def main():
             from benchmarks.migrate import bench_migrate
 
             result = asyncio.run(bench_migrate(args))
+        elif args.workload == "skewed":
+            from benchmarks.balance import bench_balance
+
+            result = asyncio.run(bench_balance(args))
         else:
             result = asyncio.run(bench(args))
     except Exception as e:  # noqa: BLE001 — bench must always print a line

@@ -1,0 +1,542 @@
+"""``bench.py --workload skewed`` — fleet hot-spot rebalancing A/B.
+
+Two REAL engines at equal chip count, one seeded skewed schedule: every
+stream is admitted to engine A (the cache-affinity / scale-up-lag skew —
+B registers a beat later, exactly the hot-spot shape ROADMAP item 3's
+remainder targets). Engine A is KV-TIGHT (a long-lived engine whose
+pool is mostly resident cache) while B is roomy — so the hot spot is
+the KV-pressure kind the tentpole's proactive-defrag arm exists for:
+statically, A thrashes (preempt → re-prefill churn) and its queue
+crawls at two effective rows; relocated decodes on B run against real
+free capacity. The A/B toggles ONE thing — whether the production
+:class:`FleetBalancer` loop runs:
+
+- **balancer off** — A serves the whole schedule through its admission
+  queue while B idles; queued streams pay wave after wave of batch
+  latency.
+- **balancer on** — the REAL BalancerLaw + FleetBalancer shell observe
+  both engines' live ``ForwardPassMetrics`` and actuate ``workerctl
+  migrate_out`` moves (victim auto-picked by the source, newest-first)
+  until the fleet levels; each move pays a real cutover stall over the
+  credit-flow stream plane — and frees an admission slot on A, so a
+  queued stream starts generating a full batch-wave earlier.
+
+Scored by SLO-attaining output tokens per second where the SLO is on
+TTFT — queueing delay is what a hot spot costs and what rebalancing
+buys back (Llumnix's headline axis: migration cuts tail/queueing
+latency at equal chip count; on a shared-core testbed aggregate decode
+throughput is invariant, so latency is also the only honest axis). The
+budget is calibrated from an unmigrated single-engine reference run —
+which also pins every stream byte-identical (``parity``), migrated or
+not. ``--quick`` shrinks the schedule for smoke use; the full run
+writes the BENCH_BALANCE_r19.json headline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineArgs, ModelConfig
+from dynamo_tpu.engine.engine import TpuEngine
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.llm.disagg import PrefillHandler
+from dynamo_tpu.llm.migration import Migration
+from dynamo_tpu.llm.protocols import PreprocessedRequest
+from dynamo_tpu.planner.actions import POOL_DECODE
+from dynamo_tpu.planner.balancer import (
+    BalancerConfig,
+    BalancerLaw,
+    FleetBalancer,
+    register_balancer_metrics,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+from dynamo_tpu.runtime.push_router import RouterMode
+from dynamo_tpu.worker.migrate import MigrationCoordinator, MigrationReceiver
+
+CFG = ModelConfig()  # control-plane bench: tiny model, real protocol
+
+
+def _args(**kw) -> EngineArgs:
+    defaults = dict(
+        model=CFG, block_size=4, num_kv_blocks=512, max_num_seqs=4,
+        max_model_len=512, max_prefill_tokens=128, dtype="float32",
+        decode_steps=4,
+    )
+    defaults.update(kw)
+    return EngineArgs(**defaults)
+
+
+def _request(prompt, max_tokens) -> PreprocessedRequest:
+    req = PreprocessedRequest(model="t", token_ids=list(prompt))
+    req.sampling.temperature = 0.0
+    req.sampling.seed = 0
+    req.stop.max_tokens = max_tokens
+    req.stop.ignore_eos = True
+    return req
+
+
+@dataclass
+class _Member:
+    instance_id: int
+
+
+async def _drain(stream) -> None:
+    async for _ in stream:
+        pass
+
+
+class _Worker:
+    """Engine + runtime created (and JIT-warmed) up front; endpoint
+    registration — router VISIBILITY — deferred to :meth:`serve`. The
+    A/B's skew is admission order, so B must exist and be warm before
+    the measured window (compile time is not the question) while
+    staying invisible until the schedule has landed on A."""
+
+    def __init__(self, rt, engine, receiver):
+        self.rt = rt
+        self.engine = engine
+        self.receiver = receiver
+        self.coordinator = None
+        self.instance_id = None
+
+    async def warm(self, prompt_len: int, gen_len: int) -> None:
+        """Compile every bucket the measured window can hit — batch-4
+        decode across the schedule's full sequence-length range, the
+        schedule's own prefill shape, AND every prefill bucket a
+        mid-stream resume can land in (a migrated-in sequence re-enters
+        as a prefill of ``prompt+delivered`` tokens, so its chunk shapes
+        range over all buckets up to ``max_prefill_tokens``) — so
+        neither arm pays JIT time mid-run. Long-running fleet engines
+        are warm; compile time is not the question here."""
+        rng = np.random.default_rng(7)
+        # A KV-tight engine can't hold a full warm wave — cap concurrency
+        # to what the pool fits (decode batch is slot-padded, so the
+        # compiled shape is the same at any live-row count).
+        per_stream = -(-(prompt_len + gen_len) // self.engine.args.block_size)
+        n_warm = max(1, min(4, self.engine.args.num_kv_blocks // per_stream))
+
+        async def one(prompt: list[int], glen: int) -> list[int]:
+            toks: list[int] = []
+            async for item in self.engine.generate(
+                _request(prompt, glen).to_dict(), Context()
+            ):
+                toks.extend(item.get("token_ids") or [])
+            return toks
+
+        def fresh(plen: int) -> list[int]:
+            return rng.integers(1, CFG.vocab_size - 1, size=plen).tolist()
+
+        await asyncio.gather(
+            *(one(fresh(prompt_len), gen_len) for _ in range(n_warm)))
+        max_pf = self.engine.args.max_prefill_tokens
+        lens, length = [], 16
+        while length <= max_pf:
+            lens.append(length)
+            length *= 2
+        # A resume past max_prefill_tokens chunks its prefill — one long
+        # prompt compiles the multi-chunk variants too.
+        lens.append(min(self.engine.args.max_model_len - gen_len,
+                        2 * max_pf + prompt_len))
+        await asyncio.gather(*(one(fresh(length), 8) for length in lens))
+        # Prefill-atop-prefix-cache — the exact shape a migrated-in
+        # sequence runs on its first destination step (every full block
+        # already cached, a short suffix of fresh query tokens): replay
+        # prompt+output at a short and a past-one-chunk total length.
+        for glen in (16, 2 * max_pf):
+            prompt = fresh(prompt_len)
+            out = await one(prompt, glen)
+            await one(prompt + out, 8)
+
+    async def serve(self) -> None:
+        engine, receiver = self.engine, self.receiver
+        comp = self.rt.namespace("balbench").component("backend")
+
+        async def gen_handler(payload, ctx):
+            if isinstance(payload, dict):
+                mr = (payload.get("kv_transfer_params") or {}).get("migration_resume")
+                if isinstance(mr, dict) and mr.get("handle"):
+                    staged = receiver.take(mr["handle"])
+                    if staged is not None:
+                        payload = dict(payload)
+                        ktp = dict(payload.get("kv_transfer_params") or {})
+                        ktp["inject"] = staged
+                        payload["kv_transfer_params"] = ktp
+            async for item in engine.generate(payload, ctx):
+                yield item
+
+        self._gen_comp = comp
+        self._gen_handler = gen_handler
+        gh = await comp.endpoint("generate").serve(gen_handler)
+        self._gh = gh
+        await comp.endpoint("kv_fetch").serve(PrefillHandler(engine).kv_fetch)
+
+        acomp = self.rt.namespace("balbench").component("workerctl")
+        coordinator = MigrationCoordinator(
+            engine,
+            await acomp.endpoint("admin").router(RouterMode.DIRECT),
+            "backend", gh.instance.instance_id,
+        )
+        self.coordinator = coordinator
+        self.instance_id = gh.instance.instance_id
+
+        async def admin(payload, ctx):
+            payload = payload or {}
+            cmd = payload.get("cmd")
+            try:
+                if cmd == "migrate_out":
+                    # Balancer-shaped command: no request_id → the
+                    # worker picks its cheapest victim (newest admission
+                    # = fewest KV blocks), the roles.py rule.
+                    request_id = payload.get("request_id")
+                    if not request_id:
+                        running = engine.list_running()
+                        if not running:
+                            yield {"ok": False, "reason": "no_running"}
+                            return
+                        request_id = running[-1]
+                    yield await coordinator.migrate_out(
+                        request_id, int(payload.get("dest_instance") or 0))
+                elif cmd == "migrate_in_start":
+                    yield await receiver.start_pull(
+                        payload.get("handle", ""),
+                        payload.get("source_component", ""),
+                        int(payload.get("source_instance") or 0))
+                elif cmd == "migrate_in_commit":
+                    yield await receiver.commit(
+                        payload.get("handle", ""), int(payload.get("kv_blocks") or 0))
+                elif cmd == "migrate_in_abort":
+                    yield await receiver.abort(payload.get("handle", ""))
+                else:
+                    yield {"error": f"unknown admin cmd {cmd!r}"}
+            except Exception as e:  # noqa: BLE001 — admin answers typed, never tears the endpoint down
+                yield {"error": f"{type(e).__name__}: {e}"}
+
+        await acomp.endpoint("admin").serve(admin)
+
+    async def hide(self) -> None:
+        """Deregister the generate endpoint (admin/kv_fetch stay up) so
+        the router stops seeing this worker — the A/B's admission skew."""
+        await self._gh.close()
+
+    async def show(self) -> None:
+        """Re-register generate under the SAME instance id (ids are
+        per-runtime, not per-registration) — the scale-up event."""
+        gh = await self._gen_comp.endpoint("generate").serve(self._gen_handler)
+        assert gh.instance.instance_id == self.instance_id
+        self._gh = gh
+
+    async def stop(self):
+        await self.receiver.close()
+        await self.engine.stop()
+        await self.rt.shutdown()
+
+
+async def _make_worker(url: str, prompt_len: int, gen_len: int,
+                       engine_kw: dict | None = None) -> _Worker:
+    rt = await DistributedRuntime.create(store_url=url)
+    engine = await TpuEngine(_args(**(engine_kw or {})), seed=0).start()
+    w = _Worker(rt, engine, MigrationReceiver(rt, "balbench"))
+    await w.warm(prompt_len, gen_len)
+    return w
+
+
+class _Cluster:
+    """A serves from the start; B is warm but joins (registers) only
+    after the schedule is admitted — the skew is in admission order,
+    not in the router."""
+
+    def __init__(self, url):
+        self.url = url
+
+    async def start(self, prompt_len: int, gen_len: int,
+                    kw_a: dict | None = None, kw_b: dict | None = None):
+        self.a = await _make_worker(self.url, prompt_len, gen_len, kw_a)
+        self.b = await _make_worker(self.url, prompt_len, gen_len, kw_b)
+        await self.a.serve()
+        await self.b.serve()
+        self.frt = await DistributedRuntime.create(store_url=self.url)
+        ns = self.frt.namespace("balbench")
+        push = await ns.component("backend").endpoint("generate").router(
+            RouterMode.DIRECT)
+        self.router = await KvPushRouter(
+            push, KvRouterConfig(block_size=4, use_kv_events=False)).start()
+        self.operator = Migration(self.router, migration_limit=3)
+        self.admin = await ns.component("workerctl").endpoint("admin").router(
+            RouterMode.DIRECT)
+        await self._warm_migrations(prompt_len, gen_len)
+        await self.b.hide()
+        return self
+
+    async def _warm_migrations(self, prompt_len: int, gen_len: int) -> None:
+        """Live migrations INTO each engine before the measured window:
+        the destination's inject kernel (staged KV pages → device pool)
+        and resume prefill compile on first use, per padded block-count
+        bucket — so each engine takes one handoff at a small, a mid, and
+        a near-full carried size. A long-running fleet engine has all of
+        these warm; compile time is not the question here."""
+        rng = np.random.default_rng(11)
+        todo = {(d, f) for d in (self.a.instance_id, self.b.instance_id)
+                for f in (0.1, 0.4, 0.7)}
+        for _ in range(24):
+            if not todo:
+                return
+            prompt = rng.integers(1, CFG.vocab_size - 1, size=prompt_len).tolist()
+            toks: list[int] = []
+
+            async def run():
+                async for item in self.operator.generate(
+                    _request(prompt, gen_len).to_dict(), Context()
+                ):
+                    toks.extend(item.get("token_ids") or [])
+
+            task = asyncio.get_running_loop().create_task(run())
+            await asyncio.sleep(0.02)
+            src, dst = self.a, self.b
+            if not src.engine.list_running():
+                src, dst = dst, src
+            frac = next((f for d, f in sorted(todo) if d == dst.instance_id),
+                        None)
+            if frac is None:  # this direction is done; burn the stream
+                await task
+                continue
+            while len(toks) < int(frac * gen_len) and not task.done():
+                await asyncio.sleep(0.005)
+            if not task.done():
+                last: dict = {}
+                async for frame in self.admin.generate(
+                    {"cmd": "migrate_out", "dest_instance": dst.instance_id},
+                    Context(), instance_id=src.instance_id,
+                ):
+                    if isinstance(frame, dict):
+                        last = frame
+                if last.get("ok"):
+                    todo.discard((dst.instance_id, frac))
+            await task
+        if todo:
+            raise RuntimeError(f"warm migrations incomplete: {sorted(todo)}")
+
+    async def add_b(self):
+        await self.b.show()
+
+    def workers(self):
+        return {w.instance_id: w for w in (self.a, self.b)}
+
+    async def stop(self):
+        await self.router.close()
+        await self.frt.shutdown()
+        await self.a.stop()
+        await self.b.stop()
+
+
+def _fleet_balancer(cluster: _Cluster, bmetrics: dict,
+                    refusals: list) -> FleetBalancer:
+    """The production shell over bench seams: live engine metrics in,
+    real admin migrate_out RPCs out."""
+    workers = cluster.workers()
+
+    async def pools():
+        return {POOL_DECODE: [_Member(iid) for iid in workers]}
+
+    async def load_source(instance_id: int):
+        return workers[instance_id].engine.metrics()
+
+    async def mover(src: int, dst: int) -> dict:
+        last: dict = {}
+        async for frame in cluster.admin.generate(
+            {"cmd": "migrate_out", "dest_instance": dst}, Context(),
+            instance_id=src,
+        ):
+            if isinstance(frame, dict):
+                last = frame
+        if not last.get("ok"):
+            refusals.append(str(last.get("reason") or last.get("error")))
+        return last
+
+    # Two-engine gates: one pair exists, so per-pair cooldown IS the
+    # move cadence; saturation keys off A's full batch + queue.
+    law = BalancerLaw(BalancerConfig(
+        saturation=0.6, idle=0.45, min_gap=0.1,
+        hysteresis_cycles=1, pair_cooldown_s=0.15, settle_s=0.15,
+        max_moves_per_cycle=1,
+    ))
+    return FleetBalancer(law, pools, load_source, mover, metrics=bmetrics)
+
+
+async def _arm(url, prompts, refs, gen_len, *, balance: bool,
+               interval_s: float = 0.05, kw_a: dict | None = None,
+               kw_b: dict | None = None) -> dict:
+    cluster = await _Cluster(url).start(len(prompts[0]), gen_len,
+                                        kw_a=kw_a, kw_b=kw_b)
+    streams = [{"tokens": [], "t_first": None, "t_done": None}
+               for _ in prompts]
+    try:
+        t0 = time.monotonic()
+
+        async def run(i, prompt):
+            st = streams[i]
+            async for item in cluster.operator.generate(
+                _request(prompt, gen_len).to_dict(), Context()
+            ):
+                toks = item.get("token_ids") or []
+                if toks and st["t_first"] is None:
+                    st["t_first"] = time.monotonic()
+                st["tokens"].extend(toks)
+            st["t_done"] = time.monotonic()
+
+        # Admit the WHOLE schedule while only A is registered: every
+        # stream lands on A (running or in its admission queue).
+        tasks = [asyncio.get_running_loop().create_task(run(i, p))
+                 for i, p in enumerate(prompts)]
+        await asyncio.sleep(0.05)
+        await cluster.add_b()
+
+        bmetrics = register_balancer_metrics(MetricsRegistry())
+        refusals: list[str] = []
+        balancer = (
+            _fleet_balancer(cluster, bmetrics, refusals) if balance else None)
+        while not all(t.done() for t in tasks):
+            if balancer is not None:
+                await balancer.step()
+            await asyncio.sleep(interval_s)
+        await asyncio.gather(*tasks)
+        makespan = time.monotonic() - t0
+
+        mismatches = sum(
+            1 for st, ref in zip(streams, refs) if st["tokens"] != ref)
+        failed = sum(1 for st in streams if not st["tokens"])
+        e2e = [st["t_done"] - t0 for st in streams]
+        ttft = [(st["t_first"] or st["t_done"]) - t0 for st in streams]
+        out = {
+            "makespan_s": round(makespan, 3),
+            "ttft_s": [round(x, 3) for x in ttft],
+            "e2e_s": [round(x, 3) for x in e2e],
+            "mismatches": mismatches,
+            "failed_streams": failed,
+            "moves_ok": 0,
+            "moves_refused": 0,
+            "pingpong_suppressed": 0,
+        }
+        if balancer is not None:
+            out["moves_ok"] = sum(
+                1 for _, o in balancer.moves_done if o == "ok")
+            out["moves_refused"] = sum(
+                1 for _, o in balancer.moves_done if o != "ok")
+            out["pingpong_suppressed"] = (
+                balancer.law.state.pingpong_suppressed)
+            out["balancer_status"] = balancer.status()
+            out["refusals"] = refusals
+            out["balancer_moves_total{outcome=ok}"] = sum(
+                bmetrics["moves"].value(reason=r, outcome="ok")
+                for r in ("hot_spot", "kv_pressure")
+            )
+    finally:
+        await cluster.stop()
+    return out
+
+
+def _goodput(arm: dict, gen_len: int, ttft_slo_s: float) -> tuple[int, float]:
+    """SLO-attaining tok/s: tokens of streams whose FIRST token landed
+    within the TTFT budget, over the arm's makespan. Queueing delay is
+    the hot-spot symptom; tokens still count at the rate the arm
+    actually sustained them."""
+    attained = sum(1 for x in arm["ttft_s"] if x <= ttft_slo_s)
+    return attained, round(attained * gen_len / arm["makespan_s"], 2)
+
+
+async def bench_balance(args) -> dict:
+    quick = bool(getattr(args, "quick", False))
+    # Sized so one batch-wave of decode is long against the balancer's
+    # move cadence (step interval + pair cooldown): the queued waves'
+    # TTFT is then far past budget while a freed slot's is well inside.
+    n_requests = 12 if quick else 16
+    gen_len = 288 if quick else 416
+    prompt_len = 16
+    # The hot engine's pool fits ~2.5 full streams (a long-lived engine
+    # dense with resident cache — the KV-pressure hot spot); the cold
+    # sibling has real headroom. Same chips, same model, both arms.
+    if quick:
+        kw_hot, kw_cold = dict(num_kv_blocks=192), dict(num_kv_blocks=768)
+    else:
+        kw_hot = dict(max_model_len=448, num_kv_blocks=256)
+        kw_cold = dict(max_model_len=448, num_kv_blocks=1024)
+
+    rng = np.random.default_rng(19)
+    prompts = [
+        rng.integers(1, CFG.vocab_size - 1, size=prompt_len).tolist()
+        for _ in range(n_requests)
+    ]
+
+    # Unmigrated sequential reference: pins parity AND calibrates the
+    # latency budget — T_ref is one stream's unqueued, unshared service
+    # time, so the SLO is hardware-relative, not wall-clock-absolute.
+    # TTFT budget: the first token must land within ~one unloaded
+    # stream-completion time; a stream stuck behind a full batch-wave
+    # (the hot-spot queue) blows it, a balancer-freed slot meets it.
+    agg = await TpuEngine(_args(**kw_cold), seed=0).start()
+    refs, ref_durs = [], []
+    for prompt in prompts:
+        toks = []
+        t0 = time.monotonic()
+        async for item in agg.generate(
+            _request(prompt, gen_len).to_dict(), Context()
+        ):
+            toks.extend(item.get("token_ids") or [])
+        ref_durs.append(time.monotonic() - t0)
+        refs.append(toks)
+    await agg.stop()
+    t_ref = float(np.median(ref_durs))
+    ttft_slo_s = 1.2 * t_ref
+
+    static = await _arm("memory://balbench-static", prompts, refs, gen_len,
+                        balance=False, kw_a=kw_hot, kw_b=kw_cold)
+    balanced = await _arm("memory://balbench-on", prompts, refs, gen_len,
+                          balance=True, kw_a=kw_hot, kw_b=kw_cold)
+
+    s_attained, s_goodput = _goodput(static, gen_len, ttft_slo_s)
+    b_attained, b_goodput = _goodput(balanced, gen_len, ttft_slo_s)
+    ratio = b_goodput / s_goodput if s_goodput > 0 else float("inf")
+    parity = static["mismatches"] == 0 and balanced["mismatches"] == 0
+    zero_failed = static["failed_streams"] == 0 and balanced["failed_streams"] == 0
+
+    result = {
+        "metric": "balancer_slo_goodput_ratio",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "vs_baseline": round(ratio, 4),
+        "workload": "skewed",
+        "num_requests": n_requests,
+        "gen_len": gen_len,
+        "prompt_len": prompt_len,
+        "t_ref_s": round(t_ref, 3),
+        "ttft_slo_s": round(ttft_slo_s, 3),
+        "static": {"slo_attained": s_attained, "slo_goodput_tok_s": s_goodput,
+                   **static},
+        "balancer": {"slo_attained": b_attained, "slo_goodput_tok_s": b_goodput,
+                     **balanced},
+        "rebalance_moves": balanced["moves_ok"],
+        "parity": parity,
+        "zero_failed_streams": zero_failed,
+        "quick": quick,
+    }
+    if not parity:
+        result["error"] = (
+            f"stream parity FAILED: {static['mismatches']} static + "
+            f"{balanced['mismatches']} balanced streams diverged from the "
+            "unmigrated reference"
+        )
+    elif not zero_failed:
+        result["error"] = "a stream produced no tokens"
+    elif balanced["moves_ok"] < 1:
+        result["error"] = "balancer actuated zero moves on a skewed fleet"
+    elif b_goodput <= s_goodput:
+        result["error"] = (
+            f"balancer goodput {b_goodput} <= static {s_goodput} "
+            "(must be strictly higher)"
+        )
+    return result

@@ -299,18 +299,27 @@ class DiurnalSim:
     draining (worker/migrate.py semantics): each migrated stream pays
     one ``migrate_gap_s`` cutover stall on its next token, and the
     worker flips after just ``switch_delay_s`` — the relocate-vs-drain
-    trade the ``--workload diurnal`` fleet comparison scores."""
+    trade the ``--workload diurnal`` fleet comparison scores.
+
+    ``placement="affinity"`` replaces least-loaded decode placement with
+    a seeded Zipf draw over the decode pool: a few engines soak up most
+    admissions — the cache-affinity/session-stickiness skew that
+    concentrates load in real fleets and the hot-spot regime the
+    balancer arm (``run_balancer_arm``) rebalances out of."""
 
     def __init__(self, decode_interp, prefill_interp, n_workers: int,
                  prefill_n: int, switch_delay_s: float = 0.5,
                  relocate: bool = False, migrate_gap_s: float = 0.25,
-                 kv_economy: KvEconomyModel | None = None):
+                 kv_economy: KvEconomyModel | None = None,
+                 placement: str = "least", place_seed: int = 0):
         self.dec = decode_interp
         self.pre = prefill_interp
         self.switch_delay_s = switch_delay_s
         self.relocate = relocate
         self.migrate_gap_s = migrate_gap_s
         self.kv_economy = kv_economy
+        self.placement = placement
+        self._place_rng = random.Random(place_seed)
         self.workers = [
             _Worker(i, POOL_PREFILL if i < prefill_n else POOL_DECODE)
             for i in range(n_workers)
@@ -400,7 +409,14 @@ class DiurnalSim:
         if not cands:
             self.decode_q.append(req)
             return
-        w = min(cands, key=lambda w: len(w.active))
+        if self.placement == "affinity" and len(cands) > 1:
+            # Zipf-1.5 admission skew, keyed by worker id so the draw
+            # stream is identical across arms regardless of load state.
+            cands = sorted(cands, key=lambda w: w.wid)
+            weights = [1.0 / (i + 1) ** 1.5 for i in range(len(cands))]
+            w = self._place_rng.choices(cands, weights=weights)[0]
+        else:
+            w = min(cands, key=lambda w: len(w.active))
         w.active.add(req.rid)
         self._home[req.rid] = w
         if req.tokens >= req.glen:
@@ -467,6 +483,30 @@ class DiurnalSim:
             self._home[rid] = dest
             self._stall[rid] = self.now + self.migrate_gap_s
             self.migrations += 1
+
+    def set_placement(self, mode: str) -> None:
+        """Schedulable placement switch (the skewed A/B ends its
+        affinity burst with one of these events)."""
+        self.placement = mode
+
+    def balancer_migrate(self, src_wid: int, dst_wid: int) -> int | None:
+        """Actuate ONE balancer move: relocate the newest in-flight
+        decode (the engine's cheapest-victim rule — ``list_running()``'s
+        tail holds the fewest KV blocks, worker/roles.py) from src to
+        dst, paying one cutover stall. Returns the migrated rid, or
+        None when the source has nothing to shed (the worker's typed
+        ``no_running`` refusal)."""
+        by_wid = {w.wid: w for w in self.workers}
+        src, dst = by_wid.get(src_wid), by_wid.get(dst_wid)
+        if src is None or dst is None or not src.active or dst.draining:
+            return None
+        rid = max(src.active)  # rids are admission-ordered: max = newest
+        src.active.discard(rid)
+        dst.active.add(rid)
+        self._home[rid] = dst
+        self._stall[rid] = self.now + self.migrate_gap_s
+        self.migrations += 1
+        return rid
 
     def _maybe_flip(self, w: _Worker) -> None:
         if w.draining and w.busy is None and not w.active and w.pending_role:
@@ -693,6 +733,160 @@ async def run_closed_loop_arm(trace, interps, n_workers: int, prefill_n: int,
     return out
 
 
+def skew_phases(scale: float = 1.0) -> list[Phase]:
+    """Skewed-placement trace for the balancer A/B: a burst of LONG
+    generations lands Zipf-concentrated on a few decode engines
+    (DiurnalSim ``affinity`` placement — cache-affinity stickiness),
+    then placement normalizes while a steady stream of short requests
+    runs least-loaded. Without rebalancing the burst residue pins the
+    hot engines at deep batch for the rest of the day — every resident
+    stream's ITL stretched; the balancer's question is whether draining
+    that residue to idle siblings (one cutover stall per move) buys the
+    stranded streams their SLO back."""
+    return [
+        Phase("burst", 6.0, 20.0, 96, 2000),
+        Phase("steady", 54.0 * scale, 25.0, 96, 64),
+    ]
+
+
+async def run_balancer_arm(trace, interps, n_workers: int, prefill_n: int,
+                           day_s: float, ttft_slo_s: float, itl_slo_ms: float,
+                           *, balancer_on: bool, interval_s: float = 2.0,
+                           seed: int = 0, migrate_gap_s: float = 0.25,
+                           decode_slots: int = 16,
+                           affinity_until: float | None = None) -> dict:
+    """Fixed pools, Zipf-skewed decode placement; with ``balancer_on``
+    the REAL :class:`BalancerLaw` decides migrations each cycle and the
+    sim actuates them as `migrate_out` moves (newest-victim rule, one
+    cutover stall each). Ping-pong is audited from the ground truth: a
+    rid migrated twice inside min(settle_s, pair_cooldown_s) is a
+    violation of the law's own guarantee."""
+    from dynamo_tpu.planner.balancer import (
+        BalancerConfig,
+        BalancerLaw,
+        EngineLoad,
+    )
+
+    dec, pre = interps
+    sim = DiurnalSim(dec, pre, n_workers, prefill_n,
+                     migrate_gap_s=migrate_gap_s,
+                     placement="affinity", place_seed=seed)
+    if affinity_until is not None:
+        sim.schedule(affinity_until, sim.set_placement, "least")
+    for i, (t, plen, glen) in enumerate(trace):
+        sim.schedule(t, sim.arrive, _Req(i, t, plen, glen))
+
+    # Fleet-tuned gates: hysteresis=1 (a 120-engine fleet has fresh cold
+    # destinations every cycle, so per-pair momentum would stall
+    # shedding), settle == cooldown so the ping-pong window is exact.
+    law = BalancerLaw(BalancerConfig(
+        hysteresis_cycles=1, pair_cooldown_s=10.0, settle_s=10.0,
+        max_moves_per_cycle=8,
+    )) if balancer_on else None
+    rid_moves: dict[int, list[float]] = {}
+    rebalance_moves = 0
+    peak_active = 0
+    t = interval_s
+    horizon = trace[-1][0]
+    while t <= horizon + interval_s:
+        sim.run_until(t)
+        decode = [w for w in sim.workers
+                  if w.role == POOL_DECODE and not w.draining]
+        peak_active = max(
+            peak_active, max((len(w.active) for w in decode), default=0))
+        if law is not None:
+            loads = [
+                EngineLoad(
+                    instance_id=w.wid, active=len(w.active),
+                    slots=decode_slots, waiting=0,
+                    kv_usage=min(len(w.active) / decode_slots, 1.0),
+                )
+                for w in decode
+            ]
+            for mv in law.decide(loads, now=sim.now):
+                rid = sim.balancer_migrate(mv.src, mv.dst)
+                if rid is None:
+                    law.notify_failed(mv)
+                    continue
+                law.notify_actuated(mv, now=sim.now)
+                rid_moves.setdefault(rid, []).append(sim.now)
+                rebalance_moves += 1
+        t += interval_s
+    sim.run_until(math.inf)
+
+    window = (min(law.cfg.settle_s, law.cfg.pair_cooldown_s)
+              if law is not None else 0.0)
+    pingpong = sum(
+        1
+        for times in rid_moves.values()
+        for a, b in zip(times, times[1:])
+        if b - a < window
+    )
+    out = _score(sim.completed, len(trace), day_s, ttft_slo_s, itl_slo_ms)
+    out["rebalance_moves"] = rebalance_moves
+    out["pingpong_violations"] = pingpong
+    out["pingpong_suppressed"] = (
+        law.state.pingpong_suppressed if law is not None else 0)
+    out["peak_active"] = peak_active
+    out["migration_stall_s"] = round(sim.migration_stall_s, 3)
+    return out
+
+
+async def run_balance_ab(n_workers: int = 120, scale: float = 1.0,
+                         seed: int = 0, ttft_slo_s: float = 2.0,
+                         itl_slo_ms: float = 25.0) -> dict:
+    """The balancer A/B at fleet scale: identical seeded skewed trace and
+    placement stream, equal chip count; the only difference is whether
+    the BalancerLaw runs. Feeds both ``--workload diurnal`` (fleet
+    section) and the standalone ``diurnal.py --balancer`` smoke."""
+    phases = skew_phases(scale)
+    day_s = sum(p.dur_s for p in phases)
+    trace = gen_trace(phases, seed)
+    interps = synth_profile()
+    prefill_n = max(1, n_workers // 6)
+    arms = {}
+    for name, on in (("static", False), ("balancer", True)):
+        arms[name] = await run_balancer_arm(
+            trace, interps, n_workers, prefill_n, day_s,
+            ttft_slo_s, itl_slo_ms, balancer_on=on, seed=seed,
+            affinity_until=phases[0].dur_s,
+        )
+    ratio = (
+        arms["balancer"]["slo_goodput_tok_s"]
+        / arms["static"]["slo_goodput_tok_s"]
+        if arms["static"]["slo_goodput_tok_s"] > 0 else float("inf")
+    )
+    result = {
+        "metric": "balancer_goodput_ratio_vs_static",
+        "value": round(ratio, 4),
+        "unit": "x",
+        "workload": "skewed-placement",
+        "workers": n_workers,
+        "split": f"{prefill_n}P/{n_workers - prefill_n}D (fixed)",
+        "day_s": day_s,
+        "offered_requests": len(trace),
+        "slo": {"ttft_s": ttft_slo_s, "itl_ms": itl_slo_ms},
+        "rebalance_moves": arms["balancer"]["rebalance_moves"],
+        "pingpong_violations": arms["balancer"]["pingpong_violations"],
+        "pingpong_suppressed": arms["balancer"]["pingpong_suppressed"],
+        "static": arms["static"],
+        "balancer": arms["balancer"],
+        "zero_failed_requests": all(a["failed"] == 0 for a in arms.values()),
+    }
+    if not result["zero_failed_requests"]:
+        result["error"] = "requests failed in a balancer-arm sim"
+    elif result["pingpong_violations"]:
+        result["error"] = (
+            f"{result['pingpong_violations']} ping-pong migrations — "
+            "the settle/cooldown guarantee is broken"
+        )
+    elif result["rebalance_moves"] < 1:
+        result["error"] = "balancer arm actuated zero moves on a skewed fleet"
+    elif ratio < 1.0:
+        result["error"] = f"balancer goodput ratio {ratio:.3f} < 1.0"
+    return result
+
+
 async def bench_diurnal(args) -> dict:
     """bench.py --workload diurnal entry point."""
     n_workers = args.diurnal_workers
@@ -786,6 +980,15 @@ async def bench_diurnal(args) -> dict:
         / max(econ_arms["directory"]["prefill_tokens_effective"], 1)
     )
 
+    # Hot-spot rebalancing at fleet scale: same 120 engines, a skewed-
+    # placement trace (cache-affinity concentration), the REAL
+    # BalancerLaw deciding continuous migrate_out moves vs letting the
+    # hot engines stretch every resident stream's ITL.
+    balance = await run_balance_ab(
+        n_workers=fleet_n, seed=seed,
+        ttft_slo_s=ttft_slo_s, itl_slo_ms=itl_slo_ms,
+    )
+
     ratio = (
         closed["slo_goodput_tok_s"] / best_static["slo_goodput_tok_s"]
         if best_static["slo_goodput_tok_s"] > 0 else float("inf")
@@ -830,6 +1033,7 @@ async def bench_diurnal(args) -> dict:
                     / max(econ_arms["per_engine"]["slo_goodput_tok_s"], 1e-9),
                     4),
             },
+            "balance": balance,
         },
         "zero_failed_requests": all(
             a["failed"] == 0
@@ -853,4 +1057,40 @@ async def bench_diurnal(args) -> dict:
         result["error"] = "requests failed in a sim arm — drain contract broken"
     elif ratio < 1.15:
         result["error"] = f"closed-loop ratio {ratio:.3f} < 1.15 acceptance bar"
+    elif "error" in balance:
+        result["error"] = f"balance arm: {balance['error']}"
     return result
+
+
+def main(argv=None) -> int:
+    """Standalone entry: the balancer A/B (``--balancer``), quick or
+    full. The complete diurnal suite runs via ``bench.py --workload
+    diurnal``; this entry exists so the tier-1 smoke can pin the
+    rebalancing contract (moves happen, zero ping-pong, goodput >=
+    static) without the 120-engine day."""
+    import argparse
+    import asyncio
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--balancer", action="store_true",
+                    help="run the hot-spot balancer A/B (required: the "
+                         "full diurnal suite runs via bench.py)")
+    ap.add_argument("--quick", action="store_true",
+                    help="halve the trace for the tier-1 smoke")
+    ap.add_argument("--workers", type=int, default=120)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if not args.balancer:
+        ap.error("pass --balancer (the full diurnal A/B runs via "
+                 "bench.py --workload diurnal)")
+    res = asyncio.run(run_balance_ab(
+        n_workers=args.workers, scale=0.5 if args.quick else 1.0,
+        seed=args.seed,
+    ))
+    print(json.dumps(res))
+    return 1 if "error" in res else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

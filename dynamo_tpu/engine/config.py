@@ -331,6 +331,14 @@ class EngineArgs:
     # decode side falls back to local prefill) instead of growing the
     # prefill worker's heap without bound.
     transfer_buffer_bytes: int = 256 << 20
+    # Proactive defrag (planner/balancer.py composition): at this KV
+    # pool usage fraction the engine fires its migration-offer hook for
+    # the CHEAPEST running sequence — relocating it to a pool peer
+    # BEFORE allocation failure forces a recompute-preemption. The same
+    # hook the preemption boundary already uses (preempt_offer_grace_s),
+    # fired ahead of pressure instead of at the cliff. 0 = off (the
+    # offer still fires at the preemption boundary as before).
+    kv_pressure_offer: float = 0.0
     # Batch-level dispatch gate: speculate only when the EMA-weighted
     # expected tokens per row-pass, mean(1 + ema_i * draft_len_i),
     # clears this threshold. Protects mixed batches (a few drafting rows
@@ -393,6 +401,10 @@ class EngineArgs:
             )
         if self.lora_slots < 0:
             raise ValueError(f"lora_slots must be >= 0; got {self.lora_slots}")
+        if not 0.0 <= self.kv_pressure_offer <= 1.0:
+            raise ValueError(
+                f"kv_pressure_offer must be in [0, 1]; got {self.kv_pressure_offer}"
+            )
         if self.lora_slots > 0 and self.lora_rank <= 0:
             raise ValueError(
                 f"lora_rank must be positive when lora_slots > 0; got {self.lora_rank}"

@@ -619,6 +619,15 @@ class TpuEngine:
         # and the kill waits a bounded grace for the offer to land.
         self.migration_offer = None
         self.preempt_offer_grace_s = 0.75
+        # Proactive defrag (args.kv_pressure_offer): once pool usage
+        # crosses the threshold, the offer hook fires for the cheapest
+        # running sequence AHEAD of the preemption boundary, rate-limited
+        # so one sustained pressure plateau yields one offer per window
+        # rather than one per scheduler step.
+        self.kv_pressure_offer = float(getattr(args, "kv_pressure_offer", 0.0) or 0.0)
+        self.kv_pressure_offer_window_s = 2.0
+        self._pressure_offer_next = 0.0
+        self.pressure_offers = 0  # observability: proactive offers fired
         # Streaming-export page fetches in flight: (seq, lo, hi, device
         # arrays, bucket n). Dispatched per prefill chunk with async D2H
         # (start_host_fetch); harvested opportunistically between chunk
@@ -1325,6 +1334,8 @@ class TpuEngine:
             self._reap_exports()
         if self._migrations:
             self._service_migrations()
+        if self.kv_pressure_offer > 0.0:
+            self._maybe_pressure_offer()
         # Prefill-priority admission, two phases: (1) allocate KV for the
         # whole wave, (2) dispatch prefills PACKED by suffix bucket
         # (model.prefill_batch) — one-at-a-time prefill was the r3 TTFT
@@ -2338,6 +2349,42 @@ class TpuEngine:
             except NoFreeBlocksError:
                 return False
         return True
+
+    def _maybe_pressure_offer(self) -> None:
+        """Proactive defrag (ISSUE 19 tentpole (d)): when KV pool usage
+        crosses ``kv_pressure_offer``, fire the migration-offer hook for
+        the CHEAPEST victim — fewest resident blocks, so the relocation
+        streams the least KV — before allocation failure forces a
+        recompute-preemption. Purely advisory: the hook's relocation
+        either frees the blocks (migration_finish) or nothing changes
+        and the preemption boundary still owns correctness. Scheduler
+        thread only; rate-limited to one offer per pressure window."""
+        cb = self.migration_offer
+        if cb is None or not self._running:
+            return
+        now = time.monotonic()
+        if now < self._pressure_offer_next:
+            return
+        if self.pool.usage < self.kv_pressure_offer:
+            return
+        victim: _Seq | None = None
+        for s in self._running:
+            if s.dead or s.mig is not None or s.export:
+                continue
+            if victim is None or len(s.block_ids) < len(victim.block_ids):
+                victim = s
+        if victim is None:
+            return
+        self._pressure_offer_next = now + self.kv_pressure_offer_window_s
+        self.pressure_offers += 1
+        # Reuse the preemption-offer grace stamp: if pressure keeps
+        # climbing and this victim IS chosen for preemption inside the
+        # grace, the kill waits for the already-running relocation.
+        victim.offer_deadline = now + self.preempt_offer_grace_s
+        try:
+            cb(victim.request_id)
+        except Exception:  # noqa: BLE001 — the proactive offer is advisory; a broken hook must never stall the scheduler
+            log.exception("kv-pressure migration offer hook failed")
 
     def _offer_migration_grace(self, victim: _Seq) -> bool:
         """QoS preemption offers migration before killing: when an offer

@@ -504,6 +504,18 @@ class FleetSupervisor:
         admission = {
             wid: data for wid, data in await self._scrape("/debug/admission")
         }
+        # Fleet-balancer decision state, published per cycle by the
+        # operator under planner/<id>/balancer (lease-attached — a dead
+        # operator's block vanishes with its lease). Keyed by operator
+        # id since several operators may run against one store.
+        balancer: dict[str, dict] = {}
+        for e in await self._store.get_prefix("planner/"):
+            parts = e.key.split("/")
+            if len(parts) == 3 and parts[2] == "balancer":
+                try:
+                    balancer[parts[1]] = json.loads(e.value)
+                except (ValueError, UnicodeDecodeError):
+                    continue
         body = {
             "fleet_id": self.fleet_id,
             "fleet_size": self.n,
@@ -512,6 +524,7 @@ class FleetSupervisor:
             "budget_chunks_claimed": len(chunks),
             "budget_chunks_by_class": per_class,
             "admission": admission,
+            "balancer": balancer,
             "workers": [
                 {
                     "worker_id": s.worker_id,

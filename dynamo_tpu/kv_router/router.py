@@ -58,6 +58,13 @@ class KvRouterConfig:
     # chosen worker fetches the prefix pages from the peer's host tier
     # (llm/peer_kv.py) instead of recomputing them. 0 disables.
     peer_fetch_min_blocks: int = 4
+    # Migration-aware placement (planner/balancer.py): when a fleet
+    # balancer relocates decodes off hot engines, set this to the
+    # amortized per-move cost in blocks — the scheduler then caps each
+    # candidate's decode-load term at fleet_mean + this, pricing
+    # "admit on the warm engine, balancer sheds later" over landing
+    # cold. None = off (no balancer, load priced at face value).
+    migrate_cost_blocks: float | None = None
 
 
 class KvPushRouter:
@@ -90,6 +97,7 @@ class KvPushRouter:
             KvSchedulerConfig(
                 overlap_score_weight=self.config.overlap_score_weight,
                 router_temperature=self.config.router_temperature,
+                migrate_cost_blocks=self.config.migrate_cost_blocks,
             )
         )
         self.active = ActiveSequences()

@@ -16,6 +16,16 @@ directory-listed holder over the credit-flow transfer plane
 transfer-vs-compute tradeoff) instead of full recompute, so a cold but
 idle engine next to a warm peer can beat a warm but saturated one —
 the directory stops being a stickiness booster and becomes an economy.
+
+Migration-aware placement (Llumnix composition): when a fleet balancer
+runs (planner/balancer.py), landing on a loaded-but-warm engine is no
+longer a terminal decision — the balancer can relocate the decode later
+for roughly one migration's worth of transfer. With
+``migrate_cost_blocks`` set, each candidate's decode-load term is capped
+at the fleet mean plus that cost: excess load above the mean is priced
+as "admit here, shed later" instead of at face value, so cache affinity
+wins ties it would otherwise lose to a cold idle engine. ``None``
+(default) keeps the original pricing for balancer-less deployments.
 """
 
 from __future__ import annotations
@@ -41,6 +51,11 @@ class KvSchedulerConfig:
     # loopback transfer plane (BENCH_DISAGG_r08 frame throughput vs
     # prefill tok/s); a WAN-separated fleet wants it near 1.
     transfer_block_cost: float = 0.35
+    # Migration-aware decode pricing: cap each candidate's decode-load
+    # term at fleet_mean + migrate_cost_blocks (the amortized price of
+    # one later balancer move, in blocks). None = off — load is priced
+    # at face value, correct when no balancer will relocate decodes.
+    migrate_cost_blocks: float | None = None
 
 
 @dataclass
@@ -74,29 +89,46 @@ class KvScheduler:
         is what a transfer would save, priced at transfer_block_cost."""
         if not workers:
             raise ValueError("no workers")
-        costs: list[float] = []
+        per_worker: list[tuple[int, int]] = []  # (overlap, fetch) per worker
+        loads: list[int] = []
         for w in workers:
             overlap = min(overlaps.scores.get(w, 0), request_blocks)
             fetch = self._fetch_blocks(w, overlap, request_blocks, fetchable)
+            per_worker.append((overlap, fetch))
+            loads.append(active.active_blocks(w))
+        priced = self._priced_loads(loads)
+        costs: list[float] = []
+        for (overlap, fetch), load in zip(per_worker, priced):
             potential_prefill = (
                 request_blocks
                 - overlap
                 - fetch
                 + self.config.transfer_block_cost * fetch
             )
-            potential_decode = active.active_blocks(w) + request_blocks
+            potential_decode = load + request_blocks
             costs.append(
                 self.config.overlap_score_weight * potential_prefill + potential_decode
             )
         idx = softmax_sample(costs, self.config.router_temperature, self._rng)
-        w = workers[idx]
-        overlap = min(overlaps.scores.get(w, 0), request_blocks)
+        overlap, fetch = per_worker[idx]
         return Placement(
-            worker=w,
+            worker=workers[idx],
             overlap_blocks=overlap,
             total_blocks=request_blocks,
-            fetch_blocks=self._fetch_blocks(w, overlap, request_blocks, fetchable),
+            fetch_blocks=fetch,
         )
+
+    def _priced_loads(self, loads: list[int]) -> list[float]:
+        """Decode-load term per worker under migration-aware pricing.
+
+        With a balancer running, load above the fleet mean is transient —
+        the balancer sheds it — so excess beyond mean + migrate_cost_blocks
+        is not charged against a warm candidate."""
+        cap_extra = self.config.migrate_cost_blocks
+        if cap_extra is None or len(loads) < 2:
+            return [float(l) for l in loads]
+        mean = sum(loads) / len(loads)
+        return [min(float(l), mean + cap_extra) for l in loads]
 
     @staticmethod
     def _fetch_blocks(

@@ -46,6 +46,7 @@ from dynamo_tpu.transfer.stream import (
     TransferError,
     TransferTimeoutError,
     inject_payload_from_chunks,
+    process_credit_budget,
     pull_kv_stream,
     serve_kv_window,
 )
@@ -491,6 +492,11 @@ class DisaggDecodeHandler:
                 prefill_done=prefill_done,
                 failed=prefill_failed,
                 on_inflight=lambda nbytes: self._set_inflight(handle, nbytes),
+                # Priority tier of the shared budget: disagg pulls are on
+                # the TTFT critical path, so they always get full credit
+                # and background migration pulls pace around them.
+                budget=process_credit_budget(),
+                budget_kind="disagg",
             )
             ok = True
         except TransferAbortedError as e:

@@ -70,6 +70,18 @@ def parse_args(argv=None):
                         "(0 = frontend fleet scaling off)")
     p.add_argument("--hysteresis-cycles", type=int, default=2)
     p.add_argument("--cooldown", type=float, default=30.0)
+    # Fleet hot-spot balancer (planner/balancer.py): continuous
+    # migration-based rebalancing of the decode pool, stepped inside
+    # the operate loop's cadence.
+    p.add_argument("--balance", choices=["on", "off"], default="off",
+                   help="on = rebalance decode load with live migrations "
+                        "(workers need a migratable engine)")
+    p.add_argument("--balance-saturation", type=float, default=0.75,
+                   help="load score above which an engine sheds")
+    p.add_argument("--balance-idle", type=float, default=0.45,
+                   help="load score below which an engine absorbs")
+    p.add_argument("--balance-cooldown", type=float, default=30.0,
+                   help="per-(src,dst)-pair cooldown after an actuated move")
     p.add_argument("--replica-scaling", choices=["on", "off"], default="off",
                    help="on = spawn/retire worker replicas (worker argv "
                         "after --); off = pool moves only (fixed chips)")
@@ -187,6 +199,27 @@ async def operate_main(args) -> None:
     admission_url = None
     if args.metrics_url.endswith("/metrics"):
         admission_url = args.metrics_url[: -len("/metrics")] + "/debug/admission"
+    balancer = None
+    if args.balance == "on":
+        from dynamo_tpu.planner.balancer import (
+            BalancerConfig,
+            BalancerLaw,
+            build_fleet_balancer,
+            register_balancer_metrics,
+        )
+
+        balancer = await build_fleet_balancer(
+            rt, args.namespace, args.component,
+            law=BalancerLaw(BalancerConfig(
+                saturation=args.balance_saturation,
+                idle=args.balance_idle,
+                pair_cooldown_s=args.balance_cooldown,
+                settle_s=args.balance_cooldown,
+                hysteresis_cycles=args.hysteresis_cycles,
+            )),
+            metrics=register_balancer_metrics(rt.metrics),
+            operator_id=args.operator_id,
+        ).build()
     auto = SlaAutoscaler(
         ControlLaw(cfg, decode_interp, prefill_interp),
         HttpMetricsSource(args.metrics_url, admission_url=admission_url),
@@ -195,6 +228,7 @@ async def operate_main(args) -> None:
         journal=ActionJournal(rt.store, args.operator_id, await rt.primary_lease()),
         metrics=register_planner_metrics(rt.metrics),
         chaos=ChaosInjector.from_config(rt.config.chaos),
+        balancer=balancer,
     )
     await auto.start()
     print(

@@ -430,6 +430,7 @@ class SlaAutoscaler:
         metrics: dict | None = None,
         chaos=None,
         clock=time.monotonic,
+        balancer=None,
     ):
         self.law = law
         self.observe = observe
@@ -439,6 +440,11 @@ class SlaAutoscaler:
         self.metrics = metrics
         self.chaos = chaos
         self._clock = clock
+        # Optional FleetBalancer (planner/balancer.py): stepped inside
+        # this loop's cadence AFTER the scale decisions — rebalancing
+        # works WITHIN the pool sizes the scale law just converged, so
+        # the two policies never race over the same observation.
+        self.balancer = balancer
         self.actions_done: list[tuple[ScaleAction, str]] = []
         self.last_decisions: list = []
         self._task: asyncio.Task | None = None
@@ -475,6 +481,11 @@ class SlaAutoscaler:
         if self.pool_actuator is not None:
             pools = await self.pool_actuator.pools()
             self._set_pool_gauges({p: len(pools.get(p, ())) for p in sizes})
+        if self.balancer is not None:
+            try:
+                await self.balancer.step()
+            except Exception:  # noqa: BLE001 — the balancer is an optimization; a failed cycle must not take the scale loop down with it
+                log.exception("balancer step failed")
         return decisions
 
     async def _actuate(self, action: ScaleAction, t0: float) -> None:

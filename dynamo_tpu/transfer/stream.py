@@ -50,6 +50,77 @@ log = get_logger("transfer")
 DEFAULT_CREDIT_BYTES = 32 << 20
 DEFAULT_FRAME_BYTES = 16 << 20
 _DATA_KINDS = ("k", "v", "k_scale", "v_scale")
+# Floor for a de-prioritized pull's window: even a fully contended
+# budget lets a background stream advance one modest window per turn,
+# so pacing slows migrations but can never wedge them.
+MIN_WINDOW_BYTES = 1 << 20
+
+
+class CreditBudget:
+    """Shared credit accounting across one process's concurrent KV pulls.
+
+    The credit-flow protocol already bounds each STREAM's in-flight
+    bytes; this bounds their SUM, with a priority tier. Disagg prefill
+    pulls are on the request critical path (TTFT) and always get their
+    full ask; background pulls — balancer/planner migrations — get
+    whatever of ``total_bytes`` the outstanding windows have left,
+    floored at :data:`MIN_WINDOW_BYTES`. Rebalancing therefore shapes
+    its own bandwidth around the disagg plane instead of competing with
+    it (ISSUE 19 tentpole (c); docs/performance.md has the budget math).
+
+    Thread-safe; windows are short-lived (acquire → one pull window →
+    release), so a busy disagg plane throttles migrations within one
+    window turn.
+    """
+
+    def __init__(self, total_bytes: int = 2 * DEFAULT_CREDIT_BYTES,
+                 priority_kinds: tuple = ("disagg",)):
+        self.total_bytes = total_bytes
+        self.priority_kinds = frozenset(priority_kinds)
+        self._lock = threading.Lock()
+        self._outstanding: dict[str, int] = {}
+        self.charged_bytes: dict[str, int] = {}  # per-kind delivered bytes
+
+    def acquire(self, kind: str, want: int) -> int:
+        """Reserve credit for one pull window. → granted bytes (== want
+        for priority kinds; bounded by the budget's headroom otherwise)."""
+        with self._lock:
+            if kind in self.priority_kinds:
+                grant = want
+            else:
+                used = sum(self._outstanding.values())
+                grant = max(MIN_WINDOW_BYTES, min(want, self.total_bytes - used))
+            self._outstanding[kind] = self._outstanding.get(kind, 0) + grant
+            return grant
+
+    def release(self, kind: str, granted: int, delivered: int = 0) -> None:
+        with self._lock:
+            left = self._outstanding.get(kind, 0) - granted
+            if left > 0:
+                self._outstanding[kind] = left
+            else:
+                self._outstanding.pop(kind, None)
+            if delivered:
+                self.charged_bytes[kind] = self.charged_bytes.get(kind, 0) + delivered
+
+    def outstanding(self, kind: str | None = None) -> int:
+        with self._lock:
+            if kind is not None:
+                return self._outstanding.get(kind, 0)
+            return sum(self._outstanding.values())
+
+
+_process_budget: CreditBudget | None = None
+
+
+def process_credit_budget() -> CreditBudget:
+    """The per-process shared budget (worker processes host both the
+    disagg decode handler and the migration receiver, so one instance
+    arbitrates between them)."""
+    global _process_budget
+    if _process_budget is None:
+        _process_budget = CreditBudget()
+    return _process_budget
 
 
 class TransferError(Exception):
@@ -428,6 +499,8 @@ async def pull_kv_stream(
     prefill_done=None,
     failed=None,
     on_inflight=None,
+    budget: CreditBudget | None = None,
+    budget_kind: str = "disagg",
 ) -> PulledKvStream:
     """Drive the windowed pull until ``kv_eos``.
 
@@ -446,6 +519,13 @@ async def pull_kv_stream(
     ``on_inflight(bytes)`` reports assembled-but-uninjected bytes for the
     inflight gauge.
 
+    ``budget`` (a :class:`CreditBudget`) arbitrates the credit window
+    PER PULL WINDOW across the process's concurrent streams: each
+    window's advertised credit is what the budget grants ``budget_kind``
+    at that moment, and delivered bytes are charged back on release —
+    a background (non-priority) kind pulls smaller windows while the
+    disagg plane is busy instead of doubling in-flight bytes.
+
     Raises TransferAbortedError / TransferTimeoutError / TransferError.
     """
     asm = KvChunkAssembler()
@@ -462,7 +542,11 @@ async def pull_kv_stream(
             )
         eos: dict | None = None
         progressed = False
-        window = window_call(cursor, credit_bytes, min(window_wait_s, remaining))
+        granted = credit_bytes
+        if budget is not None:
+            granted = budget.acquire(budget_kind, credit_bytes)
+        window_bytes = 0
+        window = window_call(cursor, granted, min(window_wait_s, remaining))
         try:
             async for frame in window:
                 if frame.get("error"):
@@ -481,6 +565,7 @@ async def pull_kv_stream(
                     cursor += 1
                     progressed = True
                     total_bytes += chunk.nbytes
+                    window_bytes += chunk.nbytes
                     if prefill_done is not None and not prefill_done():
                         overlapped += chunk.nbytes
                     if on_inflight is not None:
@@ -489,6 +574,8 @@ async def pull_kv_stream(
             aclose = getattr(window, "aclose", None)
             if aclose is not None:
                 await aclose()
+            if budget is not None:
+                budget.release(budget_kind, granted, delivered=window_bytes)
         if asm.mid_chunk:
             raise TransferError("kv stream cut mid-chunk")
         if eos is None and not progressed and failed is not None and failed():

@@ -162,6 +162,13 @@ def parse_args(argv=None):
                         "every engine; salted-hash-keyed files dedup "
                         "across the fleet, block_manager/tiers.py)")
     p.add_argument("--fleet-kv-blocks", type=int, default=16384)
+    p.add_argument("--kv-pressure-offer", type=float, default=0.0,
+                   help="pool-usage fraction above which the engine "
+                        "proactively OFFERS its cheapest running sequence "
+                        "for migration before preemption is forced "
+                        "(0 = off; the offer reuses the same "
+                        "migration_offer hook as the preemption-boundary "
+                        "grace window, docs/autoscaler.md#fleet-balancer)")
     p.add_argument("--kv-directory", choices=["on", "off"], default="off",
                    help="publish this engine's KV block residency to the "
                         "global prefix directory (fleet/directory.py) so "
@@ -674,6 +681,7 @@ def _engine_args(args, model):
         attn_impl=args.attn_impl,
         quant=args.quant,
         kv_quant=args.kv_quant,
+        kv_pressure_offer=args.kv_pressure_offer,
         host_kv_blocks=args.host_kv_blocks,
         disk_kv_dir=args.disk_kv_dir,
         disk_kv_blocks=args.disk_kv_blocks,

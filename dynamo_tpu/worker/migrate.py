@@ -49,7 +49,9 @@ from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.runtime.logging import TraceContext, get_logger
 from dynamo_tpu.transfer.stream import (
     DEFAULT_CREDIT_BYTES,
+    CreditBudget,
     inject_payload_from_chunks,
+    process_credit_budget,
     pull_kv_stream,
 )
 
@@ -66,6 +68,12 @@ DEFAULT_STREAM_TIMEOUT_S = 30.0
 # arrives (frontend died between commit and re-dispatch) are reaped
 # after this long.
 DEFAULT_STAGE_TTL_S = 120.0
+# Bandwidth pacing (ISSUE 19 tentpole (c)): at most this many outbound
+# migrations may stream concurrently per engine. The balancer issues
+# one move per cycle, but pool moves/retirement fan out over the whole
+# running batch — without the cap those N concurrent streams contend
+# with the disagg KV plane for the same egress.
+DEFAULT_MAX_OUTBOUND = 2
 
 
 class MigrationError(Exception):
@@ -80,7 +88,7 @@ def register_migration_metrics(registry) -> dict:
     return {
         "attempts": registry.counter(
             "migration_attempts_total",
-            "Live migration attempts by outcome (ok | fallback | noop)",
+            "Live migration attempts by outcome (ok | fallback | noop | paced)",
         ),
         "fallbacks": registry.counter(
             "migration_fallback_total",
@@ -98,6 +106,11 @@ def register_migration_metrics(registry) -> dict:
             "migration_inflight",
             "Migrations this worker is currently driving as the source",
         ),
+        "outbound_inflight": registry.gauge(
+            "migration_outbound_inflight",
+            "Outbound migrations currently STREAMING from this worker "
+            "(the bandwidth-pacing cap applies to this gauge)",
+        ),
     }
 
 
@@ -113,7 +126,8 @@ class MigrationCoordinator:
     def __init__(self, engine, admin_router, component: str,
                  source_instance: int, chaos=None, metrics: dict | None = None,
                  lag_blocks: int = DEFAULT_LAG_BLOCKS,
-                 stream_timeout_s: float = DEFAULT_STREAM_TIMEOUT_S):
+                 stream_timeout_s: float = DEFAULT_STREAM_TIMEOUT_S,
+                 max_outbound: int = DEFAULT_MAX_OUTBOUND):
         self.engine = engine
         self.admin_router = admin_router
         self.component = component
@@ -122,6 +136,11 @@ class MigrationCoordinator:
         self.metrics = metrics
         self.lag_blocks = lag_blocks
         self.stream_timeout_s = stream_timeout_s
+        # Bandwidth pacing: concurrent outbound migrations beyond the
+        # cap answer typed {"ok": False, "reason": "paced"} instead of
+        # opening another stream (callers retry or keep the sequence).
+        self.max_outbound = max(int(max_outbound), 1)
+        self._outbound = 0
         # In-process ledgers (tests/bench assert against these; the
         # metrics dict mirrors them when bound).
         self.outcomes: dict[str, int] = {}
@@ -164,8 +183,16 @@ class MigrationCoordinator:
         if dest_instance == self.source_instance:
             self._outcome("noop")
             return {"ok": False, "reason": "self"}
+        if self._outbound >= self.max_outbound:
+            # Pacing cap: refuse typed rather than queue — a queued move
+            # would actuate against stale load scores, and the caller
+            # (balancer, pool move loop) re-plans from live state anyway.
+            self._outcome("paced")
+            return {"ok": False, "reason": "paced"}
+        self._outbound += 1
         if self.metrics is not None:
             self.metrics["inflight"].add(1)
+            self.metrics["outbound_inflight"].set(self._outbound)
         begun = False
         trace: TraceContext | None = None
         mspan = tracing.NOOP_SPAN
@@ -303,8 +330,10 @@ class MigrationCoordinator:
             return {"ok": False, "reason": reason}
         finally:
             mspan.end()  # idempotent — closes the span on surprise exits
+            self._outbound -= 1
             if self.metrics is not None:
                 self.metrics["inflight"].add(-1)
+                self.metrics["outbound_inflight"].set(self._outbound)
 
     async def _await_caught_up(self, request_id: str) -> None:
         """Poll until the stream cursor trails the KV write head by at
@@ -355,12 +384,18 @@ class MigrationReceiver:
                  credit_bytes: int = DEFAULT_CREDIT_BYTES,
                  stall_timeout_s: float = 20.0, window_wait_s: float = 2.0,
                  stage_ttl_s: float = DEFAULT_STAGE_TTL_S,
-                 fetch_endpoint: str = "kv_fetch"):
+                 fetch_endpoint: str = "kv_fetch",
+                 budget: CreditBudget | None = None):
         self.rt = rt
         self.namespace = namespace
         self.chaos = chaos
         self.metrics = metrics
         self.credit_bytes = credit_bytes
+        # Migration pulls ride the BACKGROUND tier of the shared credit
+        # budget: each window's credit shrinks while disagg prefill
+        # pulls (the priority tier) hold credit, so rebalancing never
+        # starves the TTFT-critical plane.
+        self.budget = process_credit_budget() if budget is None else budget
         self.stall_timeout_s = stall_timeout_s
         self.window_wait_s = window_wait_s
         self.stage_ttl_s = stage_ttl_s
@@ -420,6 +455,8 @@ class MigrationReceiver:
                     credit_bytes=self.credit_bytes,
                     stall_timeout_s=self.stall_timeout_s,
                     window_wait_s=self.window_wait_s,
+                    budget=self.budget,
+                    budget_kind="migration",
                 )
             except BaseException:
                 span.end(status="error")

@@ -549,12 +549,24 @@ class WorkerRoleManager:
         }
 
     async def _migrate_out_cmd(self, payload: dict) -> dict:
-        """``{"cmd": "migrate_out", "request_id", "dest_instance"?}`` —
-        the planner/operator verb. Without a destination, round-robins
-        the live decode peers."""
+        """``{"cmd": "migrate_out", "request_id"?, "dest_instance"?}`` —
+        the planner/operator + fleet-balancer verb. Without a
+        destination, round-robins the live decode peers. Without a
+        request_id (the balancer's shape — it reasons about ENGINES, not
+        sequences), the worker auto-picks the cheapest victim: the
+        newest running sequence, which has accumulated the least KV and
+        therefore streams fastest."""
         if self.migrator is None:
             return {"error": "migration unsupported on this engine"}
         request_id = payload.get("request_id", "")
+        if not request_id:
+            running = (
+                list(self.engine.list_running())
+                if hasattr(self.engine, "list_running") else []
+            )
+            if not running:
+                return {"ok": False, "reason": "no_running"}
+            request_id = running[-1]
         dest = payload.get("dest_instance")
         if dest is None:
             peers = await self._peers()

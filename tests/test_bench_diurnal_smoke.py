@@ -1,0 +1,39 @@
+"""Tier-1 guard for ``benchmarks/diurnal.py --balancer``: the fleet
+hot-spot rebalancing arm (production BalancerLaw over the 120-engine
+DES) must actuate on the seeded skewed-placement burst, never ping-pong
+(no sequence migrated twice within the cooldown window), and deliver
+goodput at least equal to the no-balancer arm on the identical trace.
+
+``--quick`` halves the steady phase; the trace stays seeded, so the
+assertions are deterministic, not timing-dependent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_diurnal_balancer_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "diurnal.py"),
+         "--balancer", "--quick"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, proc.stdout + proc.stderr[-2000:]
+    result = json.loads(lines[-1])
+    assert "error" not in result, result
+    # The law actuated on the skewed burst...
+    assert result["rebalance_moves"] >= 1, result
+    # ...without ever moving a sequence twice inside the cooldown window.
+    assert result["pingpong_violations"] == 0, result
+    # Every offered request completed in both arms.
+    assert result["static"]["failed"] == 0
+    assert result["balancer"]["failed"] == 0
+    # Rebalancing never degrades goodput on the identical seeded trace.
+    assert result["value"] >= 1.0, result

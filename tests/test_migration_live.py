@@ -420,6 +420,147 @@ def test_preemption_during_migration_falls_back_clean():
     asyncio.run(go())
 
 
+def test_balancer_driven_move_survives_chaos_victims():
+    """The fleet balancer (production FleetBalancer over this cluster's
+    real admin plane) proposes the move; chaos kills the balancer-chosen
+    source, then the destination, mid-move. Each cell: typed fallback
+    (no exception leaks), NO cooldown opens (the balancer may retry from
+    live scores), and the client stream completes byte-identically —
+    zero failed streams."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.planner.actions import POOL_DECODE
+    from dynamo_tpu.planner.balancer import (
+        BalancerConfig,
+        BalancerLaw,
+        FleetBalancer,
+    )
+
+    async def go():
+        rng = np.random.default_rng(15)
+        prompt = rng.integers(1, CFG.vocab_size - 1, size=26).tolist()
+        n = 48
+        ref = await reference(prompt, n)
+        chaos = ChaosInjector(ChaosConfig(enabled=True, seed=7))
+        cluster = await Cluster("memory://miglive5").start(chaos=chaos)
+
+        def by_id(iid):
+            return cluster.a if iid == cluster.a.instance_id else cluster.b
+
+        async def pools():
+            return {POOL_DECODE: [
+                SimpleNamespace(instance_id=cluster.a.instance_id),
+                SimpleNamespace(instance_id=cluster.b.instance_id),
+            ]}
+
+        async def load_source(iid):
+            # Whoever is decoding is the hot spot; the peer is idle.
+            hot = bool(by_id(iid).engine.list_running())
+            return SimpleNamespace(
+                worker=SimpleNamespace(
+                    request_active_slots=4 if hot else 0,
+                    request_total_slots=4,
+                    num_requests_waiting=4 if hot else 0,
+                ),
+                kv=SimpleNamespace(gpu_cache_usage_perc=0.9 if hot else 0.0),
+            )
+
+        async def mover(src_iid, dst_iid):
+            src = by_id(src_iid)
+            running = src.engine.list_running()
+            if not running:
+                return {"ok": False, "reason": "no_running"}
+            return await cluster.migrate_rpc(src, running[-1], by_id(dst_iid))
+
+        balancer = FleetBalancer(
+            BalancerLaw(BalancerConfig(hysteresis_cycles=1)),
+            pools, load_source, mover,
+        )
+        try:
+            for victim in ("source", "dest"):
+                chaos.config = ChaosConfig(
+                    enabled=True, seed=7,
+                    migration_cut_plan=f"streaming:{victim}",
+                )
+                got, finish = [], []
+
+                async def run():
+                    async for item in cluster.operator.generate(
+                        greedy_request(prompt, n).to_dict(), Context()
+                    ):
+                        got.extend(item.get("token_ids") or [])
+                        if item.get("finish_reason"):
+                            finish.append(item["finish_reason"])
+
+                task = asyncio.get_running_loop().create_task(run())
+                moves = []
+                try:
+                    for _ in range(2000):
+                        if len(got) >= 4 or task.done():
+                            break
+                        await asyncio.sleep(0.005)
+                    moves = await balancer.step()
+                    await asyncio.wait_for(task, 120)
+                finally:
+                    if not task.done():
+                        task.cancel()
+                # THE invariant: the stream never notices the balancer's
+                # failed move.
+                assert got == ref, f"streaming:{victim} diverged"
+                assert finish == ["length"]
+                if moves:  # None only if the stream raced out
+                    move, outcome = balancer.moves_done[-1]
+                    assert outcome == "refused"
+                    # No cooldown on failure: the pair may retry next
+                    # cycle against live scores.
+                    assert (move.src, move.dst) not in \
+                        balancer.law._pair_cooldown_until
+                assert await drained(cluster.a.engine, cluster.b.engine)
+            st = balancer.status()
+            assert st["moves_actuated"] == 0
+            assert st["moves_proposed"] >= 1
+            # The coordinator ledger names chaos as every fallback cause.
+            fallbacks = {
+                **cluster.a.coordinator.fallback_reasons,
+                **cluster.b.coordinator.fallback_reasons,
+            }
+            assert any(r.startswith("chaos:streaming") for r in fallbacks), \
+                fallbacks
+            # Chaos off: the same balancer completes the move cleanly on
+            # a fresh stream — failure cost bandwidth, not the policy.
+            chaos.config = ChaosConfig(enabled=False)
+            got, finish = [], []
+
+            async def run2():
+                async for item in cluster.operator.generate(
+                    greedy_request(prompt, n).to_dict(), Context()
+                ):
+                    got.extend(item.get("token_ids") or [])
+                    if item.get("finish_reason"):
+                        finish.append(item["finish_reason"])
+
+            task = asyncio.get_running_loop().create_task(run2())
+            try:
+                for _ in range(2000):
+                    if len(got) >= 4 or task.done():
+                        break
+                    await asyncio.sleep(0.005)
+                moves = await balancer.step()
+                await asyncio.wait_for(task, 120)
+            finally:
+                if not task.done():
+                    task.cancel()
+            assert got == ref
+            assert finish == ["length"]
+            if moves:
+                assert balancer.moves_done[-1][1] == "ok"
+                assert balancer.status()["moves_actuated"] == 1
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
 def test_preemption_offers_migration_before_killing():
     """Under KV pressure the engine fires the migration-offer hook for
     the victim and waits a bounded grace before preempting — unserved

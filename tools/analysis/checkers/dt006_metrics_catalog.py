@@ -114,6 +114,13 @@ async def build_registries():
     migration_registry = MetricsRegistry()
     register_migration_metrics(migration_registry)
 
+    # Fleet-balancer series (planner/balancer.py): registered on their
+    # own registry as the planner CLI does under ``--balance on``.
+    from dynamo_tpu.planner.balancer import register_balancer_metrics
+
+    balancer_registry = MetricsRegistry()
+    register_balancer_metrics(balancer_registry)
+
     registries = [
         ("worker", wrt.metrics),
         ("frontend", frt.metrics),
@@ -121,6 +128,7 @@ async def build_registries():
         ("fleet", fleet_registry),
         ("planner", planner_registry),
         ("migration", migration_registry),
+        ("balancer", balancer_registry),
     ]
 
     async def cleanup():
