@@ -1,0 +1,563 @@
+"""BENCH_SIM: the cluster-scale control-plane proof (100/300/1000 engines).
+
+Four arms, every one driving REAL control-plane code — the DES-scale
+harness supplies traffic and failure churn, never a reimplementation of
+the logic under test (the diurnal-bench methodology, docs/autoscaler.md
+"measuring"):
+
+1. **placement** — the full ``KvPushRouter._place`` hot path (block
+   hashing, RadixIndex top-k lookup, roster cache, ActiveSequences
+   incremental load accounting, KvScheduler candidate pruning) at
+   100/300/1000 simulated engines under million-user tenant traffic:
+   Zipf tenant mix, multi-turn sessions whose chains extend across
+   turns, flash-crowd windows, and zonal failure churn (a quarter of
+   the fleet vanishes and returns, twice). Pruned (``shortlist_k=16``)
+   vs the full-scan oracle (``shortlist_k=0``) on the identical seeded
+   trace; records placement latency p50/p99, candidate counts, overlap
+   quality, an SLO-goodput proxy, and zone-failure handling time (the
+   per-worker-indexed ``remove_worker`` path).
+2. **mirror** — 10^6 distinct conversations through the real
+   :class:`RouterDecisionCache` over a memory store; the LRU mirror
+   must stay bounded under its configured cap while the store carries
+   the full key population. Reports peak mirror size and write rate.
+3. **budget** — real :class:`GlobalBudget` processes claim the full
+   fleet admission budget; the largest holders crash (leases stop
+   renewing) and the arm measures wall time until the survivors'
+   held slots re-converge to the full budget.
+4. **flap** — the diurnal closed-loop autoscaler (real ControlLaw +
+   SlaAutoscaler) rides a flash-crowd day per fleet size; a *flap* is
+   a pool move reversed within ``2 × interval`` — the sweep must show
+   zero.
+
+Writes BENCH_SIM_r20.json-shaped output (``--out``), prints JSON on
+stdout. ``--quick`` shrinks every arm for the tier-1 smoke and asserts
+the structural invariants itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import itertools
+import json
+import random
+import time
+from collections import deque
+
+import numpy as np
+
+from dynamo_tpu.fleet.budget import GlobalBudget
+from dynamo_tpu.fleet.decisions import RouterDecisionCache
+from dynamo_tpu.kv_router.indexer import RadixIndex
+from dynamo_tpu.kv_router.protocols import KvCacheEvent, StoredBlock
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouterConfig
+from dynamo_tpu.kv_router.scheduler import KvScheduler, KvSchedulerConfig
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+from dynamo_tpu.runtime.store import MemoryStore
+
+BS = 16            # tokens per KV block
+MAX_CHAIN = 14     # longest session chain, blocks
+SLO_MS = 250.0     # TTFT-proxy SLO for the goodput comparison
+ZONES = 4
+
+
+# ---------------------------------------------------------------------------
+# Traffic model: million-user tenant mix + flash crowds + zonal churn
+# ---------------------------------------------------------------------------
+
+
+def session_tokens(sid: int, n_blocks: int) -> list[int]:
+    """Deterministic per-session token stream: turn N's prompt extends
+    turn N-1's exactly, so chained block hashes build real multi-turn
+    prefix structure without storing a million token lists."""
+    base = sid * 1_000_003 + 12_345
+    return [(base + p * 69_069) % 50_021 for p in range(n_blocks * BS)]
+
+
+def gen_traffic(n_requests: int, seed: int, n_tenants: int = 64,
+                sessions_per_tenant: int = 4096):
+    """→ (requests, churn, crowds). Each request is
+    (session_id, total_blocks, prefix_blocks, gen_tokens); ``churn``
+    maps request index → ("fail"|"restore", zone); ``crowds`` lists the
+    flash-crowd windows. Tenants draw Zipf(1.1); inside a crowd window
+    half the arrivals pile onto one tenant — the cache-herding regime.
+    Generated once per fleet size and replayed identically by the
+    pruned and full-scan arms."""
+    rng = random.Random(seed)
+    cum = list(itertools.accumulate(1.0 / (i + 1) ** 1.1 for i in range(n_tenants)))
+    crowds = []
+    c0 = n_requests // 6
+    for c in range(3):
+        start = c0 + c * (n_requests // 4)
+        crowds.append((start, min(start + n_requests // 20, n_requests),
+                       rng.randrange(max(1, n_tenants // 4))))
+
+    def crowd_tenant(i: int):
+        for a, b, t in crowds:
+            if a <= i < b:
+                return t
+        return None
+
+    totals: dict[int, int] = {}
+    reqs: list[tuple[int, int, int, int]] = []
+    for i in range(n_requests):
+        ct = crowd_tenant(i)
+        if ct is not None and rng.random() < 0.5:
+            tenant = ct
+        else:
+            tenant = rng.choices(range(n_tenants), cum_weights=cum)[0]
+        # Quadratic skew inside the tenant too: a few hot conversations.
+        sid = tenant * sessions_per_tenant + int(
+            rng.random() ** 2 * sessions_per_tenant)
+        prev = totals.get(sid, 0)
+        total = min(prev + rng.randint(1, 3), MAX_CHAIN)
+        prefix = min(prev, total)
+        totals[sid] = total
+        reqs.append((sid, total, prefix, rng.randint(16, 96)))
+
+    churn: dict[int, tuple[str, int]] = {}
+    z1 = rng.randrange(ZONES)
+    z2 = (z1 + 1 + rng.randrange(ZONES - 1)) % ZONES
+    churn[int(n_requests * 0.45)] = ("fail", z1)
+    churn[int(n_requests * 0.60)] = ("restore", z1)
+    churn[int(n_requests * 0.75)] = ("fail", z2)
+    churn[int(n_requests * 0.85)] = ("restore", z2)
+    return reqs, churn, crowds
+
+
+def zone_ids(fleet: int, zone: int) -> list[int]:
+    return [w for w in range(1, fleet + 1) if (w - 1) * ZONES // fleet == zone]
+
+
+# ---------------------------------------------------------------------------
+# Placement arms: the real _place under churned traffic
+# ---------------------------------------------------------------------------
+
+
+class _SimDiscovery:
+    """The discovery surface _place reads: a version counter and the
+    live roster; zonal churn mutates both, exactly what a lease-expiry
+    wave (and the recovery re-registrations) does to the real client."""
+
+    def __init__(self, ids):
+        self._order = list(ids)
+        self._live = set(ids)
+        self.version = 1
+
+    def instance_ids(self) -> list[int]:
+        return [w for w in self._order if w in self._live]
+
+    def fail(self, ids) -> None:
+        self._live -= set(ids)
+        self.version += 1
+
+    def restore(self, ids) -> None:
+        self._live |= set(ids)
+        self.version += 1
+
+
+def build_router(fleet: int, shortlist_k: int, seed: int) -> KvPushRouter:
+    r = KvPushRouter.__new__(KvPushRouter)
+    r.config = KvRouterConfig(block_size=BS, shortlist_k=shortlist_k)
+    r.event_sink = None
+    r.decisions = None
+    r.directory = None
+    r._m = {}
+    r.discovery = _SimDiscovery(range(1, fleet + 1))
+    r.scheduler = KvScheduler(
+        KvSchedulerConfig(shortlist_k=shortlist_k,
+                          least_loaded_m=r.config.least_loaded_m),
+        rng=random.Random(seed),
+    )
+    r.active = ActiveSequences()
+    r.index = RadixIndex()
+    r._roster = []
+    r._roster_set = set()
+    r._roster_version = -1
+    r._roster_stamp = 0.0
+    return r
+
+
+def run_placement_arm(fleet: int, shortlist_k: int, trace, churn,
+                      seed: int) -> dict:
+    """Replay the seeded trace through the real _place. After each
+    placement the chosen engine 'publishes' its stored chain back into
+    the index (the KV-event feedback loop), the active ledger admits
+    the request, and old requests free — so load accounting, heap
+    churn, and index growth all run at production cadence."""
+    router = build_router(fleet, shortlist_k, seed + shortlist_k)
+    eid = dict.fromkeys(range(1, fleet + 1), 0)
+    lat: list[float] = []
+    inflight: deque[str] = deque()
+    cands = 0
+    fallbacks = 0
+    overlap_sum = 0
+    attained_tokens = 0
+    offered_tokens = 0
+    attained_n = 0
+    churn_events = []
+    rate_rps = 2.0 * fleet  # virtual arrival rate → goodput denominator
+    for i, (sid, total_b, _prefix_b, gen) in enumerate(trace):
+        ev = churn.get(i)
+        if ev is not None:
+            kind, zone = ev
+            ids = zone_ids(fleet, zone)
+            t0 = time.perf_counter()
+            if kind == "fail":
+                router.discovery.fail(ids)
+                for wid in ids:
+                    router.index.remove_worker(wid)
+                    router.active.remove_worker(wid)
+            else:
+                router.discovery.restore(ids)
+            churn_events.append({
+                "at_request": i, "kind": kind, "zone": zone,
+                "workers": len(ids),
+                "handled_ms": round((time.perf_counter() - t0) * 1000, 3),
+            })
+        toks = session_tokens(sid, total_b)
+        t0 = time.perf_counter()
+        placement, hashes, _scores, _workers, _ = router._place(toks)
+        lat.append(time.perf_counter() - t0)
+        cands += placement.candidates_considered
+        overlap_sum += placement.overlap_blocks
+        if shortlist_k > 0 and placement.full_scan:
+            fallbacks += 1
+        w = placement.worker
+        # Engine feedback: the placed worker now holds the full chain.
+        eid[w] += 1
+        blocks, parent = [], None
+        for h in hashes:
+            blocks.append(StoredBlock(h, parent))
+            parent = h
+        router.index.apply(w, KvCacheEvent.stored(blocks, event_id=eid[w]))
+        rid = f"r{i}"
+        router.active.add_request(
+            rid, w, placement.total_blocks, placement.overlap_blocks, len(toks))
+        inflight.append(rid)
+        if len(inflight) > 4 * fleet:
+            router.active.free(inflight.popleft())
+        # SLO-goodput proxy: TTFT grows with the prefill the placement
+        # did NOT save (total - overlap) and with the chosen engine's
+        # queued blocks. Identical model in both arms — only the
+        # placement decisions differ.
+        eff = placement.total_blocks - placement.overlap_blocks
+        ttft_ms = 30.0 + 20.0 * eff + 6.0 * router.active.active_blocks(w)
+        offered_tokens += gen
+        if ttft_ms <= SLO_MS:
+            attained_tokens += gen
+            attained_n += 1
+    duration_s = len(trace) / rate_rps
+    return {
+        "shortlist_k": shortlist_k,
+        "requests": len(trace),
+        "place_p50_us": round(float(np.percentile(lat, 50)) * 1e6, 1),
+        "place_p99_us": round(float(np.percentile(lat, 99)) * 1e6, 1),
+        "mean_candidates": round(cands / len(trace), 1),
+        "fallback_rate": round(fallbacks / len(trace), 4),
+        "mean_overlap_blocks": round(overlap_sum / len(trace), 3),
+        "slo_goodput_tok_s": round(attained_tokens / duration_s, 1),
+        "slo_attained_frac": round(attained_n / len(trace), 4),
+        "zone_churn": churn_events,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Mirror arm: 10^6 conversations through the real decision cache
+# ---------------------------------------------------------------------------
+
+
+async def run_mirror_arm(conversations: int, cap: int, fleet: int,
+                         seed: int) -> dict:
+    store = MemoryStore()
+    cache = await RouterDecisionCache(
+        store, "sim", ttl=3600.0, max_entries=cap).start()
+    scoped = cache.scoped("m")
+    rng = random.Random(seed)
+    peak = 0
+    t0 = time.perf_counter()
+    for i in range(conversations):
+        h = (i * 0x9E3779B97F4A7C15 + 1) & ((1 << 63) - 1)
+        scoped.record([h], rng.randrange(1, fleet + 1))
+        if i % 1024 == 0:
+            await asyncio.sleep(0)  # let writes + watch echoes drain
+            while len(cache._bg) > 4096:
+                await asyncio.sleep(0)
+        if i % 8192 == 0:
+            peak = max(peak, len(cache._mirror))
+    while cache._bg:
+        await asyncio.sleep(0)
+    await asyncio.sleep(0.1)  # final watch-echo drain
+    peak = max(peak, len(cache._mirror))
+    elapsed = time.perf_counter() - t0
+    last_h = ((conversations - 1) * 0x9E3779B97F4A7C15 + 1) & ((1 << 63) - 1)
+    recent_hit = scoped.lookup([last_h]) is not None
+    first_evicted = scoped.lookup([1]) is None if conversations > cap else True
+    out = {
+        "conversations": conversations,
+        "configured_cap": cap,
+        "peak_mirror_entries": peak,
+        "final_mirror_entries": len(cache._mirror),
+        "store_keys": len(store._data),
+        "writes_per_s": round(conversations / elapsed),
+        "recent_lookup_hit": recent_hit,
+        "oldest_evicted": first_evicted,
+        "bounded": peak <= cap,
+    }
+    await cache.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Budget arm: crash the holders, time the re-convergence
+# ---------------------------------------------------------------------------
+
+
+async def run_budget_arm(processes: int, total: int, crash: int,
+                         crash_ttl: float = 0.6) -> dict:
+    store = MemoryStore()
+    budgets = []
+    for i in range(processes):
+        lease = await store.grant_lease(crash_ttl if i < crash else 30.0)
+        b = GlobalBudget(store, "sim", lease, total=total, chunk_slots=8,
+                         worker_id=i, demand_fn=lambda: total)
+        await b.start()
+        budgets.append((b, lease))
+    t0 = time.monotonic()
+    while sum(b.held_slots for b, _ in budgets) < total:
+        await asyncio.sleep(0.02)
+        if time.monotonic() - t0 > 20:
+            raise RuntimeError("initial budget claim never completed")
+    initial_claim_s = time.monotonic() - t0
+    lost = sum(b.held_slots for b, _ in budgets[:crash])
+    # Crash: managers stop, leases stop renewing — chunks reclaim by TTL.
+    for b, _ in budgets[:crash]:
+        for t in (b._task, b._watch_task):
+            if t is not None:
+                t.cancel()
+    t1 = time.monotonic()
+    while sum(b.held_slots for b, _ in budgets[crash:]) < total:
+        for _, lease in budgets[crash:]:
+            await store.keep_alive(lease)
+        await asyncio.sleep(0.05)
+        if time.monotonic() - t1 > 30:
+            break
+    survivors_held = sum(b.held_slots for b, _ in budgets[crash:])
+    convergence_s = time.monotonic() - t1
+    for b, _ in budgets[crash:]:
+        await b.close()
+    return {
+        "processes": processes,
+        "budget_total": total,
+        "crashed": crash,
+        "crashed_held_slots": lost,
+        "initial_claim_s": round(initial_claim_s, 3),
+        "convergence_s": round(convergence_s, 3),
+        "survivors_held_slots": survivors_held,
+        "reconverged": survivors_held == total,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Flap arm: the closed-loop autoscaler through a flash crowd
+# ---------------------------------------------------------------------------
+
+
+FLAP_INTERVAL_S = 5.0
+FLAP_WINDOW_S = 2 * FLAP_INTERVAL_S
+
+
+async def run_flap_arm(fleet: int, seed: int, scale: float = 1.0) -> dict:
+    from benchmarks.diurnal import (
+        Phase,
+        gen_trace,
+        run_closed_loop_arm,
+        synth_profile,
+    )
+
+    rate = 0.12 * fleet
+    phases = [
+        Phase("steady", 20.0 * scale, rate, 128, 48),
+        Phase("crowd", 12.0 * scale, rate * 3.5, 512, 32),
+        Phase("recover", 28.0 * scale, rate, 128, 48),
+    ]
+    day_s = sum(p.dur_s for p in phases)
+    trace = gen_trace(phases, seed)
+    closed = await run_closed_loop_arm(
+        trace, synth_profile(), fleet, max(1, fleet // 10), day_s,
+        ttft_slo_s=2.0, itl_slo_ms=40.0, interval_s=FLAP_INTERVAL_S,
+        seed=seed + fleet,
+    )
+    timeline = closed.get("pool_timeline", [])
+    # A flap is a pool move REVERSED within the window: prefill count
+    # moves one way, then back, faster than the control law's own
+    # hysteresis horizon. Tracking the crowd up then back down over tens
+    # of seconds is control; reversing inside 2 intervals is oscillation.
+    flaps = 0
+    deltas = []
+    prev_p = None
+    for t, p, _d in timeline:
+        if prev_p is not None and p != prev_p:
+            deltas.append((t, p - prev_p))
+        prev_p = p
+    for (t_a, d_a), (t_b, d_b) in zip(deltas, deltas[1:]):
+        if d_a * d_b < 0 and (t_b - t_a) < FLAP_WINDOW_S:
+            flaps += 1
+    return {
+        "workers": fleet,
+        "offered_requests": len(trace),
+        "moves_applied": closed["moves_applied"],
+        "flaps": flaps,
+        "failed": closed["failed"],
+        "actions_error": closed["actions_error"],
+        "slo_goodput_tok_s": closed["slo_goodput_tok_s"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Assembly
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fleets", type=int, nargs="*", default=[100, 300, 1000])
+    ap.add_argument("--requests", type=int, default=20_000,
+                    help="placement-arm trace length per fleet size")
+    ap.add_argument("--conversations", type=int, default=1_000_000)
+    ap.add_argument("--mirror-cap", type=int, default=250_000)
+    ap.add_argument("--seed", type=int, default=20)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON result to this path")
+    ap.add_argument("--quick", action="store_true",
+                    help="shrunken arms + structural asserts (tier-1 smoke)")
+    args = ap.parse_args(argv)
+    flap_scale = 1.0
+    if args.quick:
+        args.fleets = [100]
+        args.requests = 3000
+        args.conversations = 60_000
+        args.mirror_cap = 20_000
+        flap_scale = 0.5
+
+    placement: dict[str, dict] = {}
+    for fleet in args.fleets:
+        trace, churn, crowds = gen_traffic(args.requests, args.seed + fleet)
+        pruned = run_placement_arm(fleet, 16, trace, churn, args.seed)
+        full = run_placement_arm(fleet, 0, trace, churn, args.seed)
+        speedup = full["place_p99_us"] / max(pruned["place_p99_us"], 1e-9)
+        ratio = (
+            pruned["slo_goodput_tok_s"] / full["slo_goodput_tok_s"]
+            if full["slo_goodput_tok_s"] > 0 else float("inf")
+        )
+        placement[str(fleet)] = {
+            "flash_crowds": [
+                {"from": a, "to": b, "tenant": t} for a, b, t in crowds
+            ],
+            "pruned": pruned,
+            "full_scan_oracle": full,
+            "p99_speedup_x": round(speedup, 2),
+            "goodput_ratio_vs_oracle": round(ratio, 4),
+        }
+        print(json.dumps({"arm": "placement", "fleet": fleet,
+                          "p99_speedup_x": round(speedup, 2),
+                          "goodput_ratio": round(ratio, 4)}), flush=True)
+
+    mirror = asyncio.run(run_mirror_arm(
+        args.conversations, args.mirror_cap, max(args.fleets), args.seed))
+    print(json.dumps({"arm": "mirror", "peak": mirror["peak_mirror_entries"],
+                      "bounded": mirror["bounded"]}), flush=True)
+
+    budget = asyncio.run(run_budget_arm(
+        processes=4 if args.quick else 8,
+        total=64 if args.quick else 512,
+        crash=1 if args.quick else 2,
+    ))
+    print(json.dumps({"arm": "budget",
+                      "convergence_s": budget["convergence_s"],
+                      "reconverged": budget["reconverged"]}), flush=True)
+
+    flap = {}
+    for fleet in args.fleets:
+        flap[str(fleet)] = asyncio.run(run_flap_arm(
+            fleet, args.seed, scale=flap_scale))
+        print(json.dumps({"arm": "flap", "fleet": fleet,
+                          "flaps": flap[str(fleet)]["flaps"]}), flush=True)
+
+    biggest = str(max(args.fleets))
+    goodput_dev = max(
+        abs(1.0 - placement[str(f)]["goodput_ratio_vs_oracle"])
+        for f in args.fleets
+    )
+    acceptance = {
+        "p99_speedup_at_largest_x": placement[biggest]["p99_speedup_x"],
+        "p99_speedup_floor_x": 5.0,
+        "goodput_max_deviation_vs_oracle": round(goodput_dev, 4),
+        "goodput_within_2pct": goodput_dev <= 0.02,
+        "mirror_bounded": mirror["bounded"],
+        "budget_reconverged": budget["reconverged"],
+        "zero_flapping": all(f["flaps"] == 0 for f in flap.values()),
+    }
+    acceptance["ok"] = (
+        (args.quick or placement[biggest]["p99_speedup_x"] >= 5.0)
+        and acceptance["goodput_within_2pct"]
+        and acceptance["mirror_bounded"]
+        and acceptance["budget_reconverged"]
+        and acceptance["zero_flapping"]
+    )
+    result = {
+        "bench": "BENCH_SIM",
+        "round": 20,
+        "fleets": args.fleets,
+        "traffic": {
+            "requests_per_fleet": args.requests,
+            "tenants": 64,
+            "session_space": 64 * 4096,
+            "max_chain_blocks": MAX_CHAIN,
+            "zones": ZONES,
+            "slo_proxy_ms": SLO_MS,
+        },
+        "placement": placement,
+        "mirror": mirror,
+        "budget": budget,
+        "flap": flap,
+        "acceptance": acceptance,
+        "note": (
+            "All arms execute the production control-plane code "
+            "(KvPushRouter._place / RadixIndex / ActiveSequences / "
+            "KvScheduler, RouterDecisionCache, GlobalBudget, ControlLaw "
+            "+ SlaAutoscaler) under a DES-scale harness; 1000 real "
+            "engines cannot share this host, and the per-engine data "
+            "plane is benchmarked separately (BENCH_FRONTEND/BENCH_"
+            "DISAGG). Latencies are wall-clock on the bench host; the "
+            "pruned-vs-full comparison is the signal, not the absolute "
+            "microseconds."
+        ),
+    }
+    if not acceptance["ok"]:
+        result["error"] = "acceptance criteria not met: " + json.dumps(acceptance)
+
+    if args.quick:
+        p = placement[biggest]
+        assert p["p99_speedup_x"] > 1.2, p
+        assert p["pruned"]["fallback_rate"] < 0.5, p
+        assert abs(1.0 - p["goodput_ratio_vs_oracle"]) <= 0.05, p
+        assert mirror["bounded"] and mirror["recent_lookup_hit"], mirror
+        assert mirror["oldest_evicted"], mirror
+        assert budget["reconverged"], budget
+        assert acceptance["zero_flapping"], flap
+        assert all(
+            e["handled_ms"] < 200.0
+            for e in p["pruned"]["zone_churn"] if e["kind"] == "fail"
+        ), p["pruned"]["zone_churn"]
+        print("QUICK-OK")
+
+    print(json.dumps(result), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    return 1 if "error" in result else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,6 +25,14 @@ granted each half-TTL, so an entry lives between TTL/2 and TTL and the
 store reclaims it (emitting DELETEs that prune every mirror). On drain
 the process revokes its active leases outright — a restarting fleet must
 not serve yesterday's placements (see docs/frontend-fleet.md).
+
+Bounded memory: the mirror is an LRU capped at ``max_entries`` — under
+million-conversation traffic the lease TTL alone is not a memory bound
+(every live conversation writes one entry per turn), so inserts beyond
+the cap evict the coldest entry locally (the store copy still expires by
+lease; eviction is per-mirror, not fleet-wide). A per-worker key index
+makes the dead-worker tombstone sweep O(worker's entries) instead of a
+full-mirror scan (docs/performance.md "Control-plane scaling").
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ import asyncio
 import contextlib
 import json
 import time
+from collections import OrderedDict
 
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.store import EventKind, KeyValueStore
@@ -48,6 +57,11 @@ def route_prefix(fleet_id: str, scope: str | None = None) -> str:
 class RouterDecisionCache:
     """One per frontend process; scoped per model via :meth:`scoped`."""
 
+    # Default mirror cap: sized for ~10^6-conversation fleets at roughly
+    # 50 MB of dict+tuple overhead per frontend; raise it in config for
+    # memory-rich frontends, lower it for sidecars.
+    DEFAULT_MAX_ENTRIES = 1_000_000
+
     def __init__(
         self,
         store: KeyValueStore,
@@ -55,11 +69,18 @@ class RouterDecisionCache:
         ttl: float = 120.0,
         metrics: dict | None = None,
         clock=time.monotonic,
+        max_entries: int = DEFAULT_MAX_ENTRIES,
     ):
         self.store = store
         self.fleet_id = fleet_id
         self.ttl = ttl
-        self._mirror: dict[tuple[str, int], tuple[int, int]] = {}
+        self.max_entries = max(1, max_entries)
+        # LRU mirror: reads refresh recency, inserts beyond the cap evict
+        # the coldest entry (local memory bound only — the store copy
+        # expires via its lease and DELETE-prunes every mirror).
+        self._mirror: OrderedDict[tuple[str, int], tuple[int, int]] = OrderedDict()
+        # worker id → keys pointing at it (dead-worker sweep index).
+        self._by_worker: dict[int, set[tuple[str, int]]] = {}
         self._watch = None
         self._watch_task: asyncio.Task | None = None
         self._workers_watch = None
@@ -112,7 +133,7 @@ class RouterDecisionCache:
         store keys so peers and late-joining snapshots prune too (the
         deletes race across frontends watching the same registration
         prefix, but delete is idempotent)."""
-        dead = [k for k, v in self._mirror.items() if v[0] == worker]
+        dead = list(self._by_worker.pop(worker, ()))
         if not dead:
             return
         for k in dead:
@@ -168,16 +189,49 @@ class RouterDecisionCache:
         except ValueError:
             return None
 
+    def _discard(self, key: tuple[str, int]) -> None:
+        old = self._mirror.pop(key, None)
+        if old is None:
+            return
+        held = self._by_worker.get(old[0])
+        if held is not None:
+            held.discard(key)
+            if not held:
+                del self._by_worker[old[0]]
+
+    def _insert(self, key: tuple[str, int], worker: int, blocks: int) -> None:
+        old = self._mirror.get(key)
+        if old is not None and old[0] != worker:
+            held = self._by_worker.get(old[0])
+            if held is not None:
+                held.discard(key)
+                if not held:
+                    del self._by_worker[old[0]]
+        self._mirror[key] = (worker, blocks)
+        self._mirror.move_to_end(key)
+        self._by_worker.setdefault(worker, set()).add(key)
+        evicted = 0
+        while len(self._mirror) > self.max_entries:
+            k, (w, _) = self._mirror.popitem(last=False)
+            held = self._by_worker.get(w)
+            if held is not None:
+                held.discard(k)
+                if not held:
+                    del self._by_worker[w]
+            evicted += 1
+        if evicted and "evictions" in self._m:
+            self._m["evictions"].inc(evicted)
+
     def _apply(self, key: str, value: bytes | None) -> None:
         parsed = self._parse_key(key)
         if parsed is None:
             return
         if value is None:
-            self._mirror.pop(parsed, None)
+            self._discard(parsed)
         else:
             try:
                 d = json.loads(value)
-                self._mirror[parsed] = (int(d["w"]), int(d["b"]))
+                self._insert(parsed, int(d["w"]), int(d["b"]))
             except (ValueError, KeyError, TypeError):
                 log.warning("bad decision entry at %s", key)
                 return
@@ -197,8 +251,10 @@ class RouterDecisionCache:
         """→ (worker_id, shared_prefix_blocks) for the deepest cached
         decision along this request's hash chain, or None. Local-only."""
         for i in range(len(hashes) - 1, -1, -1):
-            hit = self._mirror.get((scope, hashes[i]))
+            key = (scope, hashes[i])
+            hit = self._mirror.get(key)
             if hit is not None:
+                self._mirror.move_to_end(key)  # LRU: a hit is recency
                 if "hits" in self._m:
                     self._m["hits"].inc(model=scope)
                 return hit[0], i + 1
@@ -214,7 +270,7 @@ class RouterDecisionCache:
             return  # already published (the common repeated-turn case)
         # Optimistic local insert so back-to-back turns on THIS process
         # hit before the watch echo arrives.
-        self._mirror[key_tuple] = (worker, len(hashes))
+        self._insert(key_tuple, worker, len(hashes))
         task = asyncio.get_running_loop().create_task(
             self._write(scope, hashes[-1], worker, len(hashes))
         )
@@ -236,7 +292,7 @@ class RouterDecisionCache:
             # Drop the optimistic insert: an entry that never reached the
             # store has no DELETE event coming to prune it.
             if self._mirror.get((scope, h), (None,))[0] == worker:
-                self._mirror.pop((scope, h), None)
+                self._discard((scope, h))
 
     async def _write_lease(self) -> int:
         now = self._clock()

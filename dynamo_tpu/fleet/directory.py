@@ -185,11 +185,22 @@ class PrefixDirectory:
     all queries are local dict probes (no store round-trip on the
     routing hot path — same contract as RouterDecisionCache)."""
 
-    def __init__(self, store: KeyValueStore, scope: str, metrics: dict | None = None):
+    def __init__(self, store: KeyValueStore, scope: str, metrics: dict | None = None,
+                 max_worker_entries: int = 8192):
         self.store = store
         self.scope = scope
+        # Defensive per-worker bound: publishers cap their snapshots at
+        # 4096 newest entries, but the mirror must stay bounded even
+        # against an oversized/foreign publisher — keep the newest-seq
+        # entries and drop the cold tail.
+        self.max_worker_entries = max(1, max_worker_entries)
         # worker_id → {hash: (tier, seq)}
         self._workers: dict[int, dict[int, tuple[int, int]]] = {}
+        # Inverted index, maintained incrementally by diffing snapshots
+        # in _apply: hash → holder worker ids. Turns best_runs/holders/
+        # heat from O(workers × chain) scans into O(chain + holders)
+        # walks (docs/performance.md "Control-plane scaling").
+        self._inv: dict[int, set[int]] = {}
         self._watch = None
         self._watch_task: asyncio.Task | None = None
         self._m = metrics or {}
@@ -223,21 +234,51 @@ class PrefixDirectory:
         except ValueError:
             return
         if value is None:
-            self._workers.pop(wid, None)
+            old = self._workers.pop(wid, None)
+            if old:
+                self._unindex(wid, old)
         else:
             try:
                 d = json.loads(value)
-                self._workers[int(d["w"])] = {
+                wid = int(d["w"])
+                new = {
                     int(h, 16): (int(ts[0]), int(ts[1]))
                     for h, ts in d["h"].items()
                 }
             except (ValueError, KeyError, TypeError, IndexError):
                 log.warning("bad kvdir entry at %s", key)
                 return
+            if len(new) > self.max_worker_entries:
+                keep = sorted(new.items(), key=lambda kv: -kv[1][1])
+                new = dict(keep[: self.max_worker_entries])
+            old = self._workers.get(wid)
+            if old:
+                for h in old:
+                    if h not in new:
+                        holders = self._inv.get(h)
+                        if holders is not None:
+                            holders.discard(wid)
+                            if not holders:
+                                del self._inv[h]
+                for h in new:
+                    if h not in old:
+                        self._inv.setdefault(h, set()).add(wid)
+            else:
+                for h in new:
+                    self._inv.setdefault(h, set()).add(wid)
+            self._workers[wid] = new
         if "entries" in self._m:
             self._m["entries"].set(
                 sum(len(hs) for hs in self._workers.values())
             )
+
+    def _unindex(self, wid: int, holdings: dict[int, tuple[int, int]]) -> None:
+        for h in holdings:
+            holders = self._inv.get(h)
+            if holders is not None:
+                holders.discard(wid)
+                if not holders:
+                    del self._inv[h]
 
     # -- queries -----------------------------------------------------------
 
@@ -247,8 +288,8 @@ class PrefixDirectory:
     def holders(self, block_hash: int) -> dict[int, int]:
         """→ {worker_id: warmest tier} for every holder of one block."""
         out: dict[int, int] = {}
-        for wid, holdings in self._workers.items():
-            hit = holdings.get(block_hash)
+        for wid in self._inv.get(block_hash, ()):
+            hit = self._workers[wid].get(block_hash)
             if hit is not None:
                 out[wid] = hit[0]
         return out
@@ -268,12 +309,29 @@ class PrefixDirectory:
 
     def best_runs(self, hashes: list[int]) -> dict[int, int]:
         """→ {worker_id: leading-run depth} for every worker with a
-        non-empty run — the router's per-candidate fetchable view."""
+        non-empty run — the router's per-candidate fetchable view.
+
+        Walks the chain once over the inverted index, recording each
+        holder's depth at the step it stops matching: O(chain + holders),
+        independent of fleet size."""
         out: dict[int, int] = {}
-        for wid in self._workers:
-            n = self.run_depth(wid, hashes)
-            if n:
-                out[wid] = n
+        alive: set[int] | None = None
+        depth = 0
+        for d, h in enumerate(hashes, start=1):
+            holders = self._inv.get(h)
+            if not holders:
+                break
+            current = holders if alive is None else alive & holders
+            if not current:
+                break
+            if alive is not None and len(current) < len(alive):
+                for w in alive - current:
+                    out[w] = d - 1
+            alive = set(current)
+            depth = d
+        if alive:
+            for w in alive:
+                out[w] = depth
         return out
 
     def heat(self, worker_id: int) -> float:
@@ -287,10 +345,6 @@ class PrefixDirectory:
             return 0.0
         total = 0.0
         for h, (tier, _seq) in holdings.items():
-            others = sum(
-                1
-                for wid, hs in self._workers.items()
-                if wid != worker_id and h in hs
-            )
+            others = len(self._inv.get(h, ())) - 1
             total += 1.0 / ((1 + others) * tier)
         return total

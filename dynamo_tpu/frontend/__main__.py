@@ -52,6 +52,11 @@ def parse_args(argv=None):
                    help="run the KV index across N shard threads so event "
                         "floods never stall routing (0 = in-loop index; "
                         "reference: KvIndexerSharded)")
+    p.add_argument("--shortlist-k", type=int, default=16,
+                   help="placement candidate pruning: score only the index's "
+                        "top-k holder shortlist + least-loaded workers instead "
+                        "of the whole fleet (0 = full scan, the legacy "
+                        "byte-identical path; docs/performance.md)")
     p.add_argument("--record-dir", default=None,
                    help="record response streams + routing events to JSONL here "
                         "(replayable offline; llm/recorder.py)")
@@ -134,6 +139,7 @@ async def async_main(args) -> None:
             router_temperature=args.router_temperature,
             use_kv_events=not args.no_kv_events,
             index_shards=args.index_shards,
+            shortlist_k=args.shortlist_k,
         )
 
     fleet_metrics = budget = decisions = directory = None

@@ -25,7 +25,10 @@ WorkerId = int
 
 @dataclass
 class OverlapScores:
-    """worker → number of consecutive prompt blocks already cached there."""
+    """worker → number of consecutive prompt blocks already cached there.
+
+    When produced with ``top_k > 0`` the dict holds only the k deepest
+    holders (a ranked shortlist), not every holder in the fleet."""
 
     scores: dict[WorkerId, int] = field(default_factory=dict)
 
@@ -53,11 +56,38 @@ class RadixIndex:
 
     # -- queries ----------------------------------------------------------
 
-    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+    def find_matches(self, seq_hashes: list[int], top_k: int = 0) -> OverlapScores:
         """Per-worker consecutive-prefix depth over the request's block
-        hash chain."""
-        scores: dict[WorkerId, int] = {}
+        hash chain.
+
+        ``top_k == 0``: full scores dict, every holder (legacy behavior,
+        byte-identical to the pre-shortlist code path).
+
+        ``top_k > 0``: ranked shortlist of at most ``top_k`` holders,
+        deepest first. Instead of rewriting every surviving worker's
+        score at every depth (O(holders x chain)), the walk records only
+        *drop events* — the depth at which a worker stops matching — and
+        scores each holder exactly once: O(chain + holders)."""
+        if top_k <= 0:
+            scores: dict[WorkerId, int] = {}
+            alive: set[WorkerId] | None = None
+            for depth, h in enumerate(seq_hashes, start=1):
+                node = self._nodes.get(h)
+                if node is None or not node.workers:
+                    break
+                current = node.workers if alive is None else (alive & node.workers)
+                if not current:
+                    break
+                for w in current:
+                    scores[w] = depth
+                alive = set(current)
+            return OverlapScores(scores)
+        return self._find_top_k(seq_hashes, top_k)
+
+    def _find_top_k(self, seq_hashes: list[int], top_k: int) -> OverlapScores:
         alive: set[WorkerId] | None = None
+        drops: list[tuple[int, set[WorkerId]]] = []  # (depth scored, workers)
+        depth_reached = 0
         for depth, h in enumerate(seq_hashes, start=1):
             node = self._nodes.get(h)
             if node is None or not node.workers:
@@ -65,9 +95,23 @@ class RadixIndex:
             current = node.workers if alive is None else (alive & node.workers)
             if not current:
                 break
-            for w in current:
-                scores[w] = depth
+            if alive is not None and len(current) < len(alive):
+                drops.append((depth - 1, alive - current))
             alive = set(current)
+            depth_reached = depth
+        scores: dict[WorkerId, int] = {}
+        if alive:
+            for w in alive:
+                scores[w] = depth_reached
+                if len(scores) >= top_k:
+                    break
+        for d, ws in reversed(drops):
+            if len(scores) >= top_k:
+                break
+            for w in ws:
+                scores[w] = d
+                if len(scores) >= top_k:
+                    break
         return OverlapScores(scores)
 
     def workers(self) -> set[WorkerId]:
@@ -100,9 +144,7 @@ class RadixIndex:
             for h in event.block_hashes:
                 self._remove(worker, h)
         elif event.kind == CLEARED:
-            blocks = self._worker_blocks.get(worker, set())
-            for h in list(blocks):
-                self._remove(worker, h)
+            self._drop_blocks(worker)
         return True
 
     def _store(self, worker: WorkerId, h: int, parent: int | None) -> None:
@@ -138,11 +180,30 @@ class RadixIndex:
             pnode.children.discard(node.hash)
             node = pnode
 
+    def _drop_blocks(self, worker: WorkerId) -> None:
+        # Batch removal via the per-worker node index: pop the worker's
+        # whole hash set once, detach it from each node, then prune only
+        # the nodes that actually emptied. The old path called _remove per
+        # hash, re-fetching and mutating the per-worker set for every
+        # block — under zonal-failure churn at 1000 engines that sweep is
+        # the router's dominant stall.
+        blocks = self._worker_blocks.pop(worker, None)
+        if not blocks:
+            return
+        emptied: list[_Node] = []
+        for h in blocks:
+            node = self._nodes.get(h)
+            if node is None:
+                continue
+            node.workers.discard(worker)
+            if not node.workers:
+                emptied.append(node)
+        for node in emptied:
+            self._prune(node)
+
     def remove_worker(self, worker: WorkerId) -> None:
         """Worker died or resubscribed: drop all its blocks."""
-        for h in list(self._worker_blocks.get(worker, ())):
-            self._remove(worker, h)
-        self._worker_blocks.pop(worker, None)
+        self._drop_blocks(worker)
         self._worker_event_ids.pop(worker, None)
 
 
@@ -266,11 +327,17 @@ class ShardedRadixIndex:
             self._last_shard[worker] = s
             self._queues[s].put(("remove", worker, None))
 
-    def find_matches(self, seq_hashes: list[int]) -> OverlapScores:
+    def find_matches(self, seq_hashes: list[int], top_k: int = 0) -> OverlapScores:
         scores: dict[WorkerId, int] = {}
         for shard, lock in zip(self._shards, self._locks):
             with lock:
-                scores.update(shard.find_matches(seq_hashes).scores)
+                scores.update(shard.find_matches(seq_hashes, top_k=top_k).scores)
+        if top_k > 0 and len(scores) > top_k:
+            # Per-shard shortlists are disjoint (a worker lives wholly in
+            # one shard); re-rank the union down to the global top-k.
+            import heapq as _heapq
+
+            scores = dict(_heapq.nlargest(top_k, scores.items(), key=lambda kv: kv[1]))
         return OverlapScores(scores)
 
     def workers(self) -> set[WorkerId]:

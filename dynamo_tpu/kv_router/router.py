@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator
 
@@ -65,6 +66,55 @@ class KvRouterConfig:
     # "admit on the warm engine, balancer sheds later" over landing
     # cold. None = off (no balancer, load priced at face value).
     migrate_cost_blocks: float | None = None
+    # Cluster-scale candidate pruning (docs/performance.md
+    # "Control-plane scaling"): the index returns a ranked top-k holder
+    # shortlist and the scheduler scores only shortlist ∪ least-loaded-m
+    # ∪ sticky/directory hits — O(k) per placement instead of O(fleet).
+    # 0 = full scan, byte-for-byte the pre-shortlist behavior. Fleets no
+    # larger than shortlist_k + least_loaded_m always take the full scan.
+    shortlist_k: int = 16
+    least_loaded_m: int = 4
+
+
+# How long a cached discovery roster stays fresh without a version bump.
+# The version counter covers registration/lease/breaker *events*, but an
+# open circuit transitions to half-open silently on read — a purely
+# version-keyed cache would starve the probe. 100 ms keeps the O(fleet)
+# roster scan off the per-request path while admitting probes within a
+# tenth of a second of their cooldown.
+_ROSTER_TTL_S = 0.1
+
+
+# Placement decisions are sub-millisecond dict work; the default
+# seconds-scale buckets would flatten the whole distribution into the
+# first bucket. 50 µs … 1 s covers pruned hot path through full-scan
+# stalls at 1000 engines.
+_PLACE_BUCKETS = (
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 1.0, float("inf"),
+)
+
+
+def register_router_metrics(registry) -> dict:
+    """Placement hot-path series (documented in docs/observability.md,
+    cataloged by DT006). Returns the metrics dict KvPushRouter accepts;
+    merge with the transfer_choices counter where the fleet economy is
+    wired."""
+    return {
+        "place_seconds": registry.histogram(
+            "router_place_seconds",
+            "Placement decision latency: hash chain, overlap lookup, cost schedule",
+            buckets=_PLACE_BUCKETS,
+        ),
+        "candidates_considered": registry.counter(
+            "router_candidates_considered",
+            "Workers cost-scored by placements; divide by router_decisions_total for mean candidate-set size",
+        ),
+        "shortlist_fallback": registry.counter(
+            "router_shortlist_fallback_total",
+            "Placements that ran the O(fleet) full scan while shortlist pruning was enabled",
+        ),
+    }
 
 
 class KvPushRouter:
@@ -98,9 +148,18 @@ class KvPushRouter:
                 overlap_score_weight=self.config.overlap_score_weight,
                 router_temperature=self.config.router_temperature,
                 migrate_cost_blocks=self.config.migrate_cost_blocks,
+                shortlist_k=self.config.shortlist_k,
+                least_loaded_m=self.config.least_loaded_m,
             )
         )
         self.active = ActiveSequences()
+        # Cached discovery roster (shortlist mode only): list + membership
+        # set + roster sync into ActiveSequences, refreshed on discovery
+        # version change or _ROSTER_TTL_S, whichever comes first.
+        self._roster: list[int] = []
+        self._roster_set: set[int] = set()
+        self._roster_version: int = -1
+        self._roster_stamp: float = 0.0
         if not self.config.use_kv_events:
             self.index: RadixIndex | ShardedRadixIndex | ApproxKvIndexer = (
                 ApproxKvIndexer(ttl_s=self.config.approx_ttl_s)
@@ -200,13 +259,35 @@ class KvPushRouter:
         keyed by (model, adapter): a conversation lands where both its KV
         prefix AND its adapter are warm, and an identical prompt under a
         different adapter can never ride another identity's cache."""
+        t0 = time.perf_counter() if self._m else 0.0
         bs = self.config.block_size
         hashes = compute_block_hashes(token_ids, bs, adapter_hash_seed(adapter_id))
         request_blocks = max(1, (len(token_ids) + bs - 1) // bs)
-        workers = [w for w in self.discovery.instance_ids() if w not in excluded]
+        k = self.config.shortlist_k
+        if k > 0:
+            # Shortlist mode: amortize the O(fleet) discovery scan behind
+            # a (version, TTL)-keyed roster cache and keep the
+            # ActiveSequences idle heap synced to it.
+            v = self.discovery.version
+            now = time.monotonic()
+            if v != self._roster_version or now - self._roster_stamp > _ROSTER_TTL_S:
+                self._roster = self.discovery.instance_ids()
+                self._roster_set = set(self._roster)
+                self._roster_version = v
+                self._roster_stamp = now
+                self.active.sync_roster(self._roster)
+            if excluded:
+                workers = [w for w in self._roster if w not in excluded]
+                eligible_set = set(workers)
+            else:
+                workers = self._roster
+                eligible_set = self._roster_set
+        else:
+            workers = [w for w in self.discovery.instance_ids() if w not in excluded]
+            eligible_set = None  # legacy membership checks scan the list
         if not workers:
             raise NoInstancesError("no available instances")
-        overlaps = self.index.find_matches(hashes)
+        overlaps = self.index.find_matches(hashes, top_k=k)
         if self.decisions is not None:
             # Cross-process stickiness: a sibling's published placement is
             # an overlap FLOOR fed to the same cost schedule — a deeper
@@ -215,38 +296,78 @@ class KvPushRouter:
             cached = self.decisions.lookup(hashes)
             if cached is not None:
                 wid, depth = cached
-                if wid in workers and depth > overlaps.scores.get(wid, 0):
+                member = wid in (eligible_set if eligible_set is not None else workers)
+                if member and depth > overlaps.scores.get(wid, 0):
                     overlaps.scores[wid] = depth
+        # Would the scheduler actually prune? (Mirrors its own predicate.)
+        prune = (
+            k > 0
+            and len(workers) > k + self.config.least_loaded_m
+            and self.active.roster_size() > 0
+        )
         dir_runs: dict[int, int] = {}
         fetchable: dict[int, int] | None = None
+        fetch_default = 0
         if self.directory is not None:
             dir_runs = {
                 wid: d for wid, d in self.directory.best_runs(hashes).items()
                 if wid not in excluded
             }
             if dir_runs:
-                for wid in workers:
+                for wid, d in dir_runs.items():
                     # Own holdings floor the overlap: the live index only
                     # mirrors G1 events, the directory also knows the
-                    # worker's G2-G4 (and drained-in) residency.
-                    d = dir_runs.get(wid, 0)
-                    if d > overlaps.scores.get(wid, 0):
+                    # worker's G2-G4 (and drained-in) residency. (Only
+                    # listed holders can floor — everyone else's run is 0.)
+                    member = wid in (eligible_set if eligible_set is not None else workers)
+                    if member and d > overlaps.scores.get(wid, 0):
                         overlaps.scores[wid] = d
                 # Per-candidate transferable depth: the deepest run some
                 # OTHER holder (any pool — a prefill-role engine serves
                 # kv_prefix too) could stream to it.
-                fetchable = {}
-                for w in workers:
-                    peer = max(
-                        (d for wid, d in dir_runs.items() if wid != w),
-                        default=0,
-                    )
-                    if peer:
-                        fetchable[w] = peer
-                fetchable = fetchable or None
+                if prune:
+                    # O(holders): for any worker, max-over-others is the
+                    # global best run — or the second best if the worker
+                    # IS the best holder. Non-holders take fetch_default.
+                    top1_w, top1_d, top2_d = 0, 0, 0
+                    for wid, d in dir_runs.items():
+                        if d > top1_d:
+                            top2_d, top1_d, top1_w = top1_d, d, wid
+                        elif d > top2_d:
+                            top2_d = d
+                    fetch_default = top1_d
+                    fetchable = {}
+                    for wid in dir_runs:
+                        if wid in eligible_set:
+                            peer = top2_d if wid == top1_w else top1_d
+                            if peer:
+                                fetchable[wid] = peer
+                    fetchable = fetchable or None
+                else:
+                    fetchable = {}
+                    for w in workers:
+                        peer = max(
+                            (d for wid, d in dir_runs.items() if wid != w),
+                            default=0,
+                        )
+                        if peer:
+                            fetchable[w] = peer
+                    fetchable = fetchable or None
         placement = self.scheduler.schedule(
-            workers, request_blocks, overlaps, self.active, fetchable=fetchable
+            workers, request_blocks, overlaps, self.active, fetchable=fetchable,
+            workers_set=eligible_set, fetch_default=fetch_default,
         )
+        if self._m:
+            h = self._m.get("place_seconds")
+            if h is not None:
+                h.observe(time.perf_counter() - t0)
+            c = self._m.get("candidates_considered")
+            if c is not None:
+                c.inc(placement.candidates_considered)
+            if k > 0 and placement.full_scan:
+                f = self._m.get("shortlist_fallback")
+                if f is not None:
+                    f.inc()
         return placement, hashes, overlaps.scores, workers, dir_runs
 
     def _peer_hint(self, placement, scores: dict[int, int],

@@ -44,6 +44,16 @@ WorkerId = int
 class KvSchedulerConfig:
     overlap_score_weight: float = 1.0
     router_temperature: float = 0.0
+    # Candidate pruning: score only `shortlist ∪ least-loaded-m ∪
+    # sticky/directory hits` instead of every worker. The shortlist is
+    # the overlap index's ranked top-k holders (indexer.find_matches
+    # top_k); least-loaded-m comes from the ActiveSequences idle heap.
+    # 0 disables pruning entirely — the full-scan loop runs byte-for-byte
+    # as before (the escape hatch). Fleets no larger than
+    # shortlist_k + least_loaded_m always take the full scan: pruning
+    # there saves nothing and the exact argmin is free.
+    shortlist_k: int = 16
+    least_loaded_m: int = 4
     # Cost of pulling one missing prefix block from a peer, in units of
     # recomputing one block locally (0 = transfers are free, 1 = no
     # cheaper than recompute — directory pricing effectively off).
@@ -66,6 +76,11 @@ class Placement:
     # Blocks the chosen worker should PULL from a peer (directory-priced
     # transfer); 0 when the plain overlap path won.
     fetch_blocks: int = 0
+    # Observability: how many workers were actually cost-scored, and
+    # whether the full-scan path ran (True for shortlist_k=0, small
+    # fleets, or an unsynced roster — the pruned path's fallback).
+    candidates_considered: int = 0
+    full_scan: bool = True
 
 
 class KvScheduler:
@@ -80,20 +95,72 @@ class KvScheduler:
         overlaps: OverlapScores,
         active: ActiveSequences,
         fetchable: dict[WorkerId, int] | None = None,
+        workers_set: set[WorkerId] | None = None,
+        fetch_default: int = 0,
     ) -> Placement:
         """Pick a worker for a request spanning ``request_blocks`` blocks.
 
         ``fetchable`` maps worker → the deepest leading-run depth any
         OTHER directory-listed holder has for this request (absolute
         blocks from the root); the part beyond the worker's own overlap
-        is what a transfer would save, priced at transfer_block_cost."""
+        is what a transfer would save, priced at transfer_block_cost.
+
+        With ``shortlist_k > 0`` and a fleet larger than
+        shortlist_k + least_loaded_m, only the candidate set
+        `overlap holders ∪ fetchable holders ∪ least-loaded-m` is scored
+        (O(k), not O(fleet)). Every worker with nonzero overlap/fetch that
+        survived index top-k pruning is in the set, and among the
+        zero-overlap rest cost differs only by load — so when the index
+        shortlist covers all holders the pruned argmin equals the
+        full-scan argmin exactly (docs/performance.md, shortlist recall
+        policy). ``workers_set`` (eligible-worker membership) avoids an
+        O(fleet) set build when the caller already has one."""
         if not workers:
             raise ValueError("no workers")
+        k = self.config.shortlist_k
+        m = self.config.least_loaded_m
+        if k <= 0 or len(workers) <= k + m or active.roster_size() == 0:
+            return self._schedule_full(workers, request_blocks, overlaps, active,
+                                       fetchable, fetch_default)
+        wset = workers_set if workers_set is not None else set(workers)
+        cand: list[WorkerId] = []
+        seen: set[WorkerId] = set()
+        for w in overlaps.scores:
+            if w in wset:
+                seen.add(w)
+                cand.append(w)
+        if fetchable:
+            for w in fetchable:
+                if w in wset and w not in seen:
+                    seen.add(w)
+                    cand.append(w)
+        for w in active.least_loaded(m, exclude=seen):
+            if w in wset:
+                cand.append(w)
+        if not cand:
+            return self._schedule_full(workers, request_blocks, overlaps, active,
+                                       fetchable, fetch_default)
+        mean = active.roster_mean_load()
+        return self._score(cand, request_blocks, overlaps, active, fetchable,
+                           fetch_default, mean=mean, full_scan=False)
+
+    def _schedule_full(
+        self,
+        workers: list[WorkerId],
+        request_blocks: int,
+        overlaps: OverlapScores,
+        active: ActiveSequences,
+        fetchable: dict[WorkerId, int] | None,
+        fetch_default: int = 0,
+    ) -> Placement:
+        """Legacy O(fleet) scan — the shortlist_k=0 escape hatch. Scores
+        every worker and derives the fleet mean from the scored loads,
+        byte-identical to the pre-shortlist scheduler."""
         per_worker: list[tuple[int, int]] = []  # (overlap, fetch) per worker
         loads: list[int] = []
         for w in workers:
             overlap = min(overlaps.scores.get(w, 0), request_blocks)
-            fetch = self._fetch_blocks(w, overlap, request_blocks, fetchable)
+            fetch = self._fetch_blocks(w, overlap, request_blocks, fetchable, fetch_default)
             per_worker.append((overlap, fetch))
             loads.append(active.active_blocks(w))
         priced = self._priced_loads(loads)
@@ -116,6 +183,54 @@ class KvScheduler:
             overlap_blocks=overlap,
             total_blocks=request_blocks,
             fetch_blocks=fetch,
+            candidates_considered=len(workers),
+            full_scan=True,
+        )
+
+    def _score(
+        self,
+        cand: list[WorkerId],
+        request_blocks: int,
+        overlaps: OverlapScores,
+        active: ActiveSequences,
+        fetchable: dict[WorkerId, int] | None,
+        fetch_default: int,
+        mean: float,
+        full_scan: bool,
+    ) -> Placement:
+        """Cost-score ``cand`` only, using the incrementally-maintained
+        roster mean for migration-aware load pricing instead of an
+        O(fleet) recompute."""
+        cap_extra = self.config.migrate_cost_blocks
+        cap = None if cap_extra is None else mean + cap_extra
+        per_worker: list[tuple[int, int]] = []
+        costs: list[float] = []
+        for w in cand:
+            overlap = min(overlaps.scores.get(w, 0), request_blocks)
+            fetch = self._fetch_blocks(w, overlap, request_blocks, fetchable, fetch_default)
+            per_worker.append((overlap, fetch))
+            load = float(active.active_blocks(w))
+            if cap is not None and load > cap:
+                load = cap
+            potential_prefill = (
+                request_blocks
+                - overlap
+                - fetch
+                + self.config.transfer_block_cost * fetch
+            )
+            potential_decode = load + request_blocks
+            costs.append(
+                self.config.overlap_score_weight * potential_prefill + potential_decode
+            )
+        idx = softmax_sample(costs, self.config.router_temperature, self._rng)
+        overlap, fetch = per_worker[idx]
+        return Placement(
+            worker=cand[idx],
+            overlap_blocks=overlap,
+            total_blocks=request_blocks,
+            fetch_blocks=fetch,
+            candidates_considered=len(cand),
+            full_scan=full_scan,
         )
 
     def _priced_loads(self, loads: list[int]) -> list[float]:
@@ -134,10 +249,14 @@ class KvScheduler:
     def _fetch_blocks(
         w: WorkerId, overlap: int, request_blocks: int,
         fetchable: dict[WorkerId, int] | None,
+        default: int = 0,
     ) -> int:
+        """``default`` is the compact-fetchable fallback depth for workers
+        the dict doesn't list (pruned mode lists holders only; everyone
+        else's max-over-other-holders run is the global best run)."""
         if not fetchable:
             return 0
-        return max(0, min(fetchable.get(w, 0), request_blocks) - overlap)
+        return max(0, min(fetchable.get(w, default), request_blocks) - overlap)
 
 
 def softmax_sample(costs: list[float], temperature: float, rng: random.Random) -> int:

@@ -151,14 +151,23 @@ class ModelPipeline:
                 if self.settings.decisions is not None else None
             )
             fm = self.settings.fleet_metrics or {}
+            reg = getattr(self.runtime, "metrics", None)
+            router_m: dict | None = None
+            if reg is not None:
+                from dynamo_tpu.kv_router.router import register_router_metrics
+
+                # Placement hot-path series live beside the hit-rate
+                # series on the frontend registry (registration dedupes
+                # across models — one series set per process).
+                router_m = register_router_metrics(reg.child("router"))
+            if "transfer_choices" in fm:
+                router_m = dict(router_m or {})
+                router_m["transfer_choices"] = fm["transfer_choices"]
             self.kv_router = await KvPushRouter(
                 push, kv_cfg, event_sink=self._make_hit_rate_sink(),
                 decisions=decisions,
                 directory=self.settings.directory,
-                metrics=(
-                    {"transfer_choices": fm["transfer_choices"]}
-                    if "transfer_choices" in fm else None
-                ),
+                metrics=router_m,
             ).start()
             engine = self.kv_router
         else:

@@ -41,6 +41,9 @@ def parse_args(argv=None):
     p.add_argument("--no-kv-events", action="store_true")
     p.add_argument("--index-shards", type=int, default=0,
                    help="KV index shard threads (0 = in-loop; reference: KvIndexerSharded)")
+    p.add_argument("--shortlist-k", type=int, default=16,
+                   help="candidate pruning: top-k holder shortlist + least-loaded "
+                        "workers only (0 = legacy full scan)")
     return p.parse_args(argv)
 
 
@@ -58,6 +61,7 @@ async def async_main(args) -> None:
             router_temperature=args.router_temperature,
             use_kv_events=not args.no_kv_events,
             index_shards=args.index_shards,
+            shortlist_k=args.shortlist_k,
         ),
     ).start()
 

@@ -1,0 +1,45 @@
+"""Tier-1 guard for benchmarks/bench_sim.py: the cluster-scale
+control-plane instrument runs its --quick arms (100 simulated engines,
+shrunken trace / mirror / budget / flap) end to end and enforces its
+own invariants — pruned-vs-full speedup > 1, goodput parity with the
+full-scan oracle, bounded mirror with eviction + recent-hit, budget
+re-convergence after a crash, zero autoscaler flaps — so the BENCH_SIM
+harness can't bit-rot between perf rounds.
+
+No latency-magnitude assertions: --quick makes no timing claims; the
+1000-engine numbers live in BENCH_SIM_r20.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_sim_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "bench_sim.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    # QUICK-OK prints only after the bench's own asserts pass.
+    assert "QUICK-OK" in proc.stdout, proc.stdout[-2000:] + proc.stderr[-2000:]
+    result = json.loads(proc.stdout.splitlines()[-1])
+    assert result["bench"] == "BENCH_SIM"
+    acc = result["acceptance"]
+    assert acc["goodput_within_2pct"], acc
+    assert acc["mirror_bounded"], acc
+    assert acc["budget_reconverged"], acc
+    assert acc["zero_flapping"], acc
+    # Both placement arms ran the full quick trace through the real
+    # router, including the zonal fail/restore churn windows.
+    arm = result["placement"]["100"]
+    for variant in ("pruned", "full_scan_oracle"):
+        assert arm[variant]["requests"] == 3000, arm[variant]
+        kinds = {e["kind"] for e in arm[variant]["zone_churn"]}
+        assert kinds == {"fail", "restore"}, arm[variant]["zone_churn"]
+    assert arm["pruned"]["mean_candidates"] < arm["full_scan_oracle"]["mean_candidates"]

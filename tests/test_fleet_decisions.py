@@ -98,13 +98,14 @@ class _StubIndex:
     def __init__(self, scores):
         self._scores = scores
 
-    def find_matches(self, hashes):
+    def find_matches(self, hashes, top_k=0):
         return OverlapScores(dict(self._scores))
 
 
 class _StubDiscovery:
     def __init__(self, ids):
         self._ids = ids
+        self.version = 1
 
     def instance_ids(self):
         return list(self._ids)
@@ -126,6 +127,11 @@ def _router_with(decisions, index_scores, workers):
     r.scheduler = KvScheduler()
     r.active = ActiveSequences()
     r.directory = None
+    r._m = {}
+    r._roster = []
+    r._roster_set = set()
+    r._roster_version = -1
+    r._roster_stamp = 0.0
     return r
 
 

@@ -149,16 +149,21 @@ def make_request(prompt, max_tokens=4):
     return r.to_dict()
 
 
-def test_kv_router_concentrates_prefix_traffic():
+@pytest.mark.parametrize("shortlist_k", [0, 16])
+def test_kv_router_concentrates_prefix_traffic(shortlist_k):
+    # shortlist_k=0 is the legacy full-scan escape hatch: routing through
+    # the full e2e stack must behave identically under both settings.
     async def go():
-        url = "memory://kvr1"
+        url = f"memory://kvr1-{shortlist_k}"
         rt_a, eng_a = await start_mock_worker(url)
         rt_b, eng_b = await start_mock_worker(url)
         rt_c = await DistributedRuntime.create(store_url=url)
         ep = rt_c.namespace("kvtest").component("backend").endpoint("generate")
         push = await ep.router(RouterMode.DIRECT)
         await push.discovery.wait_for_instances(2)
-        router = await KvPushRouter(push, KvRouterConfig(block_size=BS)).start()
+        router = await KvPushRouter(
+            push, KvRouterConfig(block_size=BS, shortlist_k=shortlist_k)
+        ).start()
         try:
             shared_prefix = list(range(1, 17))  # 4 full blocks
             # Request 1: lands somewhere, warms that worker.

@@ -1,0 +1,32 @@
+"""Tier-1 guard for tools/profile_router.py: the placement-latency
+profiler runs its --quick sweep (64-engine fleet, full-scan vs pruned)
+and asserts its internal invariants itself — candidate counts, fallback
+rate, nonzero latency percentiles — so the tool can't bit-rot between
+perf rounds.
+
+No timing assertions: --quick makes no latency claims.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_profile_router_quick_smoke():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "profile_router.py"),
+         "--quick"],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    # QUICK-OK prints only after the tool's own asserts (full scan scores
+    # the whole fleet, pruning scores strictly fewer, bounded fallback).
+    assert "QUICK-OK" in proc.stdout, proc.stdout + proc.stderr[-2000:]
+    cells = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert {c["shortlist_k"] for c in cells} == {0, 8}
+    for c in cells:
+        assert c["requests"] == 200 and c["place_p99_us"] > 0

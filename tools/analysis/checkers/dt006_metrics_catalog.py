@@ -121,6 +121,15 @@ async def build_registries():
     balancer_registry = MetricsRegistry()
     register_balancer_metrics(balancer_registry)
 
+    # Router placement hot-path series (kv_router/router.py): also
+    # reached through the KV-pipeline boot above, but registered
+    # explicitly so the catalog guards them even if model discovery
+    # races the check.
+    from dynamo_tpu.kv_router.router import register_router_metrics
+
+    router_registry = MetricsRegistry()
+    register_router_metrics(router_registry.child("router"))
+
     registries = [
         ("worker", wrt.metrics),
         ("frontend", frt.metrics),
@@ -129,6 +138,7 @@ async def build_registries():
         ("planner", planner_registry),
         ("migration", migration_registry),
         ("balancer", balancer_registry),
+        ("router", router_registry),
     ]
 
     async def cleanup():
